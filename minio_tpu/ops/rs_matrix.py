@@ -1,0 +1,153 @@
+"""Reed-Solomon coding matrices, reference-compatible.
+
+Builds the systematic (K+M)xK encode matrix exactly the way the reference's
+codec does (klauspost/reedsolomon default construction, per the Backblaze
+scheme: Vandermonde matrix normalised by the inverse of its top KxK square;
+see /root/reference/cmd/erasure-coding.go:63 for where the reference
+instantiates it). Bit-exactness is pinned by tests/test_rs_golden.py.
+
+Also provides:
+  * decode matrices: given which shards survive, the KxK inverse that maps
+    surviving data+parity rows back to the original data shards;
+  * GF(2) *bit expansion*: multiplication by a field constant is linear over
+    GF(2), so any GF(2^8) matrix lifts to a binary matrix acting on the 8
+    bits of each byte.  The TPU kernels run the lifted matrices on the MXU
+    as {0,1} matmuls with a mod-2 reduction (see ops/rs.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from . import gf
+
+MAX_SHARDS = 256  # reference cap: cmd/erasure-coding.go:48
+
+
+@functools.lru_cache(maxsize=None)
+def vandermonde(rows: int, cols: int) -> np.ndarray:
+    m = np.zeros((rows, cols), dtype=np.uint8)
+    for r in range(rows):
+        for c in range(cols):
+            m[r, c] = gf.gf_exp(r, c)
+    return m
+
+
+@functools.lru_cache(maxsize=None)
+def encode_matrix(data: int, parity: int) -> np.ndarray:
+    """Systematic (data+parity) x data matrix; top is the identity."""
+    if data <= 0 or parity <= 0:
+        raise ValueError("data and parity shard counts must be positive")
+    if data + parity > MAX_SHARDS:
+        raise ValueError(f"at most {MAX_SHARDS} total shards")
+    vm = vandermonde(data + parity, data)
+    top_inv = gf.mat_inv(vm[:data])
+    m = gf.mat_mul(vm, top_inv)
+    m.setflags(write=False)
+    return m
+
+
+@functools.lru_cache(maxsize=None)
+def parity_matrix(data: int, parity: int) -> np.ndarray:
+    """The bottom parity x data block of the encode matrix."""
+    return encode_matrix(data, parity)[data:]
+
+
+def decode_matrix(data: int, parity: int, present: tuple[bool, ...]) -> np.ndarray:
+    """Matrix reconstructing ALL data shards from the first `data` present shards.
+
+    `present[i]` says whether shard row i (0..data+parity) survived. Returns a
+    [data, data] matrix M with: original_data = M @ survivors, where survivors
+    are the first `data` present shards in index order (the reference decoder
+    uses exactly the first K surviving rows; klauspost reconstruct semantics).
+    """
+    if len(present) != data + parity:
+        raise ValueError("present mask length must equal total shards")
+    rows = [i for i, p in enumerate(present) if p][:data]
+    if len(rows) < data:
+        raise ValueError("not enough shards to reconstruct")
+    em = encode_matrix(data, parity)
+    sub = em[rows]  # [data, data]
+    return gf.mat_inv(sub)
+
+
+def reconstruct_rows(
+    data: int, parity: int, present: tuple[bool, ...], want: tuple[int, ...]
+) -> np.ndarray:
+    """Coefficients producing the `want` shard rows from the K survivors.
+
+    Returns [len(want), data] GF coefficients applied to the first `data`
+    surviving shards (in index order). Data rows come straight from
+    decode_matrix; parity rows are re-encoded through the parity block.
+    """
+    dm = decode_matrix(data, parity, present)
+    em = encode_matrix(data, parity)
+    out = []
+    for w in want:
+        if w < data:
+            out.append(dm[w])
+        else:
+            # parity row w = em[w] @ data = em[w] @ (dm @ survivors)
+            out.append(gf.mat_mul(em[w : w + 1], dm)[0])
+    return np.stack(out, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# GF(2) bit expansion
+# ---------------------------------------------------------------------------
+
+
+def _byte_bitmatrix(c: int) -> np.ndarray:
+    """8x8 binary matrix B with bits(c*x) = B @ bits(x) (LSB-first)."""
+    cols = []
+    for b in range(8):
+        prod = gf.gf_mul(c, 1 << b)
+        cols.append([(prod >> j) & 1 for j in range(8)])
+    # cols[b][j] = bit j of c*2^b; want B[j, b].
+    return np.array(cols, dtype=np.uint8).T
+
+
+@functools.lru_cache(maxsize=None)
+def _all_byte_bitmatrices() -> np.ndarray:
+    """[256, 8, 8] binary matrices for every field constant."""
+    return np.stack([_byte_bitmatrix(c) for c in range(256)], axis=0)
+
+
+def bit_expand(coeffs: np.ndarray) -> np.ndarray:
+    """Lift a GF(2^8) coefficient matrix [M, K] to GF(2) weights [K*8, M*8].
+
+    The lifted matrix W satisfies, for input bits x of shape [..., K*8]
+    (LSB-first within each byte) and output bits y of shape [..., M*8]:
+        y = (x @ W) mod 2
+    which is exactly  out[m] = XOR_k  coeffs[m, k] * in[k]  in the field.
+    """
+    m, k = coeffs.shape
+    bms = _all_byte_bitmatrices()[coeffs]  # [M, K, 8(out), 8(in)]
+    # W[k*8 + b_in, m*8 + b_out] = bms[m, k, b_out, b_in]
+    w = bms.transpose(1, 3, 0, 2).reshape(k * 8, m * 8)
+    return np.ascontiguousarray(w)
+
+
+def shard_size(data_len: int, k: int) -> int:
+    """Per-shard length after the reference's Split: ceil(len/K)."""
+    return -(-data_len // k)
+
+
+def split(data: bytes | np.ndarray, k: int) -> np.ndarray:
+    """Split a buffer into K equal shards, zero-padding the tail.
+
+    Matches reedsolomon.Encoder.Split as used by EncodeData
+    (/root/reference/cmd/erasure-coding.go:77-91): per-shard size is
+    ceil(len/K) and the final shard is zero-padded.
+    Returns [K, shard_size] uint8.
+    """
+    buf = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
+    n = buf.shape[0]
+    if n == 0:
+        raise ValueError("cannot split empty data")
+    per = shard_size(n, k)
+    padded = np.zeros(k * per, dtype=np.uint8)
+    padded[:n] = buf
+    return padded.reshape(k, per)

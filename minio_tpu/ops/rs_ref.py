@@ -1,0 +1,78 @@
+"""Host (numpy) Reed-Solomon encode/decode. Correctness reference + fallback.
+
+Table-lookup implementation of the same math the device kernels in ops/rs.py
+run as GF(2) matmuls. Used by tests to cross-check the device path, and by the
+runtime as the low-latency fallback when a batch is too small to be worth a
+device round-trip (the reference's analogue is the always-on CPU SIMD codec,
+/root/reference/cmd/erasure-coding.go:63).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import gf, rs_matrix
+
+
+def encode(shards: np.ndarray, parity: int) -> np.ndarray:
+    """shards: [K, S] u8 data shards -> [K+M, S] all shards (data + parity)."""
+    k, s = shards.shape
+    pm = rs_matrix.parity_matrix(k, parity)  # [M, K]
+    mul = gf.mul_table()
+    out = np.empty((k + parity, s), dtype=np.uint8)
+    out[:k] = shards
+    for m in range(parity):
+        acc = np.zeros(s, dtype=np.uint8)
+        row = pm[m]
+        for j in range(k):
+            c = int(row[j])
+            if c:
+                acc ^= mul[c][shards[j]]
+        out[k + m] = acc
+    return out
+
+
+def encode_data(data: bytes | np.ndarray, k: int, parity: int) -> np.ndarray:
+    """Split + encode, matching Erasure.EncodeData semantics."""
+    return encode(rs_matrix.split(data, k), parity)
+
+
+def apply_coeffs(coeffs: np.ndarray, shards: np.ndarray) -> np.ndarray:
+    """[R, K] GF coefficients applied to [K, S] shards -> [R, S]."""
+    mul = gf.mul_table()
+    r, k = coeffs.shape
+    _, s = shards.shape
+    out = np.zeros((r, s), dtype=np.uint8)
+    for i in range(r):
+        for j in range(k):
+            c = int(coeffs[i, j])
+            if c:
+                out[i] ^= mul[c][shards[j]]
+    return out
+
+
+def reconstruct(
+    shards: list[np.ndarray | None], k: int, parity: int, data_only: bool = False
+) -> list[np.ndarray]:
+    """Fill in missing (None) shards. Mirrors Reconstruct/ReconstructData
+    (/root/reference/cmd/erasure-coding.go:96-119)."""
+    total = k + parity
+    if len(shards) != total:
+        raise ValueError("wrong shard count")
+    present = tuple(s is not None for s in shards)
+    n_present = sum(present)
+    if n_present == total:
+        return list(shards)  # type: ignore[return-value]
+    if n_present < k:
+        raise ValueError("not enough shards to reconstruct")
+    survivors = np.stack([s for s in shards if s is not None][:k], axis=0)
+    limit = k if data_only else total
+    want = tuple(i for i in range(limit) if shards[i] is None)
+    if not want:
+        return list(shards)  # type: ignore[return-value]
+    coeffs = rs_matrix.reconstruct_rows(k, parity, present, want)
+    rebuilt = apply_coeffs(coeffs, survivors)
+    out = list(shards)
+    for idx, w in enumerate(want):
+        out[w] = rebuilt[idx]
+    return out  # type: ignore[return-value]

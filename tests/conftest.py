@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+
+Benches run on the real TPU chip; tests exercise the same code on a virtual
+multi-device CPU platform so sharding/collective paths are covered without
+hardware (mirrors the reference's in-process multi-disk harness philosophy,
+/root/reference/cmd/test-utils_test.go:199).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")
