@@ -1,0 +1,154 @@
+"""Bitrot protection: algorithm registry + interleaved streaming format.
+
+Mirrors the reference's bitrot layer (cmd/bitrot.go:39-117 registry,
+cmd/bitrot-streaming.go interleaved format): a shard file written with the
+streaming algorithm is the concatenation of H(chunk) || chunk for every
+shard-sized chunk, so reads can verify any chunk without the whole file.
+
+The default algorithm is HighwayHash256S (streaming), as in the reference.
+Hash computation itself is ops/highwayhash.py (host) or
+ops/highwayhash_jax.py (device, batched); this module is the format layer.
+"""
+
+from __future__ import annotations
+
+import enum
+import hashlib
+from dataclasses import dataclass
+
+from . import highwayhash as hh
+
+
+class BitrotAlgorithm(enum.Enum):
+    SHA256 = "sha256"
+    BLAKE2B512 = "blake2b"
+    HIGHWAYHASH256 = "highwayhash256"
+    HIGHWAYHASH256S = "highwayhash256S"
+
+    @property
+    def streaming(self) -> bool:
+        return self is BitrotAlgorithm.HIGHWAYHASH256S
+
+    @property
+    def digest_size(self) -> int:
+        return 64 if self is BitrotAlgorithm.BLAKE2B512 else 32
+
+    def new(self):
+        if self is BitrotAlgorithm.SHA256:
+            return hashlib.sha256()
+        if self is BitrotAlgorithm.BLAKE2B512:
+            return hashlib.blake2b(digest_size=64)
+        return hh.HighwayHash256()
+
+
+DEFAULT_ALGORITHM = BitrotAlgorithm.HIGHWAYHASH256S
+
+
+class BitrotCorrupt(Exception):
+    """Equivalent of the reference's errFileCorrupt for bitrot mismatches."""
+
+
+def shard_file_size(size: int, shard_size: int, algo: BitrotAlgorithm = DEFAULT_ALGORITHM) -> int:
+    """On-disk size of a bitrot-protected shard file (cmd/bitrot.go:146-151)."""
+    if not algo.streaming:
+        return size
+    if size == 0:
+        return 0
+    n_chunks = -(-size // shard_size)
+    return n_chunks * algo.digest_size + size
+
+
+def chunk_offset(offset: int, shard_size: int, algo: BitrotAlgorithm = DEFAULT_ALGORITHM) -> int:
+    """Map a logical shard offset (multiple of shard_size) to its file offset."""
+    if not algo.streaming:
+        return offset
+    assert offset % shard_size == 0
+    n_chunks = offset // shard_size
+    return n_chunks * (shard_size + algo.digest_size)
+
+
+@dataclass
+class StreamingBitrotWriter:
+    """Accumulates H(chunk) || chunk frames; caller supplies full chunks.
+
+    Each write MUST be exactly one erasure shard-chunk (the per-block shard),
+    matching how the erasure encoder drives bitrot writers in the reference
+    (cmd/erasure-encode.go:73-109 -> bitrot-streaming.go:43-65).
+    """
+
+    algo: BitrotAlgorithm = DEFAULT_ALGORITHM
+
+    def __post_init__(self):
+        self._frames: list[bytes] = []
+
+    def write(self, chunk: bytes, digest: bytes | None = None) -> None:
+        """Append a chunk frame; digest may be precomputed (device batch)."""
+        if digest is None:
+            h = self.algo.new()
+            h.update(chunk)
+            digest = h.digest()
+        self._frames.append(digest)
+        self._frames.append(chunk)
+
+    def getvalue(self) -> bytes:
+        return b"".join(self._frames)
+
+
+class StreamingBitrotReader:
+    """Verifying reader over an interleaved shard file image."""
+
+    def __init__(self, data: bytes, shard_size: int, algo: BitrotAlgorithm = DEFAULT_ALGORITHM):
+        self.data = data
+        self.shard_size = shard_size
+        self.algo = algo
+
+    def read_chunk(self, logical_offset: int) -> bytes:
+        """Read + verify the chunk that starts at a logical shard offset."""
+        hlen = self.algo.digest_size
+        pos = chunk_offset(logical_offset, self.shard_size, self.algo)
+        want = self.data[pos : pos + hlen]
+        chunk = self.data[pos + hlen : pos + hlen + self.shard_size]
+        if len(want) < hlen or not chunk:
+            raise BitrotCorrupt("short read in bitrot stream")
+        h = self.algo.new()
+        h.update(chunk)
+        if h.digest() != want:
+            raise BitrotCorrupt(f"bitrot mismatch at logical offset {logical_offset}")
+        return chunk
+
+
+def verify_stream(
+    data: bytes,
+    part_size: int,
+    shard_size: int,
+    algo: BitrotAlgorithm = DEFAULT_ALGORITHM,
+    want_sum: bytes | None = None,
+) -> None:
+    """Whole-file bitrot verification (cmd/bitrot.go:154-206 semantics).
+
+    For streaming algo: checks total size and every interleaved chunk hash.
+    For whole-file algos: checks the single digest against want_sum.
+    """
+    if not algo.streaming:
+        h = algo.new()
+        h.update(data)
+        if want_sum is None or h.digest() != want_sum:
+            raise BitrotCorrupt("whole-file bitrot mismatch")
+        return
+    if len(data) != shard_file_size(part_size, shard_size, algo):
+        raise BitrotCorrupt("bitrot file size mismatch")
+    hlen = algo.digest_size
+    left = part_size
+    pos = 0
+    while left > 0:
+        n = min(shard_size, left)
+        want = data[pos : pos + hlen]
+        chunk = data[pos + hlen : pos + hlen + n]
+        if len(want) != hlen or len(chunk) != n:
+            raise BitrotCorrupt("short read in bitrot stream")
+        h = algo.new()
+        h.update(chunk)
+        if h.digest() != want:
+            raise BitrotCorrupt(f"bitrot mismatch at offset {pos}")
+        pos += hlen + n
+        left -= n

@@ -1,0 +1,257 @@
+"""HighwayHash-256 (frozen, Jan-2017 spec) -- bitrot checksum hash.
+
+Bit-exact reimplementation of the hash the reference uses for bitrot
+protection (minio/highwayhash, used via the BitrotAlgorithm registry at
+/root/reference/cmd/bitrot.go:47-64; magic key at bitrot.go:37). Correctness is
+pinned by the reference's boot-time self-test chain re-hosted in
+tests/test_highwayhash.py (reference: cmd/bitrot.go:214-245).
+
+Two implementations:
+  * numpy, vectorized over a batch of equal-length streams using native u64 --
+    the host path, also the cross-check oracle for the device path;
+  * JAX, vectorized and scan-based, with every u64 emulated as a (lo, hi) u32
+    pair because TPU has no native 64-bit integers. The batch dimension is
+    where the parallelism lives: HighwayHash is sequential per stream, but the
+    bitrot layout hashes every shard-chunk independently (16 shards x many
+    blocks), exactly the lane-parallel shape the VPU wants.
+
+State: four 4-lane u64 vectors (v0, v1, mul0, mul1). Per 32-byte packet:
+vector adds, 32x32->64 multiplies, and a byte-wise "zipper merge" permutation.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# First 100 decimals of pi hashed with a zero key -- the reference's magic
+# bitrot key (cmd/bitrot.go:37).
+MAGIC_KEY = bytes(
+    [
+        0x4B, 0xE7, 0x34, 0xFA, 0x8E, 0x23, 0x8A, 0xCD, 0x26, 0x3E, 0x83, 0xE6,
+        0xBB, 0x96, 0x85, 0x52, 0x04, 0x0F, 0x93, 0x5D, 0xA3, 0x9F, 0x44, 0x14,
+        0x97, 0xE0, 0x9D, 0x13, 0x22, 0xDE, 0x36, 0xA0,
+    ]
+)
+
+_INIT0 = np.array(
+    [0xDBE6D5D5FE4CCE2F, 0xA4093822299F31D0, 0x13198A2E03707344, 0x243F6A8885A308D3],
+    dtype=np.uint64,
+)
+_INIT1 = np.array(
+    [0x3BD39E10CB0EF593, 0xC0ACF169B5F18A8C, 0xBE5466CF34E90C6C, 0x452821E638D01377],
+    dtype=np.uint64,
+)
+
+_M32 = np.uint64(0xFFFFFFFF)
+
+
+def _rot32(x: np.ndarray) -> np.ndarray:
+    return (x >> np.uint64(32)) | (x << np.uint64(32))
+
+
+class _State:
+    """Batched HighwayHash state: each member is [B, 4] u64."""
+
+    __slots__ = ("v0", "v1", "mul0", "mul1")
+
+    def __init__(self, key: bytes, batch: int):
+        key_lanes = np.frombuffer(key, dtype="<u8")
+        assert key_lanes.shape == (4,)
+        self.v0 = np.broadcast_to(_INIT0 ^ key_lanes, (batch, 4)).copy()
+        self.v1 = np.broadcast_to(_INIT1 ^ _rot32(key_lanes), (batch, 4)).copy()
+        self.mul0 = np.broadcast_to(_INIT0, (batch, 4)).copy()
+        self.mul1 = np.broadcast_to(_INIT1, (batch, 4)).copy()
+
+
+def _zipper_merge(v: np.ndarray) -> np.ndarray:
+    """Byte permutation applied per (even, odd) u64 lane pair.
+
+    v: [B, 4] u64 -> [B, 4] u64 of the additive zipper terms.
+    """
+    out = np.empty_like(v)
+    for e in (0, 2):
+        v0 = v[:, e]
+        v1 = v[:, e + 1]
+        u = np.uint64
+        out[:, e] = (
+            (((v0 & u(0xFF000000)) | (v1 & u(0xFF00000000))) >> u(24))
+            | (((v0 & u(0xFF0000000000)) | (v1 & u(0xFF000000000000))) >> u(16))
+            | (v0 & u(0xFF0000))
+            | ((v0 & u(0xFF00)) << u(32))
+            | ((v1 & u(0xFF00000000000000)) >> u(8))
+            | (v0 << u(56))
+        )
+        out[:, e + 1] = (
+            (((v1 & u(0xFF000000)) | (v0 & u(0xFF00000000))) >> u(24))
+            | (v1 & u(0xFF0000))
+            | ((v1 & u(0xFF0000000000)) >> u(16))
+            | ((v1 & u(0xFF00)) << u(24))
+            | ((v0 & u(0xFF000000000000)) >> u(8))
+            | ((v1 & u(0xFF)) << u(48))
+            | (v0 & u(0xFF00000000000000))
+        )
+    return out
+
+
+def _update(st: _State, lanes: np.ndarray) -> None:
+    """One packet round. lanes: [B, 4] u64 (little-endian packet words)."""
+    st.v1 += st.mul0 + lanes
+    st.mul0 ^= (st.v1 & _M32) * (st.v0 >> np.uint64(32))
+    st.v0 += st.mul1
+    st.mul1 ^= (st.v0 & _M32) * (st.v1 >> np.uint64(32))
+    st.v0 += _zipper_merge(st.v1)
+    st.v1 += _zipper_merge(st.v0)
+
+
+def _permute_and_update(st: _State) -> None:
+    p = _rot32(st.v0[:, [2, 3, 0, 1]])
+    _update(st, p)
+
+
+def _rotate_32_by(count: int, v: np.ndarray) -> np.ndarray:
+    """Rotate both 32-bit halves of each u64 lane left by `count`."""
+    c = np.uint64(count)
+    inv = np.uint64(32 - count) if count else np.uint64(0)
+    lo = v & _M32
+    hi = v >> np.uint64(32)
+    if count == 0:
+        return v
+    rl = ((lo << c) | (lo >> inv)) & _M32
+    rh = ((hi << c) | (hi >> inv)) & _M32
+    return rl | (rh << np.uint64(32))
+
+
+def _remainder_packet(tail: np.ndarray) -> np.ndarray:
+    """Build the special final packet for a [B, r] tail (0 < r < 32)."""
+    b, r = tail.shape
+    mod4 = r & 3
+    packet = np.zeros((b, 32), dtype=np.uint8)
+    packet[:, : r & ~3] = tail[:, : r & ~3]
+    remainder = tail[:, r & ~3 :]
+    if r & 16:
+        for i in range(4):
+            packet[:, 28 + i] = tail[:, r + i - 4]
+    elif mod4:
+        packet[:, 16] = remainder[:, 0]
+        packet[:, 17] = remainder[:, mod4 >> 1]
+        packet[:, 18] = remainder[:, mod4 - 1]
+    return packet
+
+
+def _modular_reduction(a3u: np.ndarray, a2: np.ndarray, a1: np.ndarray, a0: np.ndarray):
+    a3 = a3u & np.uint64(0x3FFFFFFFFFFFFFFF)
+    m1 = a1 ^ ((a3 << np.uint64(1)) | (a2 >> np.uint64(63))) ^ (
+        (a3 << np.uint64(2)) | (a2 >> np.uint64(62))
+    )
+    m0 = a0 ^ (a2 << np.uint64(1)) ^ (a2 << np.uint64(2))
+    return m0, m1
+
+
+def hash256(data: bytes | np.ndarray, key: bytes = MAGIC_KEY) -> bytes:
+    """One-shot HighwayHash-256 of a single byte string."""
+    arr = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
+    return hash256_batch(arr[None, :], key)[0].tobytes()
+
+
+def hash256_batch(data: np.ndarray, key: bytes = MAGIC_KEY) -> np.ndarray:
+    """HighwayHash-256 of B equal-length streams. data: [B, L] u8 -> [B, 32] u8."""
+    b, length = data.shape
+    st = _State(key, b)
+    n_full = length // 32
+    if n_full:
+        lanes = np.ascontiguousarray(data[:, : n_full * 32]).reshape(b, n_full, 32)
+        lanes = lanes.view("<u8").reshape(b, n_full, 4)
+        for i in range(n_full):
+            _update(st, lanes[:, i])
+    r = length - n_full * 32
+    if r:
+        st.v0 += np.uint64((r << 32) + r)
+        st.v1 = _rotate_32_by(r, st.v1)
+        packet = _remainder_packet(np.ascontiguousarray(data[:, n_full * 32 :]))
+        _update(st, packet.reshape(b, 32).view("<u8").reshape(b, 4))
+    for _ in range(10):
+        _permute_and_update(st)
+    h0, h1 = _modular_reduction(
+        st.v1[:, 1] + st.mul1[:, 1],
+        st.v1[:, 0] + st.mul1[:, 0],
+        st.v0[:, 1] + st.mul0[:, 1],
+        st.v0[:, 0] + st.mul0[:, 0],
+    )
+    h2, h3 = _modular_reduction(
+        st.v1[:, 3] + st.mul1[:, 3],
+        st.v1[:, 2] + st.mul1[:, 2],
+        st.v0[:, 3] + st.mul0[:, 3],
+        st.v0[:, 2] + st.mul0[:, 2],
+    )
+    out = np.stack([h0, h1, h2, h3], axis=1)  # [B, 4] u64
+    return np.ascontiguousarray(out).view(np.uint8).reshape(b, 32)
+
+
+class HighwayHash256:
+    """Streaming hasher with the stdlib-hashlib-style interface.
+
+    Buffers partial packets; digest() does not disturb the running state,
+    matching the reference's hash.Hash usage in bitrot writers
+    (cmd/bitrot-streaming.go:43-65).
+    """
+
+    digest_size = 32
+    block_size = 32
+
+    def __init__(self, key: bytes = MAGIC_KEY):
+        self._key = key
+        self._st = _State(key, 1)
+        self._buf = bytearray()
+
+    def update(self, data: bytes) -> None:
+        self._buf += data
+        n_full = len(self._buf) // 32
+        if len(self._buf) % 32 == 0 and n_full > 0:
+            n_full -= 1  # keep a full packet buffered; it may be the remainder
+        if n_full:
+            lanes = (
+                np.frombuffer(bytes(self._buf[: n_full * 32]), dtype="<u8")
+                .reshape(n_full, 4)
+            )
+            for i in range(n_full):
+                _update(self._st, lanes[i][None])
+            del self._buf[: n_full * 32]
+
+    def digest(self) -> bytes:
+        # Work on copies so the stream can continue after digest().
+        st = _State(self._key, 1)
+        st.v0 = self._st.v0.copy()
+        st.v1 = self._st.v1.copy()
+        st.mul0 = self._st.mul0.copy()
+        st.mul1 = self._st.mul1.copy()
+        buf = bytes(self._buf)
+        if len(buf) == 32:
+            _update(st, np.frombuffer(buf, dtype="<u8")[None])
+            buf = b""
+        r = len(buf)
+        if r:
+            st.v0 += np.uint64((r << 32) + r)
+            st.v1 = _rotate_32_by(r, st.v1)
+            packet = _remainder_packet(np.frombuffer(buf, dtype=np.uint8)[None])
+            _update(st, packet.view("<u8").reshape(1, 4))
+        for _ in range(10):
+            _permute_and_update(st)
+        h0, h1 = _modular_reduction(
+            st.v1[:, 1] + st.mul1[:, 1],
+            st.v1[:, 0] + st.mul1[:, 0],
+            st.v0[:, 1] + st.mul0[:, 1],
+            st.v0[:, 0] + st.mul0[:, 0],
+        )
+        h2, h3 = _modular_reduction(
+            st.v1[:, 3] + st.mul1[:, 3],
+            st.v1[:, 2] + st.mul1[:, 2],
+            st.v0[:, 3] + st.mul0[:, 3],
+            st.v0[:, 2] + st.mul0[:, 2],
+        )
+        out = np.stack([h0, h1, h2, h3], axis=1)
+        return np.ascontiguousarray(out).view(np.uint8).reshape(32).tobytes()
+
+    def hexdigest(self) -> str:
+        return self.digest().hex()

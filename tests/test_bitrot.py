@@ -1,0 +1,77 @@
+"""Bitrot format tests: interleaved stream layout, verification, corruption."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import bitrot
+from minio_tpu.ops.bitrot import BitrotAlgorithm, BitrotCorrupt
+
+
+def test_shard_file_size_formula():
+    # ceil(size/shardSize)*32 + size (cmd/bitrot.go:146-151)
+    assert bitrot.shard_file_size(0, 100) == 0
+    assert bitrot.shard_file_size(100, 100) == 132
+    assert bitrot.shard_file_size(101, 100) == 165
+    assert bitrot.shard_file_size(87382 * 16, 87382) == 87382 * 16 + 16 * 32
+    assert bitrot.shard_file_size(500, 100, BitrotAlgorithm.SHA256) == 500
+
+
+def _build_stream(part_size=1000, shard_size=256):
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, part_size).astype(np.uint8).tobytes()
+    w = bitrot.StreamingBitrotWriter()
+    for off in range(0, part_size, shard_size):
+        w.write(data[off : off + shard_size])
+    return data, w.getvalue()
+
+
+def test_roundtrip_and_verify():
+    part_size, shard_size = 1000, 256
+    data, blob = _build_stream(part_size, shard_size)
+    assert len(blob) == bitrot.shard_file_size(part_size, shard_size)
+    bitrot.verify_stream(blob, part_size, shard_size)
+    r = bitrot.StreamingBitrotReader(blob, shard_size)
+    out = b"".join(r.read_chunk(off) for off in range(0, part_size, shard_size))
+    assert out == data
+
+
+def test_corruption_detected():
+    part_size, shard_size = 1000, 256
+    _, blob = _build_stream(part_size, shard_size)
+    bad = bytearray(blob)
+    bad[40] ^= 0xFF  # flip a data byte in the first chunk
+    with pytest.raises(BitrotCorrupt):
+        bitrot.verify_stream(bytes(bad), part_size, shard_size)
+    r = bitrot.StreamingBitrotReader(bytes(bad), shard_size)
+    with pytest.raises(BitrotCorrupt):
+        r.read_chunk(0)
+    # Later chunks still verify (damage is localized).
+    assert r.read_chunk(256)
+
+
+def test_truncation_detected():
+    part_size, shard_size = 1000, 256
+    _, blob = _build_stream(part_size, shard_size)
+    with pytest.raises(BitrotCorrupt):
+        bitrot.verify_stream(blob[:-1], part_size, shard_size)
+
+
+def test_whole_file_algorithms():
+    data = b"hello world" * 10
+    for algo in (BitrotAlgorithm.SHA256, BitrotAlgorithm.BLAKE2B512, BitrotAlgorithm.HIGHWAYHASH256):
+        h = algo.new()
+        h.update(data)
+        digest = h.digest()
+        bitrot.verify_stream(data, len(data), 0, algo, want_sum=digest)
+        with pytest.raises(BitrotCorrupt):
+            bitrot.verify_stream(data + b"x", 0, 0, algo, want_sum=digest)
+
+
+def test_precomputed_digest_path():
+    # Device-batch path: digests computed elsewhere and handed to the writer.
+    from minio_tpu.ops import highwayhash as hh
+
+    chunk = b"z" * 128
+    w = bitrot.StreamingBitrotWriter()
+    w.write(chunk, digest=hh.hash256(chunk))
+    bitrot.verify_stream(w.getvalue(), 128, 128)

@@ -1,0 +1,96 @@
+"""HighwayHash-256 bit-exactness tests.
+
+The chain test replicates the reference's boot-time bitrot self-test
+(/root/reference/cmd/bitrot.go:214-245) with its golden checksums, which pins
+the keyed hash (magic key, cmd/bitrot.go:37) on whole-packet inputs; the
+streaming/chunking tests cover the remainder path and buffering.
+"""
+
+import hashlib
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import highwayhash as hh
+
+# Golden self-test checksums from cmd/bitrot.go:215-220.
+GOLDEN_CHAIN = {
+    "sha256": "a7677ff19e0182e4d52e3a3db727804abc82a5818749336369552e54b838b004",
+    "blake2b": "e519b7d84b1c3c917985f544773a35cf265dcab10948be3550320d156bab612124a5ae2ae5a8c73c0eea360f68b0e28136f26e858756dbfe7375a7389f26c669",
+    "highwayhash256": "39c0407ed3f01b18d22c85db4aeff11e060ca5f43131b0126731ca197cd42313",
+}
+
+
+def _chain(new_hasher, size: int, block_size: int) -> bytes:
+    msg = b""
+    sum_ = b""
+    for _ in range(0, size * block_size, size):
+        h = new_hasher()
+        h.update(msg)
+        sum_ = h.digest()
+        msg += sum_
+    return sum_
+
+
+def test_chain_sha256():
+    assert _chain(hashlib.sha256, 32, 64).hex() == GOLDEN_CHAIN["sha256"]
+
+
+def test_chain_blake2b():
+    assert (
+        _chain(lambda: hashlib.blake2b(digest_size=64), 64, 128).hex()
+        == GOLDEN_CHAIN["blake2b"]
+    )
+
+
+def test_chain_highwayhash():
+    assert (
+        _chain(hh.HighwayHash256, 32, 32).hex() == GOLDEN_CHAIN["highwayhash256"]
+    )
+
+
+def test_oneshot_matches_streaming():
+    rng = np.random.default_rng(0)
+    for n in [0, 1, 3, 4, 15, 16, 17, 31, 32, 33, 63, 64, 100, 1000, 87382]:
+        data = rng.integers(0, 256, n).astype(np.uint8).tobytes()
+        h = hh.HighwayHash256()
+        h.update(data)
+        assert h.digest() == hh.hash256(data), n
+
+
+def test_streaming_chunked():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 10_000).astype(np.uint8).tobytes()
+    for chunks in [(1,), (7, 13), (32,), (31, 33, 64), (4096,)]:
+        h = hh.HighwayHash256()
+        pos = 0
+        i = 0
+        while pos < len(data):
+            step = chunks[i % len(chunks)]
+            h.update(data[pos : pos + step])
+            pos += step
+            i += 1
+        assert h.digest() == hh.hash256(data), chunks
+
+
+def test_digest_does_not_disturb_stream():
+    h = hh.HighwayHash256()
+    h.update(b"hello")
+    d1 = h.digest()
+    assert h.digest() == d1
+    h.update(b" world")
+    full = hh.hash256(b"hello world")
+    assert h.digest() == full
+
+
+def test_batch_matches_single():
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, (8, 1234)).astype(np.uint8)
+    out = hh.hash256_batch(data)
+    for i in range(8):
+        assert out[i].tobytes() == hh.hash256(data[i].tobytes()), i
+
+
+def test_key_sensitivity():
+    other = bytes(32)
+    assert hh.hash256(b"x") != hh.hash256(b"x", key=other)

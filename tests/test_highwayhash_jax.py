@@ -1,0 +1,24 @@
+"""Device HighwayHash vs numpy oracle, across remainder lengths and batches."""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import highwayhash as hh
+from minio_tpu.ops import highwayhash_jax as hhj
+
+
+@pytest.mark.parametrize("n", [1, 3, 16, 31, 32, 33, 64, 100, 1000, 87382])
+def test_jax_matches_numpy(n):
+    rng = np.random.default_rng(n)
+    data = rng.integers(0, 256, (4, n)).astype(np.uint8)
+    want = hh.hash256_batch(data)
+    got = np.asarray(hhj.hash256_batch(data))
+    assert np.array_equal(want, got)
+
+
+def test_large_batch():
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, (64, 333)).astype(np.uint8)
+    want = hh.hash256_batch(data)
+    got = np.asarray(hhj.hash256_batch(data))
+    assert np.array_equal(want, got)
