@@ -1,0 +1,119 @@
+"""The flagship device program: fused erasure-encode + bitrot-hash pipeline.
+
+One jitted step turns a batch of 1 MiB-block data shards into parity shards
+plus per-shard HighwayHash-256 bitrot digests -- the device-side fusion of the
+reference's per-request hot loop (cmd/erasure-encode.go:73-109 feeding
+cmd/bitrot-streaming.go:43-65), batched across concurrent uploads so the
+host<->device transfer and kernel launches amortize (the BASELINE.json north
+star). The decode/heal steps reuse the same GF(2) matmul with reconstruction
+weights (cmd/erasure-decode.go:206, erasure-lowlevel-heal.go:31 equivalents).
+
+With a mesh, the steps are pjit-sharded: encode runs with bytes sp-sharded
+(pointwise in the byte axis), then the encode->hash boundary reshards streams
+across (tp, sp) -- an all-to-all over ICI, the storage analogue of sequence
+parallelism. See parallel/mesh.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import highwayhash_jax as hhj
+from ..ops import rs, rs_matrix
+from ..parallel import mesh as mesh_lib
+
+
+@dataclass(frozen=True)
+class Geometry:
+    """Erasure geometry: K data + M parity shards over a block size."""
+
+    data: int
+    parity: int
+    block_size: int = 1 << 20  # blockSizeV2, cmd/object-api-common.go:40
+
+    @property
+    def total(self) -> int:
+        return self.data + self.parity
+
+    @property
+    def shard_size(self) -> int:
+        return rs_matrix.shard_size(self.block_size, self.data)
+
+
+class ErasurePipeline:
+    """Batched encode/decode/heal steps for a fixed geometry.
+
+    All steps take shard batches shaped [B, K(+M), S] u8 and are jitted once
+    per (geometry, batch shape). `mesh` enables SPMD sharding over dp/tp/sp.
+    """
+
+    def __init__(self, geometry: Geometry, mesh=None):
+        self.geom = geometry
+        self.mesh = mesh
+        self.codec = rs.RSCodec(geometry.data, geometry.parity)
+        self._encode_fn = self._build_encode()
+
+    # -- encode ------------------------------------------------------------
+
+    def _build_encode(self):
+        geom = self.geom
+        mesh = self.mesh
+
+        def encode_step(data_shards: jax.Array):
+            """[B, K, S] -> ([B, K+M, S] shards, [B, K+M, 32] digests)."""
+            all_shards = self.codec.encode_all(data_shards)
+            if mesh is not None:
+                all_shards = jax.lax.with_sharding_constraint(
+                    all_shards, mesh_lib.stream_sharding(mesh)
+                )
+            b, t, s = all_shards.shape
+            digests = hhj.hash256_batch(all_shards.reshape(b * t, s)).reshape(b, t, 32)
+            return all_shards, digests
+
+        if mesh is None:
+            return jax.jit(encode_step)
+        return jax.jit(
+            encode_step,
+            in_shardings=mesh_lib.data_sharding(mesh),
+            out_shardings=(
+                mesh_lib.shard_output_sharding(mesh),
+                mesh_lib.digest_sharding(mesh),
+            ),
+        )
+
+    def encode(self, data_shards) -> tuple[jax.Array, jax.Array]:
+        return self._encode_fn(data_shards)
+
+    # -- decode / heal -----------------------------------------------------
+
+    @functools.lru_cache(maxsize=256)
+    def _recon_weights(self, present: tuple[bool, ...], want: tuple[int, ...]):
+        return np.asarray(
+            rs_matrix.bit_expand(
+                rs_matrix.reconstruct_rows(self.geom.data, self.geom.parity, present, want)
+            ).astype(np.int8)
+        )
+
+    def reconstruct(self, survivors, present: tuple[bool, ...], want: tuple[int, ...]):
+        """[B, K, S] survivor shards (first K present rows, index order) ->
+        [B, len(want), S] rebuilt shards + their digests."""
+        w = jnp.asarray(self._recon_weights(present, want))
+        return _reconstruct_step(survivors, w)
+
+    def verify_digests(self, shards) -> jax.Array:
+        """[B, T, S] shards -> [B, T, 32] digests (for bitrot deep-scan)."""
+        b, t, s = shards.shape
+        return hhj.hash256_batch(shards.reshape(b * t, s)).reshape(b, t, 32)
+
+
+@jax.jit
+def _reconstruct_step(survivors: jax.Array, w_bits: jax.Array):
+    rebuilt = rs.gf_matmul(survivors, w_bits)
+    b, r, s = rebuilt.shape
+    digests = hhj.hash256_batch(rebuilt.reshape(b * r, s)).reshape(b, r, 32)
+    return rebuilt, digests

@@ -175,7 +175,7 @@ def _lane(pairs, i):
     return lo[..., i], hi[..., i]
 
 
-def _init_state(key: bytes, batch: int) -> _VState:
+def _init_state(key: bytes, lead: tuple[int, ...]) -> _VState:
     key_lanes = np.frombuffer(key, dtype="<u8")
     rot = (key_lanes >> np.uint64(32)) | (key_lanes << np.uint64(32))
     v0_np = _INIT0 ^ key_lanes
@@ -185,8 +185,8 @@ def _init_state(key: bytes, batch: int) -> _VState:
         lo = (arr64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)
         hi = (arr64 >> np.uint64(32)).astype(np.uint32)
         return (
-            jnp.broadcast_to(jnp.asarray(lo), (batch, 4)),
-            jnp.broadcast_to(jnp.asarray(hi), (batch, 4)),
+            jnp.broadcast_to(jnp.asarray(lo), (*lead, 4)),
+            jnp.broadcast_to(jnp.asarray(hi), (*lead, 4)),
         )
 
     return _VState(pair(v0_np), pair(v1_np), pair(_INIT0.copy()), pair(_INIT1.copy()))
@@ -199,16 +199,16 @@ def _lanes_from_words(words):
 
 @functools.partial(jax.jit, static_argnames=("length", "key"))
 def _hh256_impl(data: jax.Array, length: int, key: bytes) -> jax.Array:
-    b = data.shape[0]
-    st = _init_state(key, b)
+    lead = data.shape[:-1]
+    st = _init_state(key, lead)
     n_full = length // 32
     r = length - n_full * 32
 
     if n_full:
         words = jax.lax.bitcast_convert_type(
-            data[:, : n_full * 32].reshape(b, n_full, 8, 4), jnp.uint32
-        )  # [B, n_full, 8]  (little-endian u32 words)
-        xs = jnp.moveaxis(words, 1, 0)  # [n_full, B, 8]
+            data[..., : n_full * 32].reshape(*lead, n_full, 8, 4), jnp.uint32
+        )  # [..., n_full, 8]  (little-endian u32 words)
+        xs = jnp.moveaxis(words, -2, 0)  # [n_full, ..., 8]
 
         def step(carry, w):
             stc = _VState.unflat(carry)
@@ -220,21 +220,24 @@ def _hh256_impl(data: jax.Array, length: int, key: bytes) -> jax.Array:
 
     if r:
         inc = ((np.uint32(r)), (np.uint32(r)))  # (r<<32) + r as (lo, hi)
-        st.v0 = _add(st.v0, (jnp.full((b, 4), inc[0], U32), jnp.full((b, 4), inc[1], U32)))
+        st.v0 = _add(
+            st.v0,
+            (jnp.full((*lead, 4), inc[0], U32), jnp.full((*lead, 4), inc[1], U32)),
+        )
         st.v1 = _rotate_32_by(st.v1, r)
-        tail = data[:, n_full * 32 :]
+        tail = data[..., n_full * 32 :]
         mod4 = r & 3
-        packet = jnp.zeros((b, 32), dtype=jnp.uint8)
-        packet = packet.at[:, : r & ~3].set(tail[:, : r & ~3])
+        packet = jnp.zeros((*lead, 32), dtype=jnp.uint8)
+        packet = packet.at[..., : r & ~3].set(tail[..., : r & ~3])
         if r & 16:
             for i in range(4):
-                packet = packet.at[:, 28 + i].set(tail[:, r + i - 4])
+                packet = packet.at[..., 28 + i].set(tail[..., r + i - 4])
         elif mod4:
-            rem = tail[:, r & ~3 :]
-            packet = packet.at[:, 16].set(rem[:, 0])
-            packet = packet.at[:, 17].set(rem[:, mod4 >> 1])
-            packet = packet.at[:, 18].set(rem[:, mod4 - 1])
-        words = jax.lax.bitcast_convert_type(packet.reshape(b, 8, 4), jnp.uint32)
+            rem = tail[..., r & ~3 :]
+            packet = packet.at[..., 16].set(rem[..., 0])
+            packet = packet.at[..., 17].set(rem[..., mod4 >> 1])
+            packet = packet.at[..., 18].set(rem[..., mod4 - 1])
+        words = jax.lax.bitcast_convert_type(packet.reshape(*lead, 8, 4), jnp.uint32)
         st = _update(st, _lanes_from_words(words))
 
     for _ in range(10):
@@ -251,13 +254,13 @@ def _hh256_impl(data: jax.Array, length: int, key: bytes) -> jax.Array:
     # halves = [h0, h1, h2, h3] as u64 pairs; serialize little-endian.
     words = jnp.stack(
         [w for h in halves for w in (h[0], h[1])], axis=-1
-    )  # [B, 8] u32
-    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(b, 32)
+    )  # [..., 8] u32
+    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(*lead, 32)
 
 
 def hash256_batch(data: jax.Array, key: bytes = MAGIC_KEY) -> jax.Array:
-    """HighwayHash-256 of B equal-length streams on device.
+    """HighwayHash-256 of a batch of equal-length streams on device.
 
-    data: [B, L] u8 -> [B, 32] u8 digests.
+    data: [..., L] u8 -> [..., 32] u8 digests (any leading batch shape).
     """
-    return _hh256_impl(data, data.shape[1], key)
+    return _hh256_impl(data, data.shape[-1], key)

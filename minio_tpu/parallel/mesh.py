@@ -1,0 +1,76 @@
+"""Device mesh construction for the storage data plane.
+
+Parallel axes (the TPU mapping of the reference's parallelism inventory,
+SURVEY.md section 2.4):
+  * dp -- across block batches (independent uploads / heal scans), the
+    analogue of object-level parallelism across erasure sets;
+  * tp -- across shard streams (the reference writes K+M shards concurrently,
+    cmd/erasure-encode.go:29-70: `parallelWriter`); bitrot hashing shards
+    this axis;
+  * sp -- across shard byte ranges (sequence/long-object parallelism): the
+    erasure matmul is pointwise in the byte axis so it runs sp-sharded with
+    no collectives, and the encode->hash boundary is an all-to-all reshard
+    (sp <-> tp), the storage equivalent of sequence-parallel attention
+    re-gathering.
+
+Multi-host: the same mesh spans hosts via jax.distributed; ICI carries the
+sp/tp all-to-alls, DCN only carries control traffic (dist/ package).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "tp", "sp")
+
+
+def factor_mesh(n: int) -> tuple[int, int, int]:
+    """Split n devices into (dp, tp, sp), preferring dp >= tp >= sp."""
+    best = (n, 1, 1)
+    best_score = None
+    for dp in range(1, n + 1):
+        if n % dp:
+            continue
+        rest = n // dp
+        for tp in range(1, rest + 1):
+            if rest % tp:
+                continue
+            sp = rest // tp
+            # Prefer balanced meshes with dp the largest axis.
+            score = (abs(math.log(max(dp, 1) / max(tp, 1))) + abs(math.log(max(tp, 1) / max(sp, 1))),)
+            if dp >= tp >= sp and (best_score is None or score < best_score):
+                best, best_score = (dp, tp, sp), score
+    return best
+
+
+def make_mesh(n_devices: int | None = None, shape: tuple[int, int, int] | None = None) -> Mesh:
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    if shape is None:
+        shape = factor_mesh(n)
+    assert shape[0] * shape[1] * shape[2] == n, (shape, n)
+    dev_array = np.array(devices[:n]).reshape(shape)
+    return Mesh(dev_array, AXES)
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, K, S] input blocks: batch over dp, bytes over sp."""
+    return NamedSharding(mesh, P("dp", None, "sp"))
+
+
+def stream_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, nshards, S] hash streams: batch over dp, shard streams over tp+sp."""
+    return NamedSharding(mesh, P("dp", ("tp", "sp"), None))
+
+
+def digest_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P("dp", ("tp", "sp"), None))
+
+
+def shard_output_sharding(mesh: Mesh) -> NamedSharding:
+    """[B, K+M, S] encoded shards leaving the device: match data layout."""
+    return NamedSharding(mesh, P("dp", None, "sp"))

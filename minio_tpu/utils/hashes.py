@@ -1,0 +1,90 @@
+"""Deterministic placement hashes: SipHash-2-4, crc32 helpers.
+
+Used for object -> erasure-set distribution and per-object drive rotation,
+matching the reference's semantics bit-for-bit so placement is stable:
+  * sip_hash_mod: cmd/erasure-sets.go:747-780 (dchest/siphash Hash(k0,k1,key))
+  * crc_hash_mod + hash_order: cmd/erasure-metadata-utils.go:107,
+    crc32 IEEE of the object name.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+_MASK = 0xFFFFFFFFFFFFFFFF
+
+
+def _rotl(x: int, b: int) -> int:
+    return ((x << b) | (x >> (64 - b))) & _MASK
+
+
+def siphash24(k0: int, k1: int, data: bytes) -> int:
+    """SipHash-2-4 with 64-bit output (dchest/siphash.Hash semantics)."""
+    v0 = k0 ^ 0x736F6D6570736575
+    v1 = k1 ^ 0x646F72616E646F6D
+    v2 = k0 ^ 0x6C7967656E657261
+    v3 = k1 ^ 0x7465646279746573
+
+    def sipround(v0, v1, v2, v3):
+        v0 = (v0 + v1) & _MASK
+        v1 = _rotl(v1, 13)
+        v1 ^= v0
+        v0 = _rotl(v0, 32)
+        v2 = (v2 + v3) & _MASK
+        v3 = _rotl(v3, 16)
+        v3 ^= v2
+        v0 = (v0 + v3) & _MASK
+        v3 = _rotl(v3, 21)
+        v3 ^= v0
+        v2 = (v2 + v1) & _MASK
+        v1 = _rotl(v1, 17)
+        v1 ^= v2
+        v2 = _rotl(v2, 32)
+        return v0, v1, v2, v3
+
+    n = len(data)
+    end = n - (n % 8)
+    for i in range(0, end, 8):
+        m = int.from_bytes(data[i : i + 8], "little")
+        v3 ^= m
+        v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+        v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+        v0 ^= m
+    # Final block: remaining bytes + length in the top byte.
+    b = (n & 0xFF) << 56
+    tail = data[end:]
+    for i, ch in enumerate(tail):
+        b |= ch << (8 * i)
+    v3 ^= b
+    v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+    v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+    v0 ^= b
+    v2 ^= 0xFF
+    for _ in range(4):
+        v0, v1, v2, v3 = sipround(v0, v1, v2, v3)
+    return (v0 ^ v1 ^ v2 ^ v3) & _MASK
+
+
+def sip_hash_mod(key: str, cardinality: int, deployment_id: bytes) -> int:
+    """Object name -> set index (cmd/erasure-sets.go:747)."""
+    if cardinality <= 0:
+        return -1
+    k0 = int.from_bytes(deployment_id[0:8], "little")
+    k1 = int.from_bytes(deployment_id[8:16], "little")
+    return siphash24(k0, k1, key.encode()) % cardinality
+
+
+def crc_hash_mod(key: str, cardinality: int) -> int:
+    if cardinality <= 0:
+        return -1
+    return (zlib.crc32(key.encode()) & 0xFFFFFFFF) % cardinality
+
+
+def hash_order(key: str, cardinality: int) -> list[int]:
+    """Consistent 1-based drive order for an object
+    (cmd/erasure-metadata-utils.go:107)."""
+    if cardinality <= 0:
+        return []
+    key_crc = zlib.crc32(key.encode()) & 0xFFFFFFFF
+    start = key_crc % cardinality
+    return [1 + ((start + i) % cardinality) for i in range(1, cardinality + 1)]

@@ -1,15 +1,33 @@
-"""Test configuration: force an 8-device virtual CPU mesh before jax imports.
+"""Test configuration: force an 8-device virtual CPU mesh before jax is used.
 
 Benches run on the real TPU chip; tests exercise the same code on a virtual
 multi-device CPU platform so sharding/collective paths are covered without
 hardware (mirrors the reference's in-process multi-disk harness philosophy,
 /root/reference/cmd/test-utils_test.go:199).
+
+The environment may pre-register a hardware TPU backend (tunnel plugin) via
+sitecustomize before this file runs, and its client init both bypasses
+JAX_PLATFORMS and can block on the tunnel. Tests must never touch it, so we
+both repoint jax's platform config at cpu and drop the plugin's backend
+factory before any backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache: the suite re-jits the same kernels every run.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+try:
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:  # pragma: no cover - jax internals moved; cpu config still set
+    pass

@@ -7,7 +7,7 @@ from minio_tpu.ops import highwayhash as hh
 from minio_tpu.ops import highwayhash_jax as hhj
 
 
-@pytest.mark.parametrize("n", [1, 3, 16, 31, 32, 33, 64, 100, 1000, 87382])
+@pytest.mark.parametrize("n", [1, 3, 16, 31, 32, 33, 64, 100, 1000])
 def test_jax_matches_numpy(n):
     rng = np.random.default_rng(n)
     data = rng.integers(0, 256, (4, n)).astype(np.uint8)
