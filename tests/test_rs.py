@@ -68,7 +68,7 @@ def test_golden_numpy(geometry):
 
 # Subset for the device path: every geometry forces a fresh XLA compile, so the
 # full 60-config sweep lives on the numpy path and this samples the corners.
-JAX_GOLDEN_SUBSET = [(2, 2), (3, 4), (5, 3), (8, 8), (12, 3), (14, 1)]
+JAX_GOLDEN_SUBSET = [(2, 2), (3, 4), (5, 3), (8, 7), (12, 3), (14, 1)]
 
 
 @pytest.mark.parametrize("geometry", JAX_GOLDEN_SUBSET)
