@@ -1,0 +1,125 @@
+"""Drive format file -- cluster topology consensus record.
+
+Role of the reference's format.json v3 (cmd/format-erasure.go:139
+newFormatErasureV3): every drive carries
+    {deployment id, its own drive id, the full sets layout, distribution algo}
+so any quorum of drives can reconstruct the topology, misplaced drives are
+detected, and replaced drives are recognized as unformatted.
+
+Stored as JSON at <drive>/.minio_tpu.sys/format.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import uuid
+from dataclasses import dataclass, field
+
+from ..utils import errors
+
+SYS_DIR = ".minio_tpu.sys"
+FORMAT_FILE = "format.json"
+
+DISTRIBUTION_ALGO_V3 = "SIPMOD+PARITY"  # sipHashMod placement (the modern algo)
+
+
+@dataclass
+class DriveFormat:
+    deployment_id: str
+    this_id: str
+    sets: list[list[str]]  # set -> ordered drive uuids
+    distribution_algo: str = DISTRIBUTION_ALGO_V3
+    version: int = 1
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "version": self.version,
+                "format": "erasure",
+                "id": self.deployment_id,
+                "erasure": {
+                    "this": self.this_id,
+                    "sets": self.sets,
+                    "distributionAlgo": self.distribution_algo,
+                },
+            },
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "DriveFormat":
+        d = json.loads(raw)
+        e = d["erasure"]
+        return cls(
+            deployment_id=d["id"],
+            this_id=e["this"],
+            sets=e["sets"],
+            distribution_algo=e.get("distributionAlgo", DISTRIBUTION_ALGO_V3),
+            version=d.get("version", 1),
+        )
+
+    # -- per-drive persistence ----------------------------------------------
+
+    @staticmethod
+    def path(drive_root: str) -> str:
+        return os.path.join(drive_root, SYS_DIR, FORMAT_FILE)
+
+    def save(self, drive_root: str) -> None:
+        p = self.path(drive_root)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_json())
+        os.replace(tmp, p)
+
+    @classmethod
+    def load(cls, drive_root: str) -> "DriveFormat | None":
+        try:
+            with open(cls.path(drive_root)) as f:
+                return cls.from_json(f.read())
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, KeyError) as e:
+            raise errors.FileCorrupt(f"bad format.json: {e}")
+
+    def find_disk(self, disk_id: str) -> tuple[int, int]:
+        for s, drive_ids in enumerate(self.sets):
+            for d, did in enumerate(drive_ids):
+                if did == disk_id:
+                    return s, d
+        raise errors.DiskIDMismatch(f"disk {disk_id} not in format")
+
+
+def init_format(
+    n_sets: int, set_drive_count: int, deployment_id: str | None = None
+) -> list[DriveFormat]:
+    """Fresh formats for n_sets x set_drive_count drives
+    (initFormatErasure equivalent, cmd/format-erasure.go:818)."""
+    dep = deployment_id or str(uuid.uuid4())
+    sets = [[str(uuid.uuid4()) for _ in range(set_drive_count)] for _ in range(n_sets)]
+    out = []
+    for s in range(n_sets):
+        for d in range(set_drive_count):
+            out.append(DriveFormat(deployment_id=dep, this_id=sets[s][d], sets=sets))
+    return out
+
+
+def quorum_format(formats: list[DriveFormat | None]) -> DriveFormat:
+    """Pick the format agreed by a majority of drives
+    (getFormatErasureInQuorum, cmd/format-erasure.go:583)."""
+    counts: dict[str, int] = {}
+    rep: dict[str, DriveFormat] = {}
+    for f in formats:
+        if f is None:
+            continue
+        key = f.deployment_id + ":" + json.dumps(f.sets, sort_keys=True)
+        counts[key] = counts.get(key, 0) + 1
+        rep[key] = f
+    if not counts:
+        raise errors.UnformattedDisk("no formatted drives")
+    key = max(counts, key=lambda k: counts[k])
+    n_drives = sum(len(s) for s in rep[key].sets)
+    if counts[key] <= n_drives // 2:
+        raise errors.ErasureReadQuorum(msg="format.json quorum not reached")
+    return rep[key]

@@ -1,0 +1,113 @@
+"""StorageAPI -- the per-drive contract every backend implements.
+
+Role of the reference's StorageAPI interface (cmd/storage-interface.go:27-87):
+the seam that makes drives interchangeable -- a local directory (LocalDrive),
+a remote drive over the storage REST protocol (dist/storage_rest.py client),
+or an injected faulty drive in tests. The object layer only ever talks to
+this interface.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Iterator
+
+from .types import DiskInfo, FileInfo, VolInfo
+from .xlmeta import XLMeta
+
+
+class StorageAPI(abc.ABC):
+    # identity / health
+    @abc.abstractmethod
+    def endpoint(self) -> str: ...
+
+    @abc.abstractmethod
+    def is_online(self) -> bool: ...
+
+    @abc.abstractmethod
+    def is_local(self) -> bool: ...
+
+    @abc.abstractmethod
+    def disk_id(self) -> str: ...
+
+    @abc.abstractmethod
+    def set_disk_id(self, disk_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def disk_info(self) -> DiskInfo: ...
+
+    # volumes
+    @abc.abstractmethod
+    def make_vol(self, volume: str) -> None: ...
+
+    @abc.abstractmethod
+    def stat_vol(self, volume: str) -> VolInfo: ...
+
+    @abc.abstractmethod
+    def list_vols(self) -> list[VolInfo]: ...
+
+    @abc.abstractmethod
+    def delete_vol(self, volume: str, force: bool = False) -> None: ...
+
+    # whole small files
+    @abc.abstractmethod
+    def write_all(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def read_all(self, volume: str, path: str) -> bytes: ...
+
+    @abc.abstractmethod
+    def delete(self, volume: str, path: str, recursive: bool = False) -> None: ...
+
+    # shard files
+    @abc.abstractmethod
+    def create_file(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def append_file(self, volume: str, path: str, data: bytes) -> None: ...
+
+    @abc.abstractmethod
+    def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes: ...
+
+    @abc.abstractmethod
+    def stat_file(self, volume: str, path: str) -> int: ...
+
+    # object metadata
+    @abc.abstractmethod
+    def read_xl(self, volume: str, path: str) -> XLMeta: ...
+
+    @abc.abstractmethod
+    def read_version(self, volume: str, path: str, version_id: str = "") -> FileInfo: ...
+
+    @abc.abstractmethod
+    def write_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    @abc.abstractmethod
+    def delete_version(self, volume: str, path: str, fi: FileInfo) -> None: ...
+
+    # commit / rename
+    @abc.abstractmethod
+    def rename_data(
+        self, src_volume: str, src_path: str, fi: FileInfo, dst_volume: str, dst_path: str
+    ) -> None: ...
+
+    @abc.abstractmethod
+    def rename_file(
+        self, src_volume: str, src_path: str, dst_volume: str, dst_path: str
+    ) -> None: ...
+
+    # listing
+    @abc.abstractmethod
+    def list_dir(self, volume: str, path: str) -> list[str]: ...
+
+    @abc.abstractmethod
+    def walk_dir(
+        self, volume: str, base: str = "", recursive: bool = True
+    ) -> Iterator[tuple[str, bytes]]: ...
+
+    # integrity
+    @abc.abstractmethod
+    def verify_file(self, volume: str, path: str, fi: FileInfo) -> None: ...

@@ -1,0 +1,186 @@
+"""Wire/storage datatypes shared across the drive and object layers.
+
+The role of cmd/storage-datatypes.go (FileInfo/DiskInfo/VolInfo msgp structs):
+plain dataclasses with msgpack-dict codecs. These cross the storage REST wire
+(dist/storage_rest.py) and land in xl.meta (storage/xlmeta.py), so every field
+has a stable short key.
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ErasureInfo:
+    """Erasure geometry + placement for one object version on one drive.
+
+    Mirrors the reference's ErasureInfo (cmd/storage-datatypes.go): the
+    distribution is the 1-based drive order from hash_order, and `index` is
+    this drive's position in it.
+    """
+
+    algorithm: str = "reedsolomon-vandermonde"
+    data_blocks: int = 0
+    parity_blocks: int = 0
+    block_size: int = 1 << 20
+    index: int = 0  # 1-based shard index held by this drive
+    distribution: list[int] = field(default_factory=list)
+    checksums: list[dict] = field(default_factory=list)  # whole-bitrot only
+
+    def shard_size(self) -> int:
+        return -(-self.block_size // self.data_blocks)
+
+    def shard_file_size(self, total_length: int) -> int:
+        """Final erasure shard size for an object of total_length bytes
+        (cmd/erasure-coding.go:127-138 formula)."""
+        if total_length == 0:
+            return 0
+        if total_length < 0:
+            return -1
+        num_blocks = total_length // self.block_size
+        last = total_length % self.block_size
+        last_shard = -(-last // self.data_blocks) if last else 0
+        return num_blocks * self.shard_size() + last_shard
+
+    def to_dict(self) -> dict:
+        return {
+            "al": self.algorithm,
+            "d": self.data_blocks,
+            "p": self.parity_blocks,
+            "bs": self.block_size,
+            "ix": self.index,
+            "ds": self.distribution,
+            "cs": self.checksums,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ErasureInfo":
+        return cls(
+            algorithm=d.get("al", "reedsolomon-vandermonde"),
+            data_blocks=d.get("d", 0),
+            parity_blocks=d.get("p", 0),
+            block_size=d.get("bs", 1 << 20),
+            index=d.get("ix", 0),
+            distribution=list(d.get("ds", [])),
+            checksums=list(d.get("cs", [])),
+        )
+
+
+@dataclass
+class ObjectPartInfo:
+    number: int
+    size: int
+    actual_size: int = -1  # pre-compression size; -1 = same as size
+    mod_time: float = 0.0
+    etag: str = ""
+
+    def to_dict(self) -> dict:
+        return {"n": self.number, "s": self.size, "as": self.actual_size,
+                "mt": self.mod_time, "e": self.etag}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ObjectPartInfo":
+        return cls(d["n"], d["s"], d.get("as", -1), d.get("mt", 0.0), d.get("e", ""))
+
+
+@dataclass
+class FileInfo:
+    """Everything known about one object version on one drive
+    (cmd/storage-datatypes.go FileInfo equivalent)."""
+
+    volume: str = ""
+    name: str = ""
+    version_id: str = ""  # "" = null version
+    is_latest: bool = True
+    deleted: bool = False  # delete marker
+    data_dir: str = ""  # uuid dir holding part files; "" when inline
+    mod_time: float = 0.0
+    size: int = 0
+    metadata: dict[str, str] = field(default_factory=dict)
+    parts: list[ObjectPartInfo] = field(default_factory=list)
+    erasure: ErasureInfo = field(default_factory=ErasureInfo)
+    inline_data: bytes = b""  # small-object data embedded in xl.meta
+    fresh: bool = False  # first write of this object
+    num_versions: int = 0
+    successor_mod_time: float = 0.0
+
+    @property
+    def etag(self) -> str:
+        return self.metadata.get("etag", "")
+
+    def write_quorum(self, default_parity: int) -> int:
+        """data (+1 if data == parity) -- cmd/erasure-object.go:810-813."""
+        d = self.erasure.data_blocks
+        p = self.erasure.parity_blocks or default_parity
+        return d + 1 if d == p else d
+
+    def to_dict(self, with_inline: bool = True) -> dict:
+        d = {
+            "v": self.volume,
+            "n": self.name,
+            "vid": self.version_id,
+            "del": self.deleted,
+            "dd": self.data_dir,
+            "mt": self.mod_time,
+            "sz": self.size,
+            "meta": self.metadata,
+            "parts": [p.to_dict() for p in self.parts],
+            "ei": self.erasure.to_dict(),
+        }
+        if with_inline and self.inline_data:
+            d["inl"] = self.inline_data
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FileInfo":
+        return cls(
+            volume=d.get("v", ""),
+            name=d.get("n", ""),
+            version_id=d.get("vid", ""),
+            deleted=d.get("del", False),
+            data_dir=d.get("dd", ""),
+            mod_time=d.get("mt", 0.0),
+            size=d.get("sz", 0),
+            metadata=dict(d.get("meta", {})),
+            parts=[ObjectPartInfo.from_dict(p) for p in d.get("parts", [])],
+            erasure=ErasureInfo.from_dict(d.get("ei", {})),
+            inline_data=d.get("inl", b""),
+        )
+
+
+@dataclass
+class VolInfo:
+    name: str
+    created: float = 0.0
+
+
+@dataclass
+class DiskInfo:
+    total: int = 0
+    free: int = 0
+    used: int = 0
+    fs_type: str = ""
+    root_disk: bool = False
+    healing: bool = False
+    endpoint: str = ""
+    mount_path: str = ""
+    disk_id: str = ""
+    error: str = ""
+
+    def to_dict(self) -> dict:
+        return self.__dict__.copy()
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "DiskInfo":
+        return cls(**{k: d[k] for k in cls.__dataclass_fields__ if k in d})
+
+
+def new_uuid() -> str:
+    return str(uuid.uuid4())
+
+
+def now() -> float:
+    return time.time()

@@ -1,0 +1,199 @@
+"""Error taxonomy for the storage stack.
+
+The reference threads typed sentinel errors through every layer (cmd/
+storage-errors.go, object-api-errors.go); quorum logic counts them by
+identity. Here they are exception classes with the same roles: drive-level
+errors (DiskError subclasses) are counted toward read/write quorums, and
+object-level errors map 1:1 onto S3 API error codes in api/errors.py.
+"""
+
+from __future__ import annotations
+
+
+class StorageError(Exception):
+    """Base for all storage-stack errors."""
+
+
+# ---------------------------------------------------------------------------
+# Drive-level (per-disk) errors -- the quorum-countable set.
+# ---------------------------------------------------------------------------
+
+
+class DiskError(StorageError):
+    pass
+
+
+class DiskNotFound(DiskError):
+    """Drive offline / unreachable (errDiskNotFound)."""
+
+
+class UnformattedDisk(DiskError):
+    """Drive has no format file yet (errUnformattedDisk)."""
+
+
+class DiskAccessDenied(DiskError):
+    pass
+
+
+class FaultyDisk(DiskError):
+    pass
+
+
+class DiskFull(DiskError):
+    pass
+
+
+class VolumeNotFound(DiskError):
+    """Bucket directory missing on this drive (errVolumeNotFound)."""
+
+
+class VolumeExists(DiskError):
+    pass
+
+
+class VolumeNotEmpty(DiskError):
+    pass
+
+
+class FileNotFound(DiskError):
+    """Object/shard file missing on this drive (errFileNotFound)."""
+
+
+class FileVersionNotFound(DiskError):
+    pass
+
+
+class FileCorrupt(DiskError):
+    """Bitrot or metadata parse failure (errFileCorrupt)."""
+
+
+class FileAccessDenied(DiskError):
+    pass
+
+
+class IsNotRegular(DiskError):
+    """Path exists but is not a regular file (errIsNotRegular)."""
+
+
+class PathNotEmpty(DiskError):
+    pass
+
+
+class DiskIDMismatch(DiskError):
+    """Drive answered with the wrong identity (errDiskNotFound analogue for
+    the disk-id check wrapper, cmd/xl-storage-disk-id-check.go:68)."""
+
+
+# ---------------------------------------------------------------------------
+# Object-layer errors (cmd/object-api-errors.go equivalents).
+# ---------------------------------------------------------------------------
+
+
+class ObjectError(StorageError):
+    def __init__(self, bucket: str = "", object: str = "", msg: str = ""):
+        self.bucket = bucket
+        self.object = object
+        super().__init__(msg or f"{type(self).__name__}: {bucket}/{object}")
+
+
+class BucketNotFound(ObjectError):
+    pass
+
+
+class BucketExists(ObjectError):
+    pass
+
+
+class BucketNotEmpty(ObjectError):
+    pass
+
+
+class ObjectNotFound(ObjectError):
+    pass
+
+
+class VersionNotFound(ObjectError):
+    pass
+
+
+class MethodNotAllowed(ObjectError):
+    """E.g. GET on a delete marker."""
+
+
+class InvalidArgument(ObjectError):
+    pass
+
+
+class ObjectExistsAsDirectory(ObjectError):
+    pass
+
+
+class InvalidUploadID(ObjectError):
+    pass
+
+
+class InvalidPart(ObjectError):
+    pass
+
+
+class ObjectNameInvalid(ObjectError):
+    pass
+
+
+class BucketNameInvalid(ObjectError):
+    pass
+
+
+class ErasureReadQuorum(ObjectError):
+    """Not enough drives answered consistently for a read
+    (errErasureReadQuorum)."""
+
+
+class ErasureWriteQuorum(ObjectError):
+    """Write could not reach quorum (errErasureWriteQuorum)."""
+
+
+class PreconditionFailed(ObjectError):
+    pass
+
+
+class InsufficientReadQuorum(ErasureReadQuorum):
+    pass
+
+
+class InsufficientWriteQuorum(ErasureWriteQuorum):
+    pass
+
+
+def reduce_errs(errs: list[Exception | None], ignored: tuple[type, ...] = ()) -> tuple[int, Exception | None]:
+    """Count the most common error identity (None = success counts too).
+
+    The quorum reducer (cmd/erasure-metadata-utils.go reduceErrs
+    equivalent): returns (max_count, representative_error).
+    """
+    counts: dict[str, int] = {}
+    rep: dict[str, Exception | None] = {}
+    for e in errs:
+        if e is not None and ignored and isinstance(e, ignored):
+            continue
+        key = type(e).__name__ if e is not None else "__ok__"
+        counts[key] = counts.get(key, 0) + 1
+        rep[key] = e
+    if not counts:
+        return 0, None
+    key = max(counts, key=lambda k: counts[k])
+    return counts[key], rep[key]
+
+
+def reduce_quorum_errs(
+    errs: list[Exception | None],
+    quorum: int,
+    quorum_err: Exception,
+    ignored: tuple[type, ...] = (),
+) -> Exception | None:
+    """None if the dominant outcome reaches quorum and is success; the
+    dominant error if it reaches quorum; otherwise quorum_err."""
+    count, err = reduce_errs(errs, ignored)
+    if count >= quorum:
+        return err
+    return quorum_err

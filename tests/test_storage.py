@@ -1,0 +1,186 @@
+"""Local drive + xl.meta + format tests (xl-storage_test.go analogues)."""
+
+import os
+
+import pytest
+
+from minio_tpu.storage import format as fmt
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.storage.types import ErasureInfo, FileInfo, ObjectPartInfo
+from minio_tpu.storage.xlmeta import XLMeta
+from minio_tpu.utils import errors
+
+
+@pytest.fixture
+def drive(tmp_path):
+    return LocalDrive(str(tmp_path / "disk0"))
+
+
+def _fi(version_id="", name="obj", inline=b"", data_dir="", mod_time=1.0):
+    return FileInfo(
+        volume="bucket",
+        name=name,
+        version_id=version_id,
+        data_dir=data_dir,
+        mod_time=mod_time,
+        size=len(inline),
+        metadata={"etag": "abc"},
+        parts=[ObjectPartInfo(1, len(inline))],
+        erasure=ErasureInfo(data_blocks=2, parity_blocks=1, index=1, distribution=[1, 2, 3]),
+        inline_data=inline,
+    )
+
+
+class TestXLMeta:
+    def test_roundtrip_with_inline(self):
+        m = XLMeta()
+        m.add_version(_fi("v1", inline=b"hello", mod_time=1.0))
+        m.add_version(_fi("v2", inline=b"world!", mod_time=2.0))
+        raw = m.to_bytes()
+        m2 = XLMeta.from_bytes(raw)
+        assert [v.version_id for v in m2.versions] == ["v2", "v1"]
+        assert m2.find_version("v1").inline_data == b"hello"
+        assert m2.find_version("v2").inline_data == b"world!"
+        assert m2.latest().version_id == "v2"
+
+    def test_checksum_detects_corruption(self):
+        m = XLMeta()
+        m.add_version(_fi("v1", inline=b"data"))
+        raw = bytearray(m.to_bytes())
+        raw[12] ^= 0xFF
+        with pytest.raises(errors.FileCorrupt):
+            XLMeta.from_bytes(bytes(raw))
+
+    def test_delete_version(self):
+        m = XLMeta()
+        m.add_version(_fi("v1", mod_time=1.0))
+        m.add_version(_fi("v2", mod_time=2.0))
+        m.delete_version("v2")
+        assert m.latest().version_id == "v1"
+        with pytest.raises(errors.FileVersionNotFound):
+            m.delete_version("nope")
+
+    def test_replace_same_version(self):
+        m = XLMeta()
+        m.add_version(_fi("v1", inline=b"a", mod_time=1.0))
+        m.add_version(_fi("v1", inline=b"bb", mod_time=2.0))
+        assert len(m.versions) == 1
+        assert m.latest().inline_data == b"bb"
+
+
+class TestLocalDrive:
+    def test_volumes(self, drive):
+        drive.make_vol("bucket")
+        with pytest.raises(errors.VolumeExists):
+            drive.make_vol("bucket")
+        assert [v.name for v in drive.list_vols()] == ["bucket"]
+        drive.delete_vol("bucket")
+        with pytest.raises(errors.VolumeNotFound):
+            drive.stat_vol("bucket")
+
+    def test_write_read_all(self, drive):
+        drive.make_vol("b")
+        drive.write_all("b", "cfg/x.json", b"{}")
+        assert drive.read_all("b", "cfg/x.json") == b"{}"
+        with pytest.raises(errors.FileNotFound):
+            drive.read_all("b", "missing")
+        with pytest.raises(errors.VolumeNotFound):
+            drive.read_all("nope", "missing")
+
+    def test_path_escape_blocked(self, drive):
+        drive.make_vol("b")
+        with pytest.raises(errors.StorageError):
+            drive.read_all("b", "../../../etc/passwd")
+
+    def test_metadata_versions(self, drive):
+        drive.make_vol("bucket")
+        drive.write_metadata("bucket", "a/obj", _fi("v1", inline=b"xx", mod_time=1.0))
+        drive.write_metadata("bucket", "a/obj", _fi("v2", inline=b"yy", mod_time=2.0))
+        fi = drive.read_version("bucket", "a/obj")
+        assert fi.version_id == "v2"
+        assert fi.is_latest
+        fi1 = drive.read_version("bucket", "a/obj", "v1")
+        assert not fi1.is_latest
+        assert fi1.inline_data == b"xx"
+
+    def test_rename_data_commit(self, drive):
+        drive.make_vol("bucket")
+        # Stage shard files in tmp, then commit.
+        tmp = ".minio_tpu.sys/tmp"
+        drive.create_file("bucket", f"{tmp}/upload1/part.1", b"shard-bytes")
+        fi = _fi("v1", data_dir="datadir-uuid")
+        drive.rename_data("bucket", f"{tmp}/upload1", fi, "bucket", "obj")
+        assert drive.read_file("bucket", "obj/datadir-uuid/part.1") == b"shard-bytes"
+        assert drive.read_version("bucket", "obj").version_id == "v1"
+        # Staged dir is gone.
+        with pytest.raises(errors.FileNotFound):
+            drive.read_file("bucket", f"{tmp}/upload1/part.1")
+
+    def test_delete_version_flow(self, drive):
+        drive.make_vol("bucket")
+        drive.create_file("bucket", ".minio_tpu.sys/tmp/u1/part.1", b"d1")
+        drive.rename_data("bucket", ".minio_tpu.sys/tmp/u1", _fi("v1", data_dir="dd1"), "bucket", "obj")
+        drive.delete_version("bucket", "obj", _fi("v1", data_dir="dd1"))
+        with pytest.raises(errors.FileNotFound):
+            drive.read_xl("bucket", "obj")
+        # Data dir removed and object dir pruned.
+        assert not os.path.exists(os.path.join(drive.root, "bucket", "obj"))
+
+    def test_delete_marker(self, drive):
+        drive.make_vol("bucket")
+        drive.write_metadata("bucket", "obj", _fi("v1", inline=b"x", mod_time=1.0))
+        dm = _fi("v2", mod_time=2.0)
+        dm.deleted = True
+        drive.delete_version("bucket", "obj", dm)
+        meta = drive.read_xl("bucket", "obj")
+        assert meta.latest().deleted
+        assert len(meta.versions) == 2
+
+    def test_walk_dir(self, drive):
+        drive.make_vol("bucket")
+        for name in ["a/1", "a/2", "b/x/deep", "top"]:
+            drive.write_metadata("bucket", name, _fi("v1", inline=b"d"))
+        entries = [path for path, _ in drive.walk_dir("bucket")]
+        assert entries == ["a/1", "a/2", "b/x/deep", "top"]
+        shallow = [path for path, _ in drive.walk_dir("bucket", recursive=False)]
+        assert shallow == ["a/", "b/", "top"]
+
+    def test_list_dir(self, drive):
+        drive.make_vol("bucket")
+        drive.write_all("bucket", "d/f1", b"1")
+        drive.write_all("bucket", "f2", b"2")
+        assert drive.list_dir("bucket", "") == ["d/", "f2"]
+
+
+class TestFormat:
+    def test_init_and_quorum(self, tmp_path):
+        formats = fmt.init_format(2, 4)
+        assert len(formats) == 8
+        dep = formats[0].deployment_id
+        assert all(f.deployment_id == dep for f in formats)
+        # Save/load roundtrip.
+        root = str(tmp_path / "d0")
+        os.makedirs(root)
+        formats[0].save(root)
+        loaded = fmt.DriveFormat.load(root)
+        assert loaded.this_id == formats[0].this_id
+        assert loaded.find_disk(loaded.this_id) == (0, 0)
+        # Quorum picks majority layout.
+        q = fmt.quorum_format(list(formats[:5]) + [None] * 3)
+        assert q.deployment_id == dep
+        with pytest.raises(errors.UnformattedDisk):
+            fmt.quorum_format([None, None])
+
+    def test_quorum_not_reached(self):
+        formats = fmt.init_format(1, 4)
+        with pytest.raises(errors.ErasureReadQuorum):
+            fmt.quorum_format(formats[:2] + [None, None])
+
+    def test_disk_id(self, tmp_path):
+        root = str(tmp_path / "d1")
+        drive = LocalDrive(root)
+        assert drive.disk_id() == ""
+        f = fmt.init_format(1, 1)[0]
+        f.save(root)
+        drive2 = LocalDrive(root)
+        assert drive2.disk_id() == f.this_id
