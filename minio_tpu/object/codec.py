@@ -1,0 +1,130 @@
+"""Block codec service: the seam between the object layer and the math.
+
+The object layer hands 1 MiB blocks to a BlockCodec and gets back shard bytes
+plus bitrot digests. Implementations:
+
+  * HostCodec  -- numpy GF tables + numpy HighwayHash; the low-latency
+    fallback (the reference's always-on CPU SIMD analogue).
+  * DeviceCodec -- single-shot JAX encode+hash on the accelerator; right for
+    large objects / heals where one call carries many blocks.
+  * The cross-upload batching scheduler (parallel/batching.py) wraps
+    DeviceCodec to aggregate blocks from concurrent requests into one device
+    program -- the BASELINE.json north-star design.
+
+All implementations produce bit-identical outputs (tests pin this), so the
+object layer can switch freely per call size.
+"""
+
+from __future__ import annotations
+
+import abc
+
+import numpy as np
+
+from ..ops import highwayhash as hh
+from ..ops import rs_matrix, rs_ref
+
+
+class BlockCodec(abc.ABC):
+    """Encode/decode service for erasure blocks."""
+
+    @abc.abstractmethod
+    def encode(
+        self, blocks: list[bytes], k: int, m: int
+    ) -> list[tuple[list[bytes], list[bytes]]]:
+        """For each input block: ([K+M shard chunks], [K+M digests])."""
+
+    @abc.abstractmethod
+    def reconstruct(
+        self, shards: list[bytes | None], k: int, m: int, want: tuple[int, ...]
+    ) -> list[bytes]:
+        """Rebuild the `want` shard rows from available shards (None = lost)."""
+
+
+def _split_block(block: bytes, k: int) -> np.ndarray:
+    return rs_matrix.split(np.frombuffer(block, dtype=np.uint8), k)
+
+
+class HostCodec(BlockCodec):
+    """Pure-host numpy codec (table lookups, vectorized over shard bytes)."""
+
+    def encode(self, blocks, k, m):
+        out = []
+        for block in blocks:
+            shards = rs_ref.encode(_split_block(block, k), m)  # [K+M, S]
+            digests = hh.hash256_batch(shards)
+            out.append(
+                (
+                    [shards[i].tobytes() for i in range(k + m)],
+                    [digests[i].tobytes() for i in range(k + m)],
+                )
+            )
+        return out
+
+    def reconstruct(self, shards, k, m, want):
+        arrs: list[np.ndarray | None] = [
+            np.frombuffer(s, dtype=np.uint8) if s is not None else None for s in shards
+        ]
+        rebuilt = rs_ref.reconstruct(arrs, k, m, data_only=False)
+        return [rebuilt[i].tobytes() for i in want]
+
+
+class DeviceCodec(BlockCodec):
+    """JAX device codec: one fused encode+hash program per call.
+
+    Blocks in one call are padded to the longest shard size and batched into
+    a single [B, K, S] tensor, so a large PutObject or heal already amortizes
+    transfer/launch across its own blocks. Cross-request amortization is the
+    batching scheduler's job (parallel/batching.py).
+    """
+
+    def __init__(self):
+        self._host = HostCodec()
+
+    def encode(self, blocks, k, m):
+        from ..ops import rs as rs_dev
+
+        if not blocks:
+            return []
+        sizes = [rs_matrix.shard_size(len(b), k) for b in blocks]
+        s_max = max(sizes)
+        batch = np.zeros((len(blocks), k, s_max), dtype=np.uint8)
+        for i, block in enumerate(blocks):
+            batch[i, :, : sizes[i]] = _split_block(block, k)
+        codec = rs_dev.RSCodec(k, m)
+        all_shards = np.asarray(codec.encode_all(batch))  # [B, K+M, S]
+        out = []
+        for i in range(len(blocks)):
+            s = sizes[i]
+            shards_i = all_shards[i, :, :s]
+            # Padded-batch digests are only valid when every block shares the
+            # padded length; hash at true length instead (host-vectorized
+            # when lengths are uniform this never triggers; see batching).
+            digests = hh.hash256_batch(np.ascontiguousarray(shards_i))
+            out.append(
+                (
+                    [shards_i[j].tobytes() for j in range(k + m)],
+                    [digests[j].tobytes() for j in range(k + m)],
+                )
+            )
+        return out
+
+    def reconstruct(self, shards, k, m, want):
+        return self._host.reconstruct(shards, k, m, want)
+
+
+_default: BlockCodec | None = None
+
+
+def default_codec() -> BlockCodec:
+    """Process-wide codec. Host for now; the server runtime installs the
+    batching device codec at startup (see parallel/batching.py)."""
+    global _default
+    if _default is None:
+        _default = HostCodec()
+    return _default
+
+
+def set_default_codec(codec: BlockCodec) -> None:
+    global _default
+    _default = codec

@@ -1,0 +1,107 @@
+"""Object-layer public datatypes (ObjectInfo & friends).
+
+Role of the reference's ObjectInfo/ListObjectsInfo/etc in
+cmd/object-api-datatypes.go: what the API layer consumes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..storage.types import FileInfo
+
+
+@dataclass
+class ObjectInfo:
+    bucket: str = ""
+    name: str = ""
+    mod_time: float = 0.0
+    size: int = 0
+    etag: str = ""
+    version_id: str = ""
+    is_latest: bool = True
+    delete_marker: bool = False
+    content_type: str = ""
+    user_defined: dict[str, str] = field(default_factory=dict)
+    parts: list = field(default_factory=list)
+    num_versions: int = 0
+    actual_size: int | None = None
+    storage_class: str = "STANDARD"
+
+    @classmethod
+    def from_file_info(cls, fi: FileInfo, bucket: str, name: str) -> "ObjectInfo":
+        meta = dict(fi.metadata)
+        etag = meta.pop("etag", "")
+        content_type = meta.pop("content-type", "application/octet-stream")
+        user = {k: v for k, v in meta.items() if not k.startswith("x-internal-")}
+        return cls(
+            bucket=bucket,
+            name=name,
+            mod_time=fi.mod_time,
+            size=fi.size,
+            etag=etag,
+            version_id=fi.version_id,
+            is_latest=fi.is_latest,
+            delete_marker=fi.deleted,
+            content_type=content_type,
+            user_defined=user,
+            parts=list(fi.parts),
+            num_versions=fi.num_versions,
+        )
+
+
+@dataclass
+class BucketInfo:
+    name: str
+    created: float = 0.0
+    versioning: bool = False
+
+
+@dataclass
+class ListObjectsInfo:
+    is_truncated: bool = False
+    next_marker: str = ""
+    objects: list[ObjectInfo] = field(default_factory=list)
+    prefixes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class ListObjectVersionsInfo:
+    is_truncated: bool = False
+    next_key_marker: str = ""
+    next_version_marker: str = ""
+    objects: list[ObjectInfo] = field(default_factory=list)
+    prefixes: list[str] = field(default_factory=list)
+
+
+@dataclass
+class PutObjectOptions:
+    user_defined: dict[str, str] = field(default_factory=dict)
+    versioned: bool = False
+    version_id: str = ""
+    content_type: str = "application/octet-stream"
+
+
+@dataclass
+class GetObjectOptions:
+    version_id: str = ""
+
+
+@dataclass
+class DeleteObjectOptions:
+    version_id: str = ""
+    versioned: bool = False
+
+
+@dataclass
+class HealResultItem:
+    """Outcome of healing one object (madmin.HealResultItem analogue)."""
+
+    bucket: str = ""
+    object: str = ""
+    version_id: str = ""
+    disks_healed: int = 0
+    parity_blocks: int = 0
+    data_blocks: int = 0
+    before_drive_state: list[str] = field(default_factory=list)
+    after_drive_state: list[str] = field(default_factory=list)

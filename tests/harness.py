@@ -1,0 +1,75 @@
+"""In-process multi-disk test harness.
+
+The workhorse of the test strategy, mirroring the reference's
+prepareErasure/ExecObjectLayerTest machinery (cmd/test-utils_test.go:199,
+:1791): build a full erasure object layer over N temp-dir "disks" in one
+process, expose the dirs for direct fault injection (deleting/corrupting
+shard files), and allow taking drives offline mid-test.
+"""
+
+from __future__ import annotations
+
+import os
+
+from minio_tpu.object.erasure import ErasureObjects
+from minio_tpu.storage import format as fmt
+from minio_tpu.storage.local import LocalDrive
+
+
+class ErasureHarness:
+    def __init__(self, tmp_path, n_disks: int = 16, parity: int | None = None):
+        self.dirs = [str(tmp_path / f"disk{i}") for i in range(n_disks)]
+        formats = fmt.init_format(1, n_disks)
+        self.drives: list[LocalDrive | None] = []
+        for d, f in zip(self.dirs, formats):
+            os.makedirs(d, exist_ok=True)
+            f.save(d)
+            self.drives.append(LocalDrive(d))
+        self.layer = ErasureObjects(self.drives, parity=parity)
+
+    def take_offline(self, *indices: int) -> None:
+        for i in indices:
+            self.layer.disks[i] = None
+
+    def bring_online(self, *indices: int) -> None:
+        for i in indices:
+            self.layer.disks[i] = LocalDrive(self.dirs[i])
+
+    def shard_file(self, disk_index: int, bucket: str, object_name: str) -> str | None:
+        """Path to the part.1 shard file on a drive (None if inline/absent)."""
+        obj_dir = os.path.join(self.dirs[disk_index], bucket, object_name)
+        if not os.path.isdir(obj_dir):
+            return None
+        for entry in os.listdir(obj_dir):
+            p = os.path.join(obj_dir, entry, "part.1")
+            if os.path.isfile(p):
+                return p
+        return None
+
+    def xl_meta_file(self, disk_index: int, bucket: str, object_name: str) -> str:
+        return os.path.join(self.dirs[disk_index], bucket, object_name, "xl.meta")
+
+    def corrupt_shard(self, disk_index: int, bucket: str, object_name: str, at: int = 100) -> bool:
+        p = self.shard_file(disk_index, bucket, object_name)
+        if p is None:
+            return False
+        with open(p, "r+b") as f:
+            f.seek(at)
+            b = f.read(1)
+            f.seek(at)
+            f.write(bytes([b[0] ^ 0xFF]))
+        return True
+
+    def delete_shard(self, disk_index: int, bucket: str, object_name: str) -> bool:
+        p = self.shard_file(disk_index, bucket, object_name)
+        if p is None:
+            return False
+        os.remove(p)
+        return True
+
+    def delete_object_dir(self, disk_index: int, bucket: str, object_name: str) -> None:
+        import shutil
+
+        p = os.path.join(self.dirs[disk_index], bucket, object_name)
+        if os.path.isdir(p):
+            shutil.rmtree(p)
