@@ -425,9 +425,7 @@ class ErasureObjects:
                 for j in range(k + mth):
                     if frames[j] is not None:
                         digest, chunk = frames[j][b]
-                        h = bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S.new()
-                        h.update(chunk)
-                        if h.digest() == digest:
+                        if bitrot_mod.digest_of(chunk) == digest:
                             rows[j] = chunk
                         else:
                             frames[j] = None  # corrupt: drop the whole shard
@@ -584,9 +582,7 @@ class ErasureObjects:
                     )
                 frames = _parse_frames(blob, chunk_sizes)
                 for digest, chunk in frames:
-                    h = bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S.new()
-                    h.update(chunk)
-                    if h.digest() != digest:
+                    if bitrot_mod.digest_of(chunk) != digest:
                         return False
                 return True
             except (errors.DiskError, errors.FileCorrupt):
@@ -630,9 +626,7 @@ class ErasureObjects:
                 rebuilt = self.codec.reconstruct(rows, k, mth, bad_rows)
                 for idx, j in enumerate(bad_rows):
                     chunk = rebuilt[idx]
-                    h = bitrot_mod.BitrotAlgorithm.HIGHWAYHASH256S.new()
-                    h.update(chunk)
-                    per_row_frames[j].append((h.digest(), chunk))
+                    per_row_frames[j].append((bitrot_mod.digest_of(chunk), chunk))
             for j in bad_rows:
                 rebuilt_files[j] = _frame_shard(
                     [c for _, c in per_row_frames[j]], [d for d, _ in per_row_frames[j]]

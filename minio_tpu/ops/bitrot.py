@@ -48,6 +48,19 @@ class BitrotCorrupt(Exception):
     """Equivalent of the reference's errFileCorrupt for bitrot mismatches."""
 
 
+def digest_of(chunk: bytes, algo: BitrotAlgorithm = DEFAULT_ALGORITHM) -> bytes:
+    """One-shot digest, via the native C++ kernel when built."""
+    if algo in (BitrotAlgorithm.HIGHWAYHASH256, BitrotAlgorithm.HIGHWAYHASH256S):
+        from . import native
+
+        if native.available():
+            return native.hh256(chunk, hh.MAGIC_KEY)
+        return hh.hash256(chunk)
+    h = algo.new()
+    h.update(chunk)
+    return h.digest()
+
+
 def shard_file_size(size: int, shard_size: int, algo: BitrotAlgorithm = DEFAULT_ALGORITHM) -> int:
     """On-disk size of a bitrot-protected shard file (cmd/bitrot.go:146-151)."""
     if not algo.streaming:

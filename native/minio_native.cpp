@@ -1,0 +1,241 @@
+// Native host kernels: Reed-Solomon GF(2^8) + HighwayHash-256.
+//
+// Role of the reference's assembly-backed Go deps (SURVEY.md section 2.9:
+// klauspost/reedsolomon AVX2 + minio/highwayhash SIMD): the CPU side of the
+// framework. Two jobs:
+//   1. low-latency fallback codec for small/cold requests where a device
+//      round-trip isn't worth it (the batching runtime decides);
+//   2. the honest AVX2 CPU baseline that bench.py compares the TPU path to.
+//
+// RS encode uses the classic PSHUFB nibble-table scheme on AVX2 (32 bytes per
+// instruction per coefficient) with a portable scalar fallback; bit-exact
+// with ops/gf.py tables (poly 0x11d, Vandermonde-systematic matrix fed in by
+// the Python side). HighwayHash is the frozen 2017 spec, bit-exact with
+// ops/highwayhash.py.
+//
+// Built as libminio_native.so via native/Makefile; loaded with ctypes
+// (minio_tpu/ops/native.py). No Python.h dependency.
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+#ifdef __AVX2__
+#include <immintrin.h>
+#endif
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// GF(2^8), poly 0x11d
+// ---------------------------------------------------------------------------
+
+static uint8_t GF_MUL[256][256];
+static uint8_t GF_LO[256][16];  // GF_LO[c][n] = c * n
+static uint8_t GF_HI[256][16];  // GF_HI[c][n] = c * (n << 4)
+static bool gf_ready = false;
+
+static void gf_init() {
+    if (gf_ready) return;
+    uint8_t exp[512];
+    int log[256];
+    int x = 1;
+    for (int i = 0; i < 255; i++) {
+        exp[i] = (uint8_t)x;
+        log[x] = i;
+        x <<= 1;
+        if (x & 0x100) x ^= 0x11d;
+    }
+    for (int i = 255; i < 512; i++) exp[i] = exp[i - 255];
+    for (int a = 0; a < 256; a++) {
+        GF_MUL[0][a] = GF_MUL[a][0] = 0;
+    }
+    for (int a = 1; a < 256; a++)
+        for (int b = 1; b < 256; b++)
+            GF_MUL[a][b] = exp[log[a] + log[b]];
+    for (int c = 0; c < 256; c++) {
+        for (int n = 0; n < 16; n++) {
+            GF_LO[c][n] = GF_MUL[c][n];
+            GF_HI[c][n] = GF_MUL[c][n << 4];
+        }
+    }
+    gf_ready = true;
+}
+
+// out[m][s] ^= coeff * in[s] for one (coeff, input-shard, output-shard) triple.
+static void gf_mul_xor(uint8_t coeff, const uint8_t* in, uint8_t* out, size_t n) {
+    if (coeff == 0) return;
+    size_t i = 0;
+#ifdef __AVX2__
+    const __m256i lo_tab = _mm256_broadcastsi128_si256(
+        _mm_loadu_si128((const __m128i*)GF_LO[coeff]));
+    const __m256i hi_tab = _mm256_broadcastsi128_si256(
+        _mm_loadu_si128((const __m128i*)GF_HI[coeff]));
+    const __m256i mask = _mm256_set1_epi8(0x0f);
+    for (; i + 32 <= n; i += 32) {
+        __m256i v = _mm256_loadu_si256((const __m256i*)(in + i));
+        __m256i lo = _mm256_and_si256(v, mask);
+        __m256i hi = _mm256_and_si256(_mm256_srli_epi64(v, 4), mask);
+        __m256i r = _mm256_xor_si256(_mm256_shuffle_epi8(lo_tab, lo),
+                                     _mm256_shuffle_epi8(hi_tab, hi));
+        __m256i o = _mm256_loadu_si256((const __m256i*)(out + i));
+        _mm256_storeu_si256((__m256i*)(out + i), _mm256_xor_si256(o, r));
+    }
+#endif
+    const uint8_t* mul = GF_MUL[coeff];
+    for (; i < n; i++) out[i] ^= mul[in[i]];
+}
+
+// Encode: data [k][shard_len] contiguous, matrix [m][k], out [m][shard_len].
+void rs_encode(int k, int m, const uint8_t* matrix, const uint8_t* data,
+               uint8_t* out, size_t shard_len) {
+    gf_init();
+    memset(out, 0, (size_t)m * shard_len);
+    for (int mi = 0; mi < m; mi++) {
+        uint8_t* dst = out + (size_t)mi * shard_len;
+        for (int ki = 0; ki < k; ki++) {
+            gf_mul_xor(matrix[mi * k + ki], data + (size_t)ki * shard_len, dst,
+                       shard_len);
+        }
+    }
+}
+
+// Apply an arbitrary [r][k] coefficient matrix (decode/reconstruct path).
+void rs_apply(int k, int r, const uint8_t* matrix, const uint8_t* data,
+              uint8_t* out, size_t shard_len) {
+    rs_encode(k, r, matrix, data, out, shard_len);
+}
+
+// ---------------------------------------------------------------------------
+// HighwayHash-256 (frozen spec)
+// ---------------------------------------------------------------------------
+
+typedef struct {
+    uint64_t v0[4], v1[4], mul0[4], mul1[4];
+} hh_state;
+
+static const uint64_t HH_INIT0[4] = {0xdbe6d5d5fe4cce2fULL, 0xa4093822299f31d0ULL,
+                                     0x13198a2e03707344ULL, 0x243f6a8885a308d3ULL};
+static const uint64_t HH_INIT1[4] = {0x3bd39e10cb0ef593ULL, 0xc0acf169b5f18a8cULL,
+                                     0xbe5466cf34e90c6cULL, 0x452821e638d01377ULL};
+
+static inline uint64_t rot32(uint64_t x) { return (x >> 32) | (x << 32); }
+
+static void hh_reset(hh_state* s, const uint8_t* key32) {
+    uint64_t k[4];
+    memcpy(k, key32, 32);
+    for (int i = 0; i < 4; i++) {
+        s->mul0[i] = HH_INIT0[i];
+        s->mul1[i] = HH_INIT1[i];
+        s->v0[i] = HH_INIT0[i] ^ k[i];
+        s->v1[i] = HH_INIT1[i] ^ rot32(k[i]);
+    }
+}
+
+static inline void zipper_merge_add(uint64_t v1, uint64_t v0, uint64_t* add1,
+                                    uint64_t* add0) {
+    *add0 += (((v0 & 0xff000000ULL) | (v1 & 0xff00000000ULL)) >> 24) |
+             (((v0 & 0xff0000000000ULL) | (v1 & 0xff000000000000ULL)) >> 16) |
+             (v0 & 0xff0000ULL) | ((v0 & 0xff00ULL) << 32) |
+             ((v1 & 0xff00000000000000ULL) >> 8) | (v0 << 56);
+    *add1 += (((v1 & 0xff000000ULL) | (v0 & 0xff00000000ULL)) >> 24) |
+             (v1 & 0xff0000ULL) | ((v1 & 0xff0000000000ULL) >> 16) |
+             ((v1 & 0xff00ULL) << 24) | ((v0 & 0xff000000000000ULL) >> 8) |
+             ((v1 & 0xffULL) << 48) | (v0 & 0xff00000000000000ULL);
+}
+
+static void hh_update(hh_state* s, const uint64_t lanes[4]) {
+    for (int i = 0; i < 4; i++) {
+        s->v1[i] += s->mul0[i] + lanes[i];
+        s->mul0[i] ^= (s->v1[i] & 0xffffffffULL) * (s->v0[i] >> 32);
+        s->v0[i] += s->mul1[i];
+        s->mul1[i] ^= (s->v0[i] & 0xffffffffULL) * (s->v1[i] >> 32);
+    }
+    zipper_merge_add(s->v1[1], s->v1[0], &s->v0[1], &s->v0[0]);
+    zipper_merge_add(s->v1[3], s->v1[2], &s->v0[3], &s->v0[2]);
+    zipper_merge_add(s->v0[1], s->v0[0], &s->v1[1], &s->v1[0]);
+    zipper_merge_add(s->v0[3], s->v0[2], &s->v1[3], &s->v1[2]);
+}
+
+static void hh_update_packet(hh_state* s, const uint8_t* p) {
+    uint64_t lanes[4];
+    memcpy(lanes, p, 32);
+    hh_update(s, lanes);
+}
+
+static void hh_permute_update(hh_state* s) {
+    uint64_t p[4] = {rot32(s->v0[2]), rot32(s->v0[3]), rot32(s->v0[0]),
+                     rot32(s->v0[1])};
+    hh_update(s, p);
+}
+
+static void hh_remainder(hh_state* s, const uint8_t* bytes, size_t size_mod32) {
+    const size_t size_mod4 = size_mod32 & 3;
+    const uint8_t* remainder = bytes + (size_mod32 & ~3ULL);
+    uint8_t packet[32] = {0};
+    for (int i = 0; i < 4; i++)
+        s->v0[i] += ((uint64_t)size_mod32 << 32) + size_mod32;
+    // Rotate both 32-bit halves of each v1 lane left by size_mod32.
+    for (int i = 0; i < 4; i++) {
+        uint32_t lo = (uint32_t)s->v1[i], hi = (uint32_t)(s->v1[i] >> 32);
+        if (size_mod32) {
+            lo = (lo << size_mod32) | (lo >> (32 - size_mod32));
+            hi = (hi << size_mod32) | (hi >> (32 - size_mod32));
+        }
+        s->v1[i] = ((uint64_t)hi << 32) | lo;
+    }
+    memcpy(packet, bytes, size_mod32 & ~3ULL);
+    if (size_mod32 & 16) {
+        for (int i = 0; i < 4; i++)
+            packet[28 + i] = remainder[(ptrdiff_t)(i + size_mod4) - 4];
+    } else if (size_mod4) {
+        packet[16] = remainder[0];
+        packet[17] = remainder[size_mod4 >> 1];
+        packet[18] = remainder[size_mod4 - 1];
+    }
+    hh_update_packet(s, packet);
+}
+
+static void hh_modular_reduction(uint64_t a3u, uint64_t a2, uint64_t a1,
+                                 uint64_t a0, uint64_t* m1, uint64_t* m0) {
+    uint64_t a3 = a3u & 0x3fffffffffffffffULL;
+    *m1 = a1 ^ ((a3 << 1) | (a2 >> 63)) ^ ((a3 << 2) | (a2 >> 62));
+    *m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
+}
+
+void hh256(const uint8_t* key32, const uint8_t* data, size_t len,
+           uint8_t* out32) {
+    hh_state s;
+    hh_reset(&s, key32);
+    size_t n_full = len / 32;
+    for (size_t i = 0; i < n_full; i++) hh_update_packet(&s, data + i * 32);
+    size_t r = len - n_full * 32;
+    if (r) hh_remainder(&s, data + n_full * 32, r);
+    for (int i = 0; i < 10; i++) hh_permute_update(&s);
+    uint64_t h[4];
+    hh_modular_reduction(s.v1[1] + s.mul1[1], s.v1[0] + s.mul1[0],
+                         s.v0[1] + s.mul0[1], s.v0[0] + s.mul0[0], &h[1], &h[0]);
+    hh_modular_reduction(s.v1[3] + s.mul1[3], s.v1[2] + s.mul1[2],
+                         s.v0[3] + s.mul0[3], s.v0[2] + s.mul0[2], &h[3], &h[2]);
+    memcpy(out32, h, 32);
+}
+
+// Hash n equal-length streams laid out contiguously: data[i] at i*stride.
+void hh256_batch(const uint8_t* key32, const uint8_t* data, size_t stride,
+                 size_t len, size_t n, uint8_t* out) {
+    for (size_t i = 0; i < n; i++)
+        hh256(key32, data + i * stride, len, out + i * 32);
+}
+
+// Interleaved bitrot framing in one pass: for each of n chunks of chunk_len
+// bytes (stride apart), write H(chunk) || chunk into dst.
+void hh256_frame(const uint8_t* key32, const uint8_t* data, size_t stride,
+                 size_t chunk_len, size_t n, uint8_t* dst) {
+    for (size_t i = 0; i < n; i++) {
+        hh256(key32, data + i * stride, chunk_len, dst);
+        memcpy(dst + 32, data + i * stride, chunk_len);
+        dst += 32 + chunk_len;
+    }
+}
+
+}  // extern "C"
