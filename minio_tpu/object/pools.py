@@ -1,0 +1,303 @@
+"""Server pools: the top-level ObjectLayer.
+
+Role of the reference's erasureServerPools (cmd/erasure-server-pool.go):
+multiple independent pools of erasure sets behind one namespace. New objects
+go to the pool with the most free space (:222-288); reads/deletes probe the
+pool that actually holds the object (:289-372); buckets and listings span all
+pools. This is the object the API layer holds (its `ObjectAPI()`).
+"""
+
+from __future__ import annotations
+
+import heapq
+
+from ..storage.interface import StorageAPI
+from ..utils import errors
+from . import codec as codec_mod
+from . import metadata as meta_mod
+from .sets import ErasureSets
+from .types import (
+    BucketInfo,
+    DeleteObjectOptions,
+    GetObjectOptions,
+    HealResultItem,
+    ListObjectsInfo,
+    ListObjectVersionsInfo,
+    ObjectInfo,
+    PutObjectOptions,
+)
+
+
+class ServerPools:
+    def __init__(self, pools: list[ErasureSets]):
+        if not pools:
+            raise ValueError("need at least one pool")
+        self.pools = pools
+
+    # -- convenience constructors ---------------------------------------------
+
+    @classmethod
+    def single(
+        cls,
+        disks: list[StorageAPI],
+        set_drive_count: int | None = None,
+        parity: int | None = None,
+        codec: codec_mod.BlockCodec | None = None,
+    ) -> "ServerPools":
+        count = set_drive_count or len(disks)
+        return cls([ErasureSets(list(disks), count, parity=parity, codec=codec)])
+
+    # -- pool selection --------------------------------------------------------
+
+    def _pool_with_space(self) -> ErasureSets:
+        best, best_free = self.pools[0], -1
+        for p in self.pools:
+            free = 0
+            for d in p.disks:
+                if d is None:
+                    continue
+                try:
+                    free += d.disk_info().free
+                except errors.DiskError:
+                    continue
+            if free > best_free:
+                best, best_free = p, free
+        return best
+
+    def _pool_holding(self, bucket: str, object_name: str, version_id: str = "") -> ErasureSets:
+        if len(self.pools) == 1:
+            return self.pools[0]
+        newest: tuple[float, ErasureSets] | None = None
+        for p in self.pools:
+            try:
+                oi = p.get_object_info(bucket, object_name, GetObjectOptions(version_id))
+                if newest is None or oi.mod_time > newest[0]:
+                    newest = (oi.mod_time, p)
+            except errors.ObjectError:
+                continue
+        if newest is None:
+            raise errors.ObjectNotFound(bucket, object_name)
+        return newest[1]
+
+    # -- buckets ---------------------------------------------------------------
+
+    def make_bucket(self, bucket: str) -> None:
+        _validate_bucket_name(bucket)
+        results = meta_mod.parallel_map(lambda p: p.make_bucket(bucket), self.pools)
+        for _, e in results:
+            if e is not None:
+                raise e
+
+    def get_bucket_info(self, bucket: str) -> BucketInfo:
+        return self.pools[0].get_bucket_info(bucket)
+
+    def bucket_exists(self, bucket: str) -> bool:
+        try:
+            self.get_bucket_info(bucket)
+            return True
+        except errors.BucketNotFound:
+            return False
+
+    def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        # Refuse unless empty across every pool (unless forced).
+        if not force:
+            for p in self.pools:
+                listing = p.list_objects(bucket, max_keys=1)
+                if listing.objects or listing.prefixes:
+                    raise errors.BucketNotEmpty(bucket)
+        results = meta_mod.parallel_map(lambda p: p.delete_bucket(bucket, True), self.pools)
+        for _, e in results:
+            if e is not None:
+                raise e
+
+    def list_buckets(self) -> list[BucketInfo]:
+        return self.pools[0].list_buckets()
+
+    # -- objects ---------------------------------------------------------------
+
+    def put_object(
+        self, bucket: str, object_name: str, data: bytes, opts: PutObjectOptions | None = None
+    ) -> ObjectInfo:
+        _validate_object_name(bucket, object_name)
+        # Overwrites must land in the pool that already holds the object.
+        try:
+            pool = self._pool_holding(bucket, object_name)
+        except errors.ObjectError:
+            pool = self._pool_with_space()
+        return pool.put_object(bucket, object_name, data, opts)
+
+    def get_object(
+        self,
+        bucket: str,
+        object_name: str,
+        opts: GetObjectOptions | None = None,
+        offset: int = 0,
+        length: int = -1,
+    ) -> tuple[ObjectInfo, bytes]:
+        opts = opts or GetObjectOptions()
+        last: Exception = errors.ObjectNotFound(bucket, object_name)
+        for p in self.pools:
+            try:
+                return p.get_object(bucket, object_name, opts, offset, length)
+            except (errors.ObjectNotFound, errors.VersionNotFound) as e:
+                last = e
+        raise last
+
+    def get_object_info(
+        self, bucket: str, object_name: str, opts: GetObjectOptions | None = None
+    ) -> ObjectInfo:
+        opts = opts or GetObjectOptions()
+        last: Exception = errors.ObjectNotFound(bucket, object_name)
+        for p in self.pools:
+            try:
+                return p.get_object_info(bucket, object_name, opts)
+            except (errors.ObjectNotFound, errors.VersionNotFound) as e:
+                last = e
+        raise last
+
+    def delete_object(
+        self, bucket: str, object_name: str, opts: DeleteObjectOptions | None = None
+    ) -> ObjectInfo:
+        opts = opts or DeleteObjectOptions()
+        if opts.versioned and not opts.version_id:
+            # Delete marker goes where the object lives (or first pool).
+            try:
+                pool = self._pool_holding(bucket, object_name)
+            except errors.ObjectError:
+                pool = self.pools[0]
+            return pool.delete_object(bucket, object_name, opts)
+        last: Exception | None = None
+        for p in self.pools:
+            try:
+                return p.delete_object(bucket, object_name, opts)
+            except (errors.ObjectNotFound, errors.VersionNotFound) as e:
+                last = e
+        if last and len(self.pools) > 1:
+            raise last
+        if last:
+            raise last
+        return ObjectInfo(bucket=bucket, name=object_name)
+
+    def delete_objects(
+        self, bucket: str, objects: list[tuple[str, str]], versioned: bool = False
+    ) -> list[tuple[ObjectInfo | None, Exception | None]]:
+        """Bulk delete: [(name, version_id)] -> per-entry result."""
+
+        def rm(item):
+            name, vid = item
+            return self.delete_object(
+                bucket, name, DeleteObjectOptions(version_id=vid, versioned=versioned)
+            )
+
+        return meta_mod.parallel_map(rm, objects)
+
+    # -- listing ---------------------------------------------------------------
+
+    def list_objects(
+        self,
+        bucket: str,
+        prefix: str = "",
+        marker: str = "",
+        delimiter: str = "",
+        max_keys: int = 1000,
+    ) -> ListObjectsInfo:
+        if len(self.pools) == 1:
+            return self.pools[0].list_objects(bucket, prefix, marker, delimiter, max_keys)
+        # Merge per-pool listings (each sorted).
+        merged = ListObjectsInfo()
+        streams = [
+            p.list_objects(bucket, prefix, marker, delimiter, max_keys) for p in self.pools
+        ]
+        names: dict[str, ObjectInfo] = {}
+        for s in streams:
+            for o in s.objects:
+                if o.name not in names or o.mod_time > names[o.name].mod_time:
+                    names[o.name] = o
+        prefixes = sorted({cp for s in streams for cp in s.prefixes})
+        ordered = sorted(names)
+        for name in ordered[:max_keys]:
+            merged.objects.append(names[name])
+        if len(ordered) > max_keys or any(s.is_truncated for s in streams):
+            merged.is_truncated = True
+            if merged.objects:
+                merged.next_marker = merged.objects[-1].name
+        merged.prefixes = prefixes
+        return merged
+
+    def list_object_versions(
+        self,
+        bucket: str,
+        prefix: str = "",
+        key_marker: str = "",
+        version_marker: str = "",
+        delimiter: str = "",
+        max_keys: int = 1000,
+    ) -> ListObjectVersionsInfo:
+        if len(self.pools) == 1:
+            return self.pools[0].list_object_versions(
+                bucket, prefix, key_marker, version_marker, delimiter, max_keys
+            )
+        out = ListObjectVersionsInfo()
+        for p in self.pools:
+            part = p.list_object_versions(
+                bucket, prefix, key_marker, version_marker, delimiter, max_keys
+            )
+            out.objects.extend(part.objects)
+            out.prefixes = sorted(set(out.prefixes) | set(part.prefixes))
+        out.objects.sort(key=lambda o: (o.name, -o.mod_time))
+        if len(out.objects) > max_keys:
+            out.objects = out.objects[:max_keys]
+            out.is_truncated = True
+            out.next_key_marker = out.objects[-1].name
+            out.next_version_marker = out.objects[-1].version_id
+        return out
+
+    # -- healing ---------------------------------------------------------------
+
+    def heal_object(
+        self, bucket: str, object_name: str, version_id: str = "", dry_run: bool = False
+    ) -> HealResultItem:
+        last: Exception | None = None
+        for p in self.pools:
+            try:
+                return p.heal_object(bucket, object_name, version_id, dry_run)
+            except (errors.ObjectError, errors.DiskError) as e:
+                last = e
+        raise last or errors.ObjectNotFound(bucket, object_name)
+
+    def heal_bucket(self, bucket: str) -> None:
+        """Recreate the bucket volume on drives that miss it."""
+        for p in self.pools:
+            for s in p.sets:
+                for d in s.disks:
+                    if d is None:
+                        continue
+                    try:
+                        d.stat_vol(bucket)
+                    except errors.VolumeNotFound:
+                        try:
+                            d.make_vol(bucket)
+                        except errors.DiskError:
+                            pass
+                    except errors.DiskError:
+                        pass
+
+
+def _validate_bucket_name(bucket: str) -> None:
+    """S3 bucket naming rules (subset the reference enforces)."""
+    if not (3 <= len(bucket) <= 63):
+        raise errors.BucketNameInvalid(bucket)
+    if bucket.startswith(".") or bucket.endswith(".") or bucket.startswith("-"):
+        raise errors.BucketNameInvalid(bucket)
+    ok = set("abcdefghijklmnopqrstuvwxyz0123456789.-")
+    if not all(c in ok for c in bucket):
+        raise errors.BucketNameInvalid(bucket)
+
+
+def _validate_object_name(bucket: str, object_name: str) -> None:
+    if not object_name or len(object_name) > 1024:
+        raise errors.ObjectNameInvalid(bucket, object_name)
+    if object_name.startswith("/") or "\\" in object_name:
+        raise errors.ObjectNameInvalid(bucket, object_name)
+    if any(part in (".", "..") for part in object_name.split("/")):
+        raise errors.ObjectNameInvalid(bucket, object_name)

@@ -1,0 +1,170 @@
+"""Erasure sets + server pools tests: routing, listing, multi-set namespaces.
+
+Mirrors cmd/erasure-sets_test.go (distribution stability) and the listing
+behavior exercised by cmd/bucket-listobjects-handlers tests.
+"""
+
+import os
+
+import pytest
+
+from minio_tpu.object.pools import ServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.object.types import PutObjectOptions
+from minio_tpu.storage import format as fmt
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.utils import errors
+
+
+def make_pools(tmp_path, n_disks=8, set_drive_count=4, n_pools=1) -> ServerPools:
+    pools = []
+    for pi in range(n_pools):
+        drives = []
+        formats = fmt.init_format(n_disks // set_drive_count, set_drive_count)
+        for i in range(n_disks):
+            root = str(tmp_path / f"pool{pi}" / f"disk{i}")
+            os.makedirs(root, exist_ok=True)
+            formats[i].save(root)
+            drives.append(LocalDrive(root))
+        pools.append(
+            ErasureSets.from_drives(drives, formats[0], pool_index=pi)
+        )
+    return ServerPools(pools)
+
+
+@pytest.fixture
+def layer(tmp_path):
+    lp = make_pools(tmp_path, n_disks=8, set_drive_count=4)
+    lp.make_bucket("bucket")
+    return lp
+
+
+class TestSets:
+    def test_routing_stable_and_spread(self, layer):
+        sets = layer.pools[0]
+        assert len(sets.sets) == 2
+        idx = {name: sets.get_set_index(name) for name in ("a", "b", "c", "obj-7", "x/y/z")}
+        for name, i in idx.items():
+            assert sets.get_set_index(name) == i  # deterministic
+            assert 0 <= i < 2
+
+    def test_objects_across_sets(self, layer):
+        for i in range(20):
+            layer.put_object("bucket", f"obj-{i}", f"data-{i}".encode())
+        for i in range(20):
+            _, got = layer.get_object("bucket", f"obj-{i}")
+            assert got == f"data-{i}".encode()
+        # Objects really landed on different sets.
+        sets = layer.pools[0]
+        indexes = {sets.get_set_index(f"obj-{i}") for i in range(20)}
+        assert indexes == {0, 1}
+
+    def test_from_drives_arrangement(self, tmp_path):
+        formats = fmt.init_format(2, 4)
+        drives = []
+        for i, f in enumerate(formats):
+            root = str(tmp_path / f"d{i}")
+            os.makedirs(root)
+            f.save(root)
+            drives.append(LocalDrive(root))
+        # Shuffle drive order; from_drives must restore format positions.
+        shuffled = drives[::-1]
+        sets = ErasureSets.from_drives(shuffled, formats[0])
+        for s in range(2):
+            for i in range(4):
+                d = sets.sets[s].disks[i]
+                assert d is not None
+                assert d.disk_id() == formats[0].sets[s][i]
+
+
+class TestListing:
+    def test_flat_listing(self, layer):
+        names = ["a.txt", "b/one", "b/two", "c.txt", "d/e/deep"]
+        for n in names:
+            layer.put_object("bucket", n, b"x")
+        res = layer.list_objects("bucket")
+        assert [o.name for o in res.objects] == sorted(names)
+        assert not res.is_truncated
+
+    def test_delimiter_listing(self, layer):
+        for n in ["a.txt", "b/one", "b/two", "c/three", "d.txt"]:
+            layer.put_object("bucket", n, b"x")
+        res = layer.list_objects("bucket", delimiter="/")
+        assert [o.name for o in res.objects] == ["a.txt", "d.txt"]
+        assert res.prefixes == ["b/", "c/"]
+
+    def test_prefix_listing(self, layer):
+        for n in ["logs/2024/a", "logs/2024/b", "logs/2025/c", "data/x"]:
+            layer.put_object("bucket", n, b"x")
+        res = layer.list_objects("bucket", prefix="logs/")
+        assert [o.name for o in res.objects] == ["logs/2024/a", "logs/2024/b", "logs/2025/c"]
+        res2 = layer.list_objects("bucket", prefix="logs/", delimiter="/")
+        assert res2.prefixes == ["logs/2024/", "logs/2025/"]
+
+    def test_marker_pagination(self, layer):
+        names = [f"obj-{i:03d}" for i in range(10)]
+        for n in names:
+            layer.put_object("bucket", n, b"x")
+        page1 = layer.list_objects("bucket", max_keys=4)
+        assert len(page1.objects) == 4
+        assert page1.is_truncated
+        page2 = layer.list_objects("bucket", marker=page1.objects[-1].name, max_keys=100)
+        assert [o.name for o in page2.objects] == names[4:]
+        assert not page2.is_truncated
+
+    def test_deleted_objects_not_listed(self, layer):
+        layer.put_object("bucket", "keep", b"x")
+        layer.put_object("bucket", "gone", b"x")
+        layer.delete_object("bucket", "gone")
+        res = layer.list_objects("bucket")
+        assert [o.name for o in res.objects] == ["keep"]
+
+    def test_list_versions(self, layer):
+        opts = PutObjectOptions(versioned=True)
+        v1 = layer.put_object("bucket", "obj", b"one", opts)
+        v2 = layer.put_object("bucket", "obj", b"two", opts)
+        res = layer.list_object_versions("bucket")
+        assert len(res.objects) == 2
+        assert res.objects[0].version_id == v2.version_id
+        assert res.objects[0].is_latest
+        assert res.objects[1].version_id == v1.version_id
+
+    def test_missing_bucket_listing(self, layer):
+        with pytest.raises(errors.BucketNotFound):
+            layer.list_objects("nope")
+
+
+class TestPools:
+    def test_multi_pool_namespace(self, tmp_path):
+        lp = make_pools(tmp_path, n_disks=4, set_drive_count=4, n_pools=2)
+        lp.make_bucket("bkt")
+        lp.put_object("bkt", "x", b"data-x")
+        _, got = lp.get_object("bkt", "x")
+        assert got == b"data-x"
+        res = lp.list_objects("bkt")
+        assert [o.name for o in res.objects] == ["x"]
+        lp.delete_object("bkt", "x")
+        with pytest.raises(errors.ObjectNotFound):
+            lp.get_object("bkt", "x")
+
+    def test_bucket_name_validation(self, layer):
+        for bad in ["ab", "-bad", "BAD", "a" * 64, ".start"]:
+            with pytest.raises(errors.BucketNameInvalid):
+                layer.make_bucket(bad)
+
+    def test_object_name_validation(self, layer):
+        for bad in ["", "/lead", "a/../b", "a\\b"]:
+            with pytest.raises(errors.ObjectNameInvalid):
+                layer.put_object("bucket", bad, b"x")
+
+    def test_bulk_delete(self, layer):
+        for i in range(5):
+            layer.put_object("bucket", f"o{i}", b"x")
+        results = layer.delete_objects("bucket", [(f"o{i}", "") for i in range(5)])
+        assert all(e is None for _, e in results)
+        assert layer.list_objects("bucket").objects == []
+
+    def test_delete_nonempty_refused(self, layer):
+        layer.put_object("bucket", "obj", b"x")
+        with pytest.raises(errors.BucketNotEmpty):
+            layer.delete_bucket("bucket")
