@@ -1,0 +1,743 @@
+"""S3-compatible HTTP API server (aiohttp).
+
+Role of the reference's API front (cmd/api-router.go, object-handlers.go,
+bucket-handlers.go): routes S3 REST onto the object layer. Request flow per
+handler mirrors the reference's order: auth (SigV4 header / presigned /
+anonymous+policy) -> policy authorization -> handler -> object layer, with
+S3-coded XML errors throughout.
+
+The object layer is synchronous (thread-pooled drive IO); handlers hop to a
+worker thread via asyncio.to_thread so the event loop only does protocol work
+-- the asyncio analogue of the reference's goroutine-per-request model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import datetime
+import hashlib
+import secrets
+import urllib.parse
+import xml.etree.ElementTree as ET
+from xml.sax.saxutils import escape
+
+from aiohttp import web
+
+from ..control.bucket_meta import BucketMetadataSys
+from ..control.iam import IAMSys
+from ..control import policy as policy_mod
+from ..object.pools import ServerPools
+from ..object.types import (
+    DeleteObjectOptions,
+    GetObjectOptions,
+    ObjectInfo,
+    PutObjectOptions,
+)
+from ..utils import errors as oerr
+from .auth import SigV4Verifier, UNSIGNED_PAYLOAD
+from .errors import S3Error, from_object_error
+
+MAX_OBJECT_SIZE = 5 * (1 << 30)  # single-PUT cap, matching S3
+
+XML_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+def _iso(ts: float) -> str:
+    return (
+        datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%S.%f")[:-3]
+        + "Z"
+    )
+
+
+def _http_date(ts: float) -> str:
+    return datetime.datetime.fromtimestamp(ts, datetime.timezone.utc).strftime(
+        "%a, %d %b %Y %H:%M:%S GMT"
+    )
+
+
+def _xml(content: str, status: int = 200) -> web.Response:
+    return web.Response(
+        status=status,
+        body=('<?xml version="1.0" encoding="UTF-8"?>\n' + content).encode(),
+        content_type="application/xml",
+    )
+
+
+def _obj_xml(o: ObjectInfo) -> str:
+    return (
+        f"<Contents><Key>{escape(o.name)}</Key>"
+        f"<LastModified>{_iso(o.mod_time)}</LastModified>"
+        f"<ETag>&quot;{o.etag}&quot;</ETag><Size>{o.size}</Size>"
+        f"<StorageClass>{o.storage_class}</StorageClass>"
+        "<Owner><ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName></Owner>"
+        "</Contents>"
+    )
+
+
+class S3Server:
+    def __init__(
+        self,
+        layer: ServerPools,
+        iam: IAMSys,
+        region: str = "us-east-1",
+        check_skew: bool = True,
+    ):
+        self.layer = layer
+        self.iam = iam
+        self.region = region
+        self.bucket_meta = BucketMetadataSys(layer)
+        self.verifier = SigV4Verifier(iam.lookup, region, check_skew)
+        self.app = web.Application(client_max_size=MAX_OBJECT_SIZE)
+        self.app.router.add_route("*", "/{tail:.*}", self._entry)
+        # Hooks filled in by the control plane (events, metrics, trace).
+        self.on_event = None
+        self.metrics = None
+        self.trace = None
+
+    # -- plumbing -------------------------------------------------------------
+
+    async def _entry(self, request: web.Request) -> web.Response:
+        request_id = secrets.token_hex(8).upper()
+        try:
+            resp = await self._dispatch(request, request_id)
+        except S3Error as e:
+            resp = _xml(e.to_xml(request_id), e.api.http_status)
+        except (oerr.StorageError, ValueError) as e:
+            bucket, key = self._split_path(request)
+            s3e = (
+                from_object_error(e, bucket, key)
+                if isinstance(e, oerr.StorageError)
+                else S3Error("InvalidArgument", str(e))
+            )
+            resp = _xml(s3e.to_xml(request_id), s3e.api.http_status)
+        resp.headers["x-amz-request-id"] = request_id
+        resp.headers.setdefault("Server", "MinIO-TPU")
+        if self.metrics is not None:
+            self.metrics.record_http(request.method, resp.status)
+        return resp
+
+    def _split_path(self, request: web.Request) -> tuple[str, str]:
+        path = urllib.parse.unquote(request.path)
+        parts = path.lstrip("/").split("/", 1)
+        bucket = parts[0] if parts else ""
+        key = parts[1] if len(parts) > 1 else ""
+        return bucket, key
+
+    def _authenticate(self, request: web.Request, body: bytes) -> str:
+        """Returns the authenticated access key; "" for anonymous."""
+        headers = dict(request.headers)
+        query = [(k, v) for k, v in request.rel_url.query.items()]
+        path = urllib.parse.unquote(request.path)
+        if "X-Amz-Signature" in request.rel_url.query:
+            return self.verifier.verify_presigned(request.method, path, query, headers)
+        if "Authorization" in request.headers:
+            return self.verifier.verify_signed(request.method, path, query, headers, body)
+        return ""  # anonymous
+
+    def _authorize(self, access_key: str, action: str, bucket: str, key: str) -> None:
+        resource = policy_mod.resource_arn(bucket, key)
+        if access_key:
+            if self.iam.is_allowed(access_key, action, resource):
+                return
+            raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
+        # Anonymous: only bucket policy can grant.
+        if bucket:
+            meta = self.bucket_meta.get(bucket)
+            if meta.policy_json:
+                pol = policy_mod.Policy.from_json(meta.policy_json)
+                if pol.is_allowed(action, resource):
+                    return
+        raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
+
+    async def _dispatch(self, request: web.Request, request_id: str) -> web.Response:
+        bucket, key = self._split_path(request)
+        body = await request.read()
+        access_key = await asyncio.to_thread(self._authenticate, request, body)
+        q = request.rel_url.query
+        action = policy_mod.s3_action(request.method, bucket, key, q)
+        await asyncio.to_thread(self._authorize, access_key, action, bucket, key)
+
+        if not bucket:
+            if request.method == "GET":
+                return await asyncio.to_thread(self._list_buckets)
+            raise S3Error("MethodNotAllowed")
+        if not key:
+            return await self._bucket_op(request, bucket, body)
+        return await self._object_op(request, bucket, key, body)
+
+    # -- service --------------------------------------------------------------
+
+    def _list_buckets(self) -> web.Response:
+        buckets = self.layer.list_buckets()
+        items = "".join(
+            f"<Bucket><Name>{escape(b.name)}</Name>"
+            f"<CreationDate>{_iso(b.created)}</CreationDate></Bucket>"
+            for b in buckets
+        )
+        return _xml(
+            f'<ListAllMyBucketsResult xmlns="{XML_NS}">'
+            "<Owner><ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName></Owner>"
+            f"<Buckets>{items}</Buckets></ListAllMyBucketsResult>"
+        )
+
+    # -- bucket ---------------------------------------------------------------
+
+    async def _bucket_op(self, request: web.Request, bucket: str, body: bytes) -> web.Response:
+        q = request.rel_url.query
+        m = request.method
+        if m == "HEAD":
+            exists = await asyncio.to_thread(self.layer.bucket_exists, bucket)
+            if not exists:
+                return web.Response(status=404)
+            return web.Response(status=200)
+        if m == "PUT":
+            if "versioning" in q:
+                return await asyncio.to_thread(self._put_versioning, bucket, body)
+            if "policy" in q:
+                return await asyncio.to_thread(self._put_policy, bucket, body)
+            if "tagging" in q:
+                return await asyncio.to_thread(self._put_bucket_tagging, bucket, body)
+            if "lifecycle" in q:
+                return await asyncio.to_thread(
+                    self._put_bucket_config, bucket, "lifecycle_xml", body
+                )
+            if "encryption" in q:
+                return await asyncio.to_thread(
+                    self._put_bucket_config, bucket, "encryption_xml", body
+                )
+            if "replication" in q:
+                return await asyncio.to_thread(
+                    self._put_bucket_config, bucket, "replication_xml", body
+                )
+            if "notification" in q:
+                return await asyncio.to_thread(
+                    self._put_bucket_config, bucket, "notification_xml", body
+                )
+            if "object-lock" in q:
+                return await asyncio.to_thread(
+                    self._put_bucket_config, bucket, "object_lock_xml", body
+                )
+            if "cors" in q:
+                return await asyncio.to_thread(self._put_bucket_config, bucket, "cors_xml", body)
+            return await asyncio.to_thread(self._make_bucket, bucket)
+        if m == "GET":
+            if "location" in q:
+                await asyncio.to_thread(self.layer.get_bucket_info, bucket)
+                loc = "" if self.region == "us-east-1" else self.region
+                return _xml(f'<LocationConstraint xmlns="{XML_NS}">{loc}</LocationConstraint>')
+            if "versioning" in q:
+                return await asyncio.to_thread(self._get_versioning, bucket)
+            if "policy" in q:
+                return await asyncio.to_thread(self._get_policy, bucket)
+            if "tagging" in q:
+                return await asyncio.to_thread(self._get_bucket_tagging, bucket)
+            if "lifecycle" in q:
+                return await asyncio.to_thread(
+                    self._get_bucket_config, bucket, "lifecycle_xml", "NoSuchLifecycleConfiguration"
+                )
+            if "encryption" in q:
+                return await asyncio.to_thread(
+                    self._get_bucket_config,
+                    bucket,
+                    "encryption_xml",
+                    "ServerSideEncryptionConfigurationNotFoundError",
+                )
+            if "replication" in q:
+                return await asyncio.to_thread(
+                    self._get_bucket_config,
+                    bucket,
+                    "replication_xml",
+                    "ReplicationConfigurationNotFoundError",
+                )
+            if "notification" in q:
+                return await asyncio.to_thread(self._get_notification, bucket)
+            if "object-lock" in q:
+                return await asyncio.to_thread(
+                    self._get_bucket_config, bucket, "object_lock_xml", "ObjectLockConfigurationNotFoundError"
+                )
+            if "cors" in q:
+                return await asyncio.to_thread(
+                    self._get_bucket_config, bucket, "cors_xml", "NoSuchCORSConfiguration"
+                )
+            if "acl" in q:
+                await asyncio.to_thread(self.layer.get_bucket_info, bucket)
+                return _xml(self._acl_xml())
+            if "uploads" in q:
+                return await asyncio.to_thread(self._list_multipart_uploads, bucket, q)
+            if "versions" in q:
+                return await asyncio.to_thread(self._list_versions, bucket, q)
+            return await asyncio.to_thread(self._list_objects, bucket, q)
+        if m == "DELETE":
+            if "policy" in q:
+                return await asyncio.to_thread(self._delete_policy, bucket)
+            if "tagging" in q:
+                return await asyncio.to_thread(self._put_bucket_tagging, bucket, b"")
+            if "lifecycle" in q:
+                return await asyncio.to_thread(self._put_bucket_config, bucket, "lifecycle_xml", b"")
+            return await asyncio.to_thread(self._delete_bucket, bucket)
+        if m == "POST":
+            if "delete" in q:
+                return await asyncio.to_thread(self._bulk_delete, bucket, body)
+            raise S3Error("MethodNotAllowed")
+        raise S3Error("MethodNotAllowed")
+
+    def _make_bucket(self, bucket: str) -> web.Response:
+        self.layer.make_bucket(bucket)
+        self.bucket_meta.save(self.bucket_meta.get(bucket))
+        return web.Response(status=200, headers={"Location": f"/{bucket}"})
+
+    def _delete_bucket(self, bucket: str) -> web.Response:
+        self.layer.delete_bucket(bucket)
+        self.bucket_meta.delete(bucket)
+        return web.Response(status=204)
+
+    def _put_versioning(self, bucket: str, body: bytes) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        try:
+            root = ET.fromstring(body)
+            status = root.findtext(f"{{{XML_NS}}}Status") or root.findtext("Status") or ""
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        if status not in ("Enabled", "Suspended"):
+            raise S3Error("MalformedXML")
+        self.bucket_meta.update(bucket, versioning=status)
+        return web.Response(status=200)
+
+    def _get_versioning(self, bucket: str) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        meta = self.bucket_meta.get(bucket)
+        inner = f"<Status>{meta.versioning}</Status>" if meta.versioning else ""
+        return _xml(f'<VersioningConfiguration xmlns="{XML_NS}">{inner}</VersioningConfiguration>')
+
+    def _put_policy(self, bucket: str, body: bytes) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        try:
+            policy_mod.Policy.from_json(body)
+        except Exception:
+            raise S3Error("MalformedXML", "Policy is not valid JSON")
+        self.bucket_meta.update(bucket, policy_json=body.decode())
+        return web.Response(status=204)
+
+    def _get_policy(self, bucket: str) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        meta = self.bucket_meta.get(bucket)
+        if not meta.policy_json:
+            raise S3Error("NoSuchBucketPolicy", resource=f"/{bucket}")
+        return web.json_response(text=meta.policy_json)
+
+    def _delete_policy(self, bucket: str) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        self.bucket_meta.update(bucket, policy_json="")
+        return web.Response(status=204)
+
+    def _put_bucket_tagging(self, bucket: str, body: bytes) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        tags: dict[str, str] = {}
+        if body:
+            try:
+                root = ET.fromstring(body)
+                for tag in root.iter():
+                    if tag.tag.endswith("Tag"):
+                        kv = {c.tag.split("}")[-1]: (c.text or "") for c in tag}
+                        if "Key" in kv:
+                            tags[kv["Key"]] = kv.get("Value", "")
+            except ET.ParseError:
+                raise S3Error("MalformedXML")
+        self.bucket_meta.update(bucket, tagging=tags)
+        return web.Response(status=200 if body else 204)
+
+    def _get_bucket_tagging(self, bucket: str) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        meta = self.bucket_meta.get(bucket)
+        if not meta.tagging:
+            raise S3Error("NoSuchTagSet", resource=f"/{bucket}")
+        tags = "".join(
+            f"<Tag><Key>{escape(k)}</Key><Value>{escape(v)}</Value></Tag>"
+            for k, v in meta.tagging.items()
+        )
+        return _xml(f'<Tagging xmlns="{XML_NS}"><TagSet>{tags}</TagSet></Tagging>')
+
+    def _put_bucket_config(self, bucket: str, field: str, body: bytes) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        if body:
+            try:
+                ET.fromstring(body)
+            except ET.ParseError:
+                raise S3Error("MalformedXML")
+        self.bucket_meta.update(bucket, **{field: body.decode() if body else ""})
+        return web.Response(status=200 if body else 204)
+
+    def _get_bucket_config(self, bucket: str, field: str, missing_code: str) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        meta = self.bucket_meta.get(bucket)
+        raw = getattr(meta, field)
+        if not raw:
+            raise S3Error(missing_code, resource=f"/{bucket}")
+        return web.Response(body=raw.encode(), content_type="application/xml")
+
+    def _get_notification(self, bucket: str) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        meta = self.bucket_meta.get(bucket)
+        if not meta.notification_xml:
+            return _xml(f'<NotificationConfiguration xmlns="{XML_NS}"></NotificationConfiguration>')
+        return web.Response(body=meta.notification_xml.encode(), content_type="application/xml")
+
+    def _acl_xml(self) -> str:
+        return (
+            f'<AccessControlPolicy xmlns="{XML_NS}">'
+            "<Owner><ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName></Owner>"
+            "<AccessControlList><Grant>"
+            '<Grantee xmlns:xsi="http://www.w3.org/2001/XMLSchema-instance" xsi:type="CanonicalUser">'
+            "<ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName></Grantee>"
+            "<Permission>FULL_CONTROL</Permission>"
+            "</Grant></AccessControlList></AccessControlPolicy>"
+        )
+
+    def _list_multipart_uploads(self, bucket: str, q) -> web.Response:
+        self.layer.get_bucket_info(bucket)
+        return _xml(
+            f'<ListMultipartUploadsResult xmlns="{XML_NS}">'
+            f"<Bucket>{escape(bucket)}</Bucket><IsTruncated>false</IsTruncated>"
+            "</ListMultipartUploadsResult>"
+        )
+
+    def _list_objects(self, bucket: str, q) -> web.Response:
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", "1000"))
+        v2 = q.get("list-type") == "2"
+        if v2:
+            token = q.get("continuation-token", "")
+            marker = base64.b64decode(token).decode() if token else q.get("start-after", "")
+        else:
+            marker = q.get("marker", "")
+        res = self.layer.list_objects(bucket, prefix, marker, delimiter, max_keys)
+        contents = "".join(_obj_xml(o) for o in res.objects)
+        prefixes = "".join(
+            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>" for p in res.prefixes
+        )
+        if v2:
+            next_token = (
+                f"<NextContinuationToken>{base64.b64encode(res.next_marker.encode()).decode()}"
+                "</NextContinuationToken>"
+                if res.is_truncated
+                else ""
+            )
+            return _xml(
+                f'<ListBucketResult xmlns="{XML_NS}">'
+                f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+                f"<KeyCount>{len(res.objects) + len(res.prefixes)}</KeyCount>"
+                f"<MaxKeys>{max_keys}</MaxKeys><Delimiter>{escape(delimiter)}</Delimiter>"
+                f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
+                f"{next_token}{contents}{prefixes}</ListBucketResult>"
+            )
+        next_marker = (
+            f"<NextMarker>{escape(res.next_marker)}</NextMarker>"
+            if res.is_truncated and delimiter
+            else ""
+        )
+        return _xml(
+            f'<ListBucketResult xmlns="{XML_NS}">'
+            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+            f"<Marker>{escape(q.get('marker', ''))}</Marker>"
+            f"<MaxKeys>{max_keys}</MaxKeys><Delimiter>{escape(delimiter)}</Delimiter>"
+            f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
+            f"{next_marker}{contents}{prefixes}</ListBucketResult>"
+        )
+
+    def _list_versions(self, bucket: str, q) -> web.Response:
+        prefix = q.get("prefix", "")
+        delimiter = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", "1000"))
+        res = self.layer.list_object_versions(
+            bucket,
+            prefix,
+            q.get("key-marker", ""),
+            q.get("version-id-marker", ""),
+            delimiter,
+            max_keys,
+        )
+        entries = []
+        for o in res.objects:
+            vid = o.version_id or "null"
+            if o.delete_marker:
+                entries.append(
+                    f"<DeleteMarker><Key>{escape(o.name)}</Key><VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{'true' if o.is_latest else 'false'}</IsLatest>"
+                    f"<LastModified>{_iso(o.mod_time)}</LastModified></DeleteMarker>"
+                )
+            else:
+                entries.append(
+                    f"<Version><Key>{escape(o.name)}</Key><VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{'true' if o.is_latest else 'false'}</IsLatest>"
+                    f"<LastModified>{_iso(o.mod_time)}</LastModified>"
+                    f"<ETag>&quot;{o.etag}&quot;</ETag><Size>{o.size}</Size>"
+                    f"<StorageClass>{o.storage_class}</StorageClass></Version>"
+                )
+        prefixes = "".join(
+            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>" for p in res.prefixes
+        )
+        return _xml(
+            f'<ListVersionsResult xmlns="{XML_NS}">'
+            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
+            f"{''.join(entries)}{prefixes}</ListVersionsResult>"
+        )
+
+    def _bulk_delete(self, bucket: str, body: bytes) -> web.Response:
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        quiet = (root.findtext("Quiet") or root.findtext(f"{{{XML_NS}}}Quiet") or "").lower() == "true"
+        objects: list[tuple[str, str]] = []
+        for obj in root.iter():
+            if obj.tag.split("}")[-1] == "Object":
+                kv = {c.tag.split("}")[-1]: (c.text or "") for c in obj}
+                if "Key" in kv:
+                    objects.append((kv["Key"], kv.get("VersionId", "")))
+        versioned = self.bucket_meta.get(bucket).versioning_enabled()
+        results = self.layer.delete_objects(bucket, objects, versioned=versioned)
+        parts = []
+        for (name, vid), (oi, err) in zip(objects, results):
+            if err is None:
+                if not quiet:
+                    parts.append(f"<Deleted><Key>{escape(name)}</Key></Deleted>")
+            else:
+                s3e = from_object_error(err, bucket, name)
+                parts.append(
+                    f"<Error><Key>{escape(name)}</Key><Code>{s3e.code}</Code>"
+                    f"<Message>{escape(s3e.message)}</Message></Error>"
+                )
+        return _xml(f'<DeleteResult xmlns="{XML_NS}">{"".join(parts)}</DeleteResult>')
+
+    # -- object ---------------------------------------------------------------
+
+    async def _object_op(
+        self, request: web.Request, bucket: str, key: str, body: bytes
+    ) -> web.Response:
+        m = request.method
+        q = request.rel_url.query
+        if m == "PUT":
+            if "x-amz-copy-source" in request.headers:
+                return await asyncio.to_thread(
+                    self._copy_object, bucket, key, request.headers["x-amz-copy-source"], request
+                )
+            return await asyncio.to_thread(self._put_object, bucket, key, body, request)
+        if m in ("GET", "HEAD"):
+            return await asyncio.to_thread(self._get_object, bucket, key, request, m == "HEAD")
+        if m == "DELETE":
+            return await asyncio.to_thread(self._delete_object, bucket, key, q)
+        raise S3Error("MethodNotAllowed")
+
+    def _put_opts(self, bucket: str, request: web.Request) -> PutObjectOptions:
+        meta = self.bucket_meta.get(bucket)
+        user_defined = {
+            k.lower(): v
+            for k, v in request.headers.items()
+            if k.lower().startswith("x-amz-meta-")
+        }
+        for h in ("cache-control", "content-disposition", "content-encoding", "content-language"):
+            if h in request.headers:
+                user_defined[h] = request.headers[h]
+        return PutObjectOptions(
+            user_defined=user_defined,
+            versioned=meta.versioning_enabled(),
+            content_type=request.headers.get("Content-Type", "application/octet-stream"),
+        )
+
+    def _put_object(self, bucket: str, key: str, body: bytes, request: web.Request) -> web.Response:
+        if len(body) > MAX_OBJECT_SIZE:
+            raise S3Error("EntityTooLarge")
+        if "Content-Md5" in request.headers:
+            want = base64.b64decode(request.headers["Content-Md5"])
+            if hashlib.md5(body).digest() != want:
+                raise S3Error("BadDigest")
+        opts = self._put_opts(bucket, request)
+        oi = self.layer.put_object(bucket, key, body, opts)
+        headers = {"ETag": f'"{oi.etag}"'}
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        self._emit("s3:ObjectCreated:Put", bucket, oi)
+        return web.Response(status=200, headers=headers)
+
+    def _copy_object(
+        self, bucket: str, key: str, source: str, request: web.Request
+    ) -> web.Response:
+        src = urllib.parse.unquote(source)
+        if src.startswith("/"):
+            src = src[1:]
+        vid = ""
+        if "?versionId=" in src:
+            src, vid = src.split("?versionId=", 1)
+        if "/" not in src:
+            raise S3Error("InvalidArgument", "bad copy source")
+        src_bucket, src_key = src.split("/", 1)
+        src_oi, data = self.layer.get_object(src_bucket, src_key, GetObjectOptions(vid))
+        opts = self._put_opts(bucket, request)
+        if request.headers.get("x-amz-metadata-directive", "COPY") == "COPY":
+            opts.user_defined = dict(src_oi.user_defined)
+            opts.content_type = src_oi.content_type
+        oi = self.layer.put_object(bucket, key, data, opts)
+        self._emit("s3:ObjectCreated:Copy", bucket, oi)
+        return _xml(
+            f'<CopyObjectResult xmlns="{XML_NS}">'
+            f"<LastModified>{_iso(oi.mod_time)}</LastModified>"
+            f"<ETag>&quot;{oi.etag}&quot;</ETag></CopyObjectResult>"
+        )
+
+    def _object_headers(self, oi: ObjectInfo) -> dict[str, str]:
+        headers = {
+            "ETag": f'"{oi.etag}"',
+            "Last-Modified": _http_date(oi.mod_time),
+            "Content-Type": oi.content_type,
+            "Accept-Ranges": "bytes",
+        }
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        for k, v in oi.user_defined.items():
+            headers[k] = v
+        return headers
+
+    def _get_object(
+        self, bucket: str, key: str, request: web.Request, head: bool
+    ) -> web.Response:
+        vid = request.rel_url.query.get("versionId", "")
+        if vid == "null":
+            vid = ""
+        opts = GetObjectOptions(version_id=vid)
+        rng = request.headers.get("Range", "")
+        try:
+            if head:
+                oi = self.layer.get_object_info(bucket, key, opts)
+                headers = self._object_headers(oi)
+                headers["Content-Length"] = str(oi.size)
+                return web.Response(status=200, headers=headers)
+            offset, length = 0, -1
+            if rng:
+                offset, length, total_needed = _parse_range(rng)
+            oi, data = self.layer.get_object(bucket, key, opts, offset=offset, length=length)
+            if rng and offset >= oi.size and oi.size > 0:
+                raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
+            headers = self._object_headers(oi)
+            status = 200
+            if rng:
+                end = offset + len(data) - 1
+                headers["Content-Range"] = f"bytes {offset}-{end}/{oi.size}"
+                status = 206
+            # Conditional requests.
+            inm = request.headers.get("If-None-Match", "")
+            if inm and inm.strip('"') == oi.etag:
+                return web.Response(status=304, headers={"ETag": f'"{oi.etag}"'})
+            im = request.headers.get("If-Match", "")
+            if im and im.strip('"') != oi.etag:
+                raise S3Error("PreconditionFailed", resource=f"/{bucket}/{key}")
+            return web.Response(status=status, body=data, headers=headers)
+        except oerr.MethodNotAllowed:
+            # GET on a delete marker by version id.
+            return web.Response(status=405, headers={"x-amz-delete-marker": "true"})
+
+    def _delete_object(self, bucket: str, key: str, q) -> web.Response:
+        vid = q.get("versionId", "")
+        if vid == "null":
+            vid = ""
+        meta = self.bucket_meta.get(bucket)
+        opts = DeleteObjectOptions(version_id=vid, versioned=meta.versioning_enabled())
+        oi = self.layer.delete_object(bucket, key, opts)
+        headers = {}
+        if oi.delete_marker:
+            headers["x-amz-delete-marker"] = "true"
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        self._emit("s3:ObjectRemoved:Delete", bucket, oi)
+        return web.Response(status=204, headers=headers)
+
+    def _emit(self, event_name: str, bucket: str, oi: ObjectInfo) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(event_name, bucket, oi)
+            except Exception:
+                pass
+
+
+def _parse_range(rng: str) -> tuple[int, int, bool]:
+    """Parse 'bytes=a-b' into (offset, length)."""
+    if not rng.startswith("bytes="):
+        raise S3Error("InvalidArgument", "bad range")
+    spec = rng[len("bytes=") :]
+    if "," in spec:
+        raise S3Error("NotImplemented", "multiple ranges")
+    start_s, _, end_s = spec.partition("-")
+    if start_s == "":
+        # suffix range: last N bytes -- handled by caller via negative offset
+        raise S3Error("NotImplemented", "suffix ranges")
+    start = int(start_s)
+    if end_s == "":
+        return start, -1, True
+    end = int(end_s)
+    if end < start:
+        raise S3Error("InvalidArgument", "bad range")
+    return start, end - start + 1, True
+
+
+# -- serving ------------------------------------------------------------------
+
+
+def run_server(server: S3Server, host: str = "127.0.0.1", port: int = 9000) -> None:
+    web.run_app(server.app, host=host, port=port, print=None)
+
+
+class ThreadedServer:
+    """Run the API server on a background thread (tests + embedded use).
+
+    The analogue of the reference's httptest-based TestServer
+    (cmd/test-utils_test.go:290)."""
+
+    def __init__(self, server: S3Server, host: str = "127.0.0.1", port: int = 0):
+        self.server = server
+        self.host = host
+        self.port = port
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._thread = None
+        self._started = None
+
+    def start(self) -> str:
+        import threading
+
+        self._started = threading.Event()
+
+        def run():
+            loop = asyncio.new_event_loop()
+            self._loop = loop
+            asyncio.set_event_loop(loop)
+
+            async def setup():
+                runner = web.AppRunner(self.server.app)
+                await runner.setup()
+                site = web.TCPSite(runner, self.host, self.port)
+                await site.start()
+                self.port = runner.addresses[0][1]
+                self._runner = runner
+                self._started.set()
+
+            loop.run_until_complete(setup())
+            loop.run_forever()
+
+        self._thread = __import__("threading").Thread(target=run, daemon=True)
+        self._thread.start()
+        self._started.wait(10)
+        return f"http://{self.host}:{self.port}"
+
+    def stop(self) -> None:
+        if self._loop is not None:
+            loop = self._loop
+
+            async def teardown():
+                await self._runner.cleanup()
+                loop.stop()
+
+            asyncio.run_coroutine_threadsafe(teardown(), loop)
+            self._thread.join(5)

@@ -1,0 +1,219 @@
+"""IAM subsystem: users, service accounts, policies, persistence.
+
+Role of the reference's IAMSys (cmd/iam.go:62, iam-store.go): credential +
+policy store with an in-memory cache, persisted under the system meta bucket
+(.minio_tpu.sys/config/iam/) through the object layer so it survives restarts
+and replicates with the cluster. STS temporary credentials layer on top
+(api/sts.py).
+"""
+
+from __future__ import annotations
+
+import json
+import secrets
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ..api.auth import Credentials
+from ..utils import errors
+from . import policy as policy_mod
+
+IAM_PREFIX = "config/iam"
+
+
+@dataclass
+class UserIdentity:
+    credentials: Credentials
+    status: str = "enabled"
+    policies: list[str] = field(default_factory=list)
+    groups: list[str] = field(default_factory=list)
+    # Service accounts / STS creds:
+    parent_user: str = ""
+    session_policy: dict | None = None
+    expiration: float = 0.0  # 0 = never
+
+    def expired(self) -> bool:
+        return self.expiration > 0 and time.time() > self.expiration
+
+    def to_dict(self, with_secret: bool = True) -> dict:
+        return {
+            "accessKey": self.credentials.access_key,
+            "secretKey": self.credentials.secret_key if with_secret else "",
+            "status": self.status,
+            "policies": self.policies,
+            "groups": self.groups,
+            "parentUser": self.parent_user,
+            "sessionPolicy": self.session_policy,
+            "expiration": self.expiration,
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "UserIdentity":
+        return cls(
+            credentials=Credentials(d["accessKey"], d.get("secretKey", "")),
+            status=d.get("status", "enabled"),
+            policies=list(d.get("policies", [])),
+            groups=list(d.get("groups", [])),
+            parent_user=d.get("parentUser", ""),
+            session_policy=d.get("sessionPolicy"),
+            expiration=d.get("expiration", 0.0),
+        )
+
+
+class IAMSys:
+    """In-memory IAM store with optional persistence via a store backend."""
+
+    def __init__(self, root_user: str, root_password: str, store=None):
+        self.root = Credentials(root_user, root_password)
+        self.users: dict[str, UserIdentity] = {}
+        self.group_policies: dict[str, list[str]] = {}
+        self.custom_policies: dict[str, dict] = {}
+        self.store = store  # object-layer-backed persistence (control/configsys)
+        self._lock = threading.RLock()
+
+    # -- persistence ---------------------------------------------------------
+
+    def load(self) -> None:
+        if self.store is None:
+            return
+        raw = self.store.get(f"{IAM_PREFIX}/users.json")
+        if raw:
+            data = json.loads(raw)
+            with self._lock:
+                self.users = {k: UserIdentity.from_dict(v) for k, v in data.items()}
+        raw = self.store.get(f"{IAM_PREFIX}/policies.json")
+        if raw:
+            self.custom_policies = json.loads(raw)
+
+    def _persist(self) -> None:
+        if self.store is None:
+            return
+        with self._lock:
+            users = {k: v.to_dict() for k, v in self.users.items()}
+        self.store.put(f"{IAM_PREFIX}/users.json", json.dumps(users).encode())
+        self.store.put(
+            f"{IAM_PREFIX}/policies.json", json.dumps(self.custom_policies).encode()
+        )
+
+    # -- credential lookup (hot path for SigV4) ------------------------------
+
+    def lookup(self, access_key: str) -> Credentials | None:
+        if access_key == self.root.access_key:
+            return self.root
+        with self._lock:
+            ident = self.users.get(access_key)
+        if ident is None or ident.status != "enabled" or ident.expired():
+            return None
+        return ident.credentials
+
+    # -- user management (admin API surface) ---------------------------------
+
+    def add_user(self, access_key: str, secret_key: str, policies: list[str] | None = None):
+        with self._lock:
+            self.users[access_key] = UserIdentity(
+                Credentials(access_key, secret_key), policies=policies or []
+            )
+        self._persist()
+
+    def remove_user(self, access_key: str) -> None:
+        with self._lock:
+            if access_key not in self.users:
+                raise errors.InvalidArgument(msg=f"no such user {access_key}")
+            del self.users[access_key]
+        self._persist()
+
+    def set_user_status(self, access_key: str, status: str) -> None:
+        with self._lock:
+            if access_key not in self.users:
+                raise errors.InvalidArgument(msg=f"no such user {access_key}")
+            self.users[access_key].status = status
+        self._persist()
+
+    def list_users(self) -> dict[str, UserIdentity]:
+        with self._lock:
+            return dict(self.users)
+
+    def attach_policy(self, access_key: str, policy_names: list[str]) -> None:
+        with self._lock:
+            if access_key not in self.users:
+                raise errors.InvalidArgument(msg=f"no such user {access_key}")
+            self.users[access_key].policies = list(policy_names)
+        self._persist()
+
+    def set_policy(self, name: str, doc: dict) -> None:
+        self.custom_policies[name] = doc
+        self._persist()
+
+    def delete_policy(self, name: str) -> None:
+        self.custom_policies.pop(name, None)
+        self._persist()
+
+    def new_service_account(
+        self, parent: str, session_policy: dict | None = None
+    ) -> Credentials:
+        ak = "SA" + secrets.token_hex(8).upper()
+        sk = secrets.token_urlsafe(30)
+        with self._lock:
+            self.users[ak] = UserIdentity(
+                Credentials(ak, sk), parent_user=parent, session_policy=session_policy
+            )
+        self._persist()
+        return Credentials(ak, sk)
+
+    def new_sts_credentials(
+        self, parent: str, duration_seconds: int, session_policy: dict | None = None
+    ) -> tuple[Credentials, float]:
+        ak = "STS" + secrets.token_hex(8).upper()
+        sk = secrets.token_urlsafe(30)
+        exp = time.time() + duration_seconds
+        with self._lock:
+            self.users[ak] = UserIdentity(
+                Credentials(ak, sk),
+                parent_user=parent,
+                session_policy=session_policy,
+                expiration=exp,
+            )
+        return Credentials(ak, sk), exp
+
+    # -- authorization -------------------------------------------------------
+
+    def _policy_doc(self, name: str) -> dict | None:
+        if name in self.custom_policies:
+            return self.custom_policies[name]
+        return policy_mod.CANNED.get(name)
+
+    def is_allowed(self, access_key: str, action: str, resource: str) -> bool:
+        """Policy evaluation (IAMSys.IsAllowed equivalent)."""
+        if access_key == self.root.access_key:
+            return True  # root owner bypasses policy, as in the reference
+        with self._lock:
+            ident = self.users.get(access_key)
+        if ident is None or ident.status != "enabled" or ident.expired():
+            return False
+        names = list(ident.policies)
+        subject = ident
+        # Service accounts / STS inherit the parent's policies, optionally
+        # narrowed by a session policy.
+        if ident.parent_user:
+            if ident.parent_user == self.root.access_key:
+                parent_allowed = True
+            else:
+                with self._lock:
+                    parent = self.users.get(ident.parent_user)
+                if parent is None:
+                    return False
+                names = list(parent.policies)
+                parent_allowed = self._eval(names, action, resource)
+            if ident.session_policy is not None:
+                sp = policy_mod.Policy.from_dict(ident.session_policy)
+                return parent_allowed and sp.is_allowed(action, resource)
+            return parent_allowed
+        return self._eval(names, action, resource)
+
+    def _eval(self, names: list[str], action: str, resource: str) -> bool:
+        for name in names:
+            doc = self._policy_doc(name)
+            if doc and policy_mod.Policy.from_dict(doc).is_allowed(action, resource):
+                return True
+        return False

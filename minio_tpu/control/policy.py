@@ -1,0 +1,144 @@
+"""IAM policy documents and evaluation.
+
+Role of the reference's policy engine (minio/pkg/iam/policy used from
+cmd/iam.go): JSON policy documents with Effect/Action/Resource statements,
+wildcard matching, evaluated per request. Covers the S3 action namespace for
+the implemented API; condition keys can layer on later.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+from dataclasses import dataclass, field
+
+# Canned policies (the reference ships the same set).
+READ_ONLY = {
+    "Version": "2012-10-17",
+    "Statement": [
+        {
+            "Effect": "Allow",
+            "Action": ["s3:GetBucketLocation", "s3:GetObject", "s3:ListBucket"],
+            "Resource": ["arn:aws:s3:::*"],
+        }
+    ],
+}
+WRITE_ONLY = {
+    "Version": "2012-10-17",
+    "Statement": [
+        {"Effect": "Allow", "Action": ["s3:PutObject"], "Resource": ["arn:aws:s3:::*"]}
+    ],
+}
+READ_WRITE = {
+    "Version": "2012-10-17",
+    "Statement": [{"Effect": "Allow", "Action": ["s3:*"], "Resource": ["arn:aws:s3:::*"]}],
+}
+ADMIN_ALL = {
+    "Version": "2012-10-17",
+    "Statement": [{"Effect": "Allow", "Action": ["admin:*", "s3:*"], "Resource": ["arn:aws:s3:::*"]}],
+}
+
+CANNED = {
+    "readonly": READ_ONLY,
+    "writeonly": WRITE_ONLY,
+    "readwrite": READ_WRITE,
+    "consoleAdmin": ADMIN_ALL,
+}
+
+
+@dataclass
+class Statement:
+    effect: str  # "Allow" | "Deny"
+    actions: list[str]
+    resources: list[str]
+    conditions: dict = field(default_factory=dict)
+
+    def matches_action(self, action: str) -> bool:
+        return any(fnmatch.fnmatchcase(action, pat) for pat in self.actions)
+
+    def matches_resource(self, resource: str) -> bool:
+        if not self.resources:
+            return True
+        return any(
+            fnmatch.fnmatchcase(resource, pat) or fnmatch.fnmatchcase(resource + "/", pat)
+            for pat in self.resources
+        )
+
+
+@dataclass
+class Policy:
+    statements: list[Statement]
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "Policy":
+        stmts = []
+        raw = doc.get("Statement", [])
+        if isinstance(raw, dict):
+            raw = [raw]
+        for s in raw:
+            actions = s.get("Action", [])
+            if isinstance(actions, str):
+                actions = [actions]
+            resources = s.get("Resource", [])
+            if isinstance(resources, str):
+                resources = [resources]
+            stmts.append(
+                Statement(
+                    effect=s.get("Effect", "Deny"),
+                    actions=list(actions),
+                    resources=list(resources),
+                    conditions=s.get("Condition", {}),
+                )
+            )
+        return cls(stmts)
+
+    @classmethod
+    def from_json(cls, raw: str | bytes) -> "Policy":
+        return cls.from_dict(json.loads(raw))
+
+    def is_allowed(self, action: str, resource: str) -> bool:
+        """Deny overrides allow; default deny."""
+        allowed = False
+        for s in self.statements:
+            if s.matches_action(action) and s.matches_resource(resource):
+                if s.effect == "Deny":
+                    return False
+                allowed = True
+        return allowed
+
+
+def resource_arn(bucket: str, key: str = "") -> str:
+    return f"arn:aws:s3:::{bucket}/{key}" if key else f"arn:aws:s3:::{bucket}"
+
+
+# HTTP method+query -> s3 action mapping used by the API layer.
+def s3_action(method: str, bucket: str, key: str, query: dict[str, str]) -> str:
+    if not bucket:
+        return "s3:ListAllMyBuckets"
+    if key:
+        if method in ("GET", "HEAD"):
+            return "s3:GetObject"
+        if method == "PUT":
+            return "s3:PutObject"
+        if method == "DELETE":
+            return "s3:DeleteObject"
+        if method == "POST":
+            return "s3:PutObject"
+    else:
+        if method == "GET" or method == "HEAD":
+            if "versions" in query:
+                return "s3:ListBucketVersions"
+            return "s3:ListBucket"
+        if method == "PUT":
+            if "policy" in query:
+                return "s3:PutBucketPolicy"
+            if "versioning" in query:
+                return "s3:PutBucketVersioning"
+            return "s3:CreateBucket"
+        if method == "DELETE":
+            if "policy" in query:
+                return "s3:DeleteBucketPolicy"
+            return "s3:DeleteBucket"
+        if method == "POST" and "delete" in query:
+            return "s3:DeleteObject"
+    return "s3:*"

@@ -1,0 +1,71 @@
+"""Minimal SigV4-signing S3 test client (requests-based).
+
+Plays the role of the reference's signed-request test helpers
+(cmd/test-utils_test.go newTestSignedRequestV4): every call is a properly
+V4-signed HTTP request against the in-process server.
+"""
+
+from __future__ import annotations
+
+import urllib.parse
+
+import requests
+
+from minio_tpu.api.auth import Credentials, sign_request
+
+
+class S3TestClient:
+    def __init__(self, endpoint: str, access_key: str, secret_key: str, region="us-east-1"):
+        self.endpoint = endpoint.rstrip("/")
+        self.creds = Credentials(access_key, secret_key)
+        self.region = region
+        self.host = urllib.parse.urlparse(self.endpoint).netloc
+        self.session = requests.Session()
+
+    def request(
+        self,
+        method: str,
+        path: str,
+        query: list[tuple[str, str]] | None = None,
+        body: bytes = b"",
+        headers: dict[str, str] | None = None,
+        anonymous: bool = False,
+    ) -> requests.Response:
+        query = query or []
+        headers = dict(headers or {})
+        url = self.endpoint + urllib.parse.quote(path)
+        if query:
+            url += "?" + urllib.parse.urlencode(query)
+        if not anonymous:
+            headers["host"] = self.host
+            headers = sign_request(
+                self.creds, method, path, query, headers, body, region=self.region
+            )
+            headers.pop("host")
+        return self.session.request(method, url, data=body, headers=headers)
+
+    # Convenience wrappers -----------------------------------------------
+
+    def make_bucket(self, bucket: str):
+        return self.request("PUT", f"/{bucket}")
+
+    def delete_bucket(self, bucket: str):
+        return self.request("DELETE", f"/{bucket}")
+
+    def head_bucket(self, bucket: str):
+        return self.request("HEAD", f"/{bucket}")
+
+    def put_object(self, bucket: str, key: str, data: bytes, headers=None):
+        return self.request("PUT", f"/{bucket}/{key}", body=data, headers=headers)
+
+    def get_object(self, bucket: str, key: str, headers=None, query=None):
+        return self.request("GET", f"/{bucket}/{key}", headers=headers, query=query)
+
+    def head_object(self, bucket: str, key: str):
+        return self.request("HEAD", f"/{bucket}/{key}")
+
+    def delete_object(self, bucket: str, key: str, query=None):
+        return self.request("DELETE", f"/{bucket}/{key}", query=query)
+
+    def list_objects(self, bucket: str, **params):
+        return self.request("GET", f"/{bucket}", query=list(params.items()))

@@ -1,0 +1,268 @@
+"""End-to-end S3 API tests: signed HTTP against the in-process server.
+
+The analogue of the reference's server_test.go (~100 signed S3 scenarios
+against an httptest server, cmd/server_test.go + test-utils_test.go:290):
+boots the full stack (HTTP router -> auth -> object layer -> 16 temp-dir
+drives) and exercises the S3 wire protocol.
+"""
+
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from minio_tpu.api.server import S3Server, ThreadedServer
+from minio_tpu.control.iam import IAMSys
+from tests.harness import ErasureHarness
+from tests.s3client import S3TestClient
+
+ROOT_AK = "minioadmin"
+ROOT_SK = "minioadmin-secret"
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+@pytest.fixture(scope="module")
+def stack(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("s3api")
+    hz = ErasureHarness(tmp, n_disks=8)
+    from minio_tpu.object.pools import ServerPools
+    from minio_tpu.object.sets import ErasureSets
+
+    layer = ServerPools([ErasureSets([d for d in hz.drives], 8)])
+    iam = IAMSys(ROOT_AK, ROOT_SK)
+    srv = S3Server(layer, iam, check_skew=False)
+    ts = ThreadedServer(srv)
+    endpoint = ts.start()
+    client = S3TestClient(endpoint, ROOT_AK, ROOT_SK)
+    yield {"client": client, "endpoint": endpoint, "iam": iam, "server": srv, "layer": layer}
+    ts.stop()
+
+
+@pytest.fixture
+def client(stack):
+    return stack["client"]
+
+
+def _fresh_bucket(client, name):
+    client.delete_bucket(name)
+    r = client.make_bucket(name)
+    assert r.status_code == 200, r.text
+    return name
+
+
+class TestBuckets:
+    def test_bucket_lifecycle(self, client):
+        r = client.make_bucket("apibucket")
+        assert r.status_code == 200
+        assert client.head_bucket("apibucket").status_code == 200
+        # ListBuckets contains it.
+        r = client.request("GET", "/")
+        assert r.status_code == 200
+        names = [e.text for e in ET.fromstring(r.content).iter(f"{NS}Name")]
+        assert "apibucket" in names
+        # Double create conflicts.
+        assert client.make_bucket("apibucket").status_code == 409
+        assert client.delete_bucket("apibucket").status_code == 204
+        assert client.head_bucket("apibucket").status_code == 404
+
+    def test_invalid_bucket_name(self, client):
+        r = client.make_bucket("AB")
+        assert r.status_code == 400
+        assert b"InvalidBucketName" in r.content
+
+    def test_location(self, client):
+        _fresh_bucket(client, "locbucket")
+        r = client.request("GET", "/locbucket", query=[("location", "")])
+        assert r.status_code == 200
+        assert b"LocationConstraint" in r.content
+
+
+class TestObjects:
+    def test_put_get_roundtrip(self, client):
+        _fresh_bucket(client, "objb")
+        data = b"hello s3 world" * 1000
+        r = client.put_object("objb", "dir/key.txt", data, headers={"Content-Type": "text/plain"})
+        assert r.status_code == 200, r.text
+        etag = r.headers["ETag"]
+        r = client.get_object("objb", "dir/key.txt")
+        assert r.status_code == 200
+        assert r.content == data
+        assert r.headers["ETag"] == etag
+        assert r.headers["Content-Type"] == "text/plain"
+        r = client.head_object("objb", "dir/key.txt")
+        assert r.status_code == 200
+        assert int(r.headers["Content-Length"]) == len(data)
+        assert client.delete_object("objb", "dir/key.txt").status_code == 204
+        assert client.get_object("objb", "dir/key.txt").status_code == 404
+
+    def test_missing_key_and_bucket(self, client):
+        _fresh_bucket(client, "objb2")
+        r = client.get_object("objb2", "missing")
+        assert r.status_code == 404
+        assert b"NoSuchKey" in r.content
+        r = client.get_object("nonexistentbkt", "k")
+        assert r.status_code == 404
+        assert b"NoSuchBucket" in r.content
+
+    def test_user_metadata(self, client):
+        _fresh_bucket(client, "metab")
+        client.put_object("metab", "k", b"x", headers={"x-amz-meta-owner": "tester"})
+        r = client.head_object("metab", "k")
+        assert r.headers.get("x-amz-meta-owner") == "tester"
+
+    def test_range_request(self, client):
+        _fresh_bucket(client, "rangeb")
+        data = bytes(range(256)) * 10
+        client.put_object("rangeb", "r", data)
+        r = client.get_object("rangeb", "r", headers={"Range": "bytes=10-19"})
+        assert r.status_code == 206
+        assert r.content == data[10:20]
+        assert r.headers["Content-Range"] == f"bytes 10-19/{len(data)}"
+
+    def test_copy_object(self, client):
+        _fresh_bucket(client, "copyb")
+        client.put_object("copyb", "src", b"copy-me", headers={"x-amz-meta-tag": "v"})
+        r = client.request(
+            "PUT", "/copyb/dst", headers={"x-amz-copy-source": "/copyb/src"}
+        )
+        assert r.status_code == 200
+        assert b"CopyObjectResult" in r.content
+        r = client.get_object("copyb", "dst")
+        assert r.content == b"copy-me"
+        assert r.headers.get("x-amz-meta-tag") == "v"
+
+    def test_content_md5_check(self, client):
+        _fresh_bucket(client, "md5b")
+        import base64, hashlib
+
+        good = base64.b64encode(hashlib.md5(b"data").digest()).decode()
+        assert client.put_object("md5b", "k", b"data", headers={"Content-Md5": good}).status_code == 200
+        bad = base64.b64encode(hashlib.md5(b"other").digest()).decode()
+        r = client.put_object("md5b", "k2", b"data", headers={"Content-Md5": bad})
+        assert r.status_code == 400
+        assert b"BadDigest" in r.content
+
+    def test_conditional_get(self, client):
+        _fresh_bucket(client, "condb")
+        etag = client.put_object("condb", "k", b"v").headers["ETag"]
+        r = client.get_object("condb", "k", headers={"If-None-Match": etag})
+        assert r.status_code == 304
+        r = client.get_object("condb", "k", headers={"If-Match": '"wrong"'})
+        assert r.status_code == 412
+
+
+class TestListing:
+    def test_list_v1_and_v2(self, client):
+        _fresh_bucket(client, "listb")
+        for k in ["a.txt", "b/one", "b/two", "c.txt"]:
+            client.put_object("listb", k, b"x")
+        r = client.list_objects("listb")
+        root = ET.fromstring(r.content)
+        keys = [e.text for e in root.iter(f"{NS}Key")]
+        assert keys == ["a.txt", "b/one", "b/two", "c.txt"]
+        r = client.list_objects("listb", **{"list-type": "2", "delimiter": "/"})
+        root = ET.fromstring(r.content)
+        keys = [e.text for e in root.iter(f"{NS}Key")]
+        assert keys == ["a.txt", "c.txt"]
+        prefixes = [e.text for e in root.iter(f"{NS}Prefix") if e.text and e.text != ""]
+        assert "b/" in prefixes
+        assert root.find(f"{NS}KeyCount").text == "3"
+
+    def test_bulk_delete(self, client):
+        _fresh_bucket(client, "bulkb")
+        for i in range(3):
+            client.put_object("bulkb", f"k{i}", b"x")
+        body = (
+            '<Delete><Object><Key>k0</Key></Object>'
+            "<Object><Key>k1</Key></Object><Object><Key>k2</Key></Object></Delete>"
+        ).encode()
+        r = client.request("POST", "/bulkb", query=[("delete", "")], body=body)
+        assert r.status_code == 200
+        assert r.content.count(b"<Deleted>") == 3
+        assert len(ET.fromstring(client.list_objects("bulkb").content).findall(f"{NS}Contents")) == 0
+
+
+class TestVersioning:
+    def test_versioning_flow(self, client):
+        _fresh_bucket(client, "verb")
+        cfg = f'<VersioningConfiguration xmlns="{NS[1:-1]}"><Status>Enabled</Status></VersioningConfiguration>'
+        r = client.request("PUT", "/verb", query=[("versioning", "")], body=cfg.encode())
+        assert r.status_code == 200, r.text
+        r = client.request("GET", "/verb", query=[("versioning", "")])
+        assert b"Enabled" in r.content
+        v1 = client.put_object("verb", "obj", b"one").headers.get("x-amz-version-id")
+        v2 = client.put_object("verb", "obj", b"two").headers.get("x-amz-version-id")
+        assert v1 and v2 and v1 != v2
+        assert client.get_object("verb", "obj").content == b"two"
+        r = client.get_object("verb", "obj", query=[("versionId", v1)])
+        assert r.content == b"one"
+        # Delete -> marker; older versions still reachable.
+        r = client.delete_object("verb", "obj")
+        assert r.status_code == 204
+        assert r.headers.get("x-amz-delete-marker") == "true"
+        assert client.get_object("verb", "obj").status_code == 404
+        assert client.get_object("verb", "obj", query=[("versionId", v2)]).content == b"two"
+        # List versions shows marker + 2 versions.
+        r = client.request("GET", "/verb", query=[("versions", "")])
+        root = ET.fromstring(r.content)
+        assert len(root.findall(f"{NS}Version")) == 2
+        assert len(root.findall(f"{NS}DeleteMarker")) == 1
+
+
+class TestAuth:
+    def test_bad_secret_rejected(self, stack):
+        bad = S3TestClient(stack["endpoint"], ROOT_AK, "wrong-secret")
+        r = bad.request("GET", "/")
+        assert r.status_code == 403
+        assert b"SignatureDoesNotMatch" in r.content
+
+    def test_unknown_access_key(self, stack):
+        bad = S3TestClient(stack["endpoint"], "no-such-key", "x")
+        r = bad.request("GET", "/")
+        assert r.status_code == 403
+        assert b"InvalidAccessKeyId" in r.content
+
+    def test_anonymous_denied(self, stack, client):
+        _fresh_bucket(client, "authb")
+        client.put_object("authb", "k", b"secret")
+        anon = S3TestClient(stack["endpoint"], "", "")
+        r = anon.request("GET", "/authb/k", anonymous=True)
+        assert r.status_code == 403
+
+    def test_anonymous_allowed_by_policy(self, stack, client):
+        _fresh_bucket(client, "pubbkt")
+        client.put_object("pubbkt", "k", b"public-data")
+        policy = (
+            '{"Version":"2012-10-17","Statement":[{"Effect":"Allow","Principal":"*",'
+            '"Action":["s3:GetObject"],"Resource":["arn:aws:s3:::pubbkt/*"]}]}'
+        )
+        r = stack["client"].request("PUT", "/pubbkt", query=[("policy", "")], body=policy.encode())
+        assert r.status_code == 204, r.text
+        anon = S3TestClient(stack["endpoint"], "", "")
+        assert anon.request("GET", "/pubbkt/k", anonymous=True).content == b"public-data"
+        # Write still denied.
+        assert anon.request("PUT", "/pubbkt/new", body=b"x", anonymous=True).status_code == 403
+
+    def test_iam_user_policies(self, stack, client):
+        _fresh_bucket(client, "iamb")
+        client.put_object("iamb", "k", b"data")
+        stack["iam"].add_user("reader", "reader-secret-key", ["readonly"])
+        reader = S3TestClient(stack["endpoint"], "reader", "reader-secret-key")
+        assert reader.get_object("iamb", "k").status_code == 200
+        assert reader.put_object("iamb", "new", b"x").status_code == 403
+        stack["iam"].set_user_status("reader", "disabled")
+        assert reader.get_object("iamb", "k").status_code == 403
+
+    def test_presigned_url(self, stack, client):
+        import requests as rq
+
+        _fresh_bucket(client, "presb")
+        client.put_object("presb", "k", b"presigned-data")
+        url = stack["server"].verifier.presign_url(
+            client.creds, "GET", "/presb/k", [], client.host
+        )
+        r = rq.get(url)
+        assert r.status_code == 200, r.text
+        assert r.content == b"presigned-data"
+        # Tampered signature fails.
+        r = rq.get(url[:-4] + "0000")
+        assert r.status_code == 403
