@@ -1,0 +1,140 @@
+"""Benchmark: erasure-encode throughput, 12+4 @ 1 MiB blocks (BASELINE.md #1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+  value       = device (TPU) Reed-Solomon encode GiB/s over a 64-block batch,
+                data-bytes counted (the reference benchmark convention,
+                cmd/erasure-encode_test.go b.SetBytes).
+  vs_baseline = value / CPU-AVX2 GiB/s measured on this machine with the
+                native C++ kernel (native/minio_native.cpp) across all cores
+                -- the stand-in for klauspost/reedsolomon's AVX2 path, same
+                nibble-table algorithm the Go assembly uses.
+
+Run directly on the bench machine: python bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+K, M = 12, 4
+BLOCK = 1 << 20
+BATCH = 64
+SHARD = -(-BLOCK // K)
+ITERS = 20
+
+
+def cpu_baseline_gibs(blocks: np.ndarray) -> float:
+    """Multi-core AVX2 encode throughput (data GiB/s)."""
+    from minio_tpu.ops import native, rs_matrix
+
+    if not native.available():
+        return 0.0
+    pm = np.ascontiguousarray(rs_matrix.parity_matrix(K, M))
+    nproc = os.cpu_count() or 1
+    pool = ThreadPoolExecutor(max_workers=nproc)
+
+    def enc(i):
+        native.rs_encode(blocks[i], pm)
+
+    # Warmup.
+    list(pool.map(enc, range(len(blocks))))
+    t0 = time.perf_counter()
+    n_iters = max(4, ITERS // 2)
+    for _ in range(n_iters):
+        list(pool.map(enc, range(len(blocks))))
+    dt = time.perf_counter() - t0
+    return len(blocks) * BLOCK * n_iters / dt / (1 << 30)
+
+
+def device_gibs() -> tuple[float, float, str]:
+    """(encode_gibs, fused_encode_hash_gibs, platform)."""
+    import jax
+    import jax.numpy as jnp
+
+    from minio_tpu.ops import rs
+    from minio_tpu.ops import highwayhash_jax as hhj
+
+    platform = jax.devices()[0].platform
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (BATCH, K, SHARD), dtype=np.uint8)
+    dev = jax.device_put(jnp.asarray(data))
+
+    codec = rs.RSCodec(K, M)
+
+    @jax.jit
+    def encode_only(x):
+        return codec.encode(x)
+
+    @jax.jit
+    def fused(x):
+        shards = codec.encode_all(x)
+        return shards, hhj.hash256_batch(shards)
+
+    encode_only(dev).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = encode_only(dev)
+    out.block_until_ready()
+    enc_gibs = BATCH * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
+
+    r = fused(dev)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    for _ in range(max(4, ITERS // 4)):
+        r = fused(dev)
+    jax.block_until_ready(r)
+    fused_gibs = BATCH * BLOCK * max(4, ITERS // 4) / (time.perf_counter() - t0) / (1 << 30)
+    return enc_gibs, fused_gibs, platform
+
+
+def main() -> None:
+    rng = np.random.default_rng(1)
+    blocks = rng.integers(0, 256, (BATCH, K, SHARD), dtype=np.uint8)
+    cpu = cpu_baseline_gibs(blocks)
+
+    # Watchdog: if device init wedges (tunnel flake), still print a line.
+    def on_timeout(signum, frame):
+        print(
+            json.dumps(
+                {
+                    "metric": "erasure-encode GiB/s (12+4 @ 1MiB, CPU fallback: device init timeout)",
+                    "value": round(cpu, 3),
+                    "unit": "GiB/s",
+                    "vs_baseline": 1.0,
+                }
+            )
+        )
+        os._exit(0)
+
+    signal.signal(signal.SIGALRM, on_timeout)
+    signal.alarm(600)
+    try:
+        enc, fused, platform = device_gibs()
+    finally:
+        signal.alarm(0)
+
+    print(
+        json.dumps(
+            {
+                "metric": f"erasure-encode GiB/s (12+4 @ 1MiB, batch {BATCH}, {platform})",
+                "value": round(enc, 3),
+                "unit": "GiB/s",
+                "vs_baseline": round(enc / cpu, 3) if cpu else 0.0,
+                "cpu_avx2_gibs": round(cpu, 3),
+                "fused_encode_hash_gibs": round(fused, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

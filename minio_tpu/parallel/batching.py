@@ -1,0 +1,170 @@
+"""Cross-request device batching: the encode service.
+
+The BASELINE.json north star: 1 MiB blocks from *concurrent* uploads and heal
+scans are fanned into fixed-shape device batches, amortizing host<->device
+transfer and program launch across requests (the reference instead runs
+per-request SIMD calls on the CPU, cmd/erasure-coding.go:63; its analogous
+fan-in point is erasure-sets.go routing concurrent uploads).
+
+Design:
+  * Full 1 MiB blocks take the batched device path -- uniform [B, K, S]
+    shapes, one fused encode+hash program (models/pipeline.py).
+  * Tail/partial blocks and low-QPS traffic fall back to the host C++ codec
+    (object/codec.py HostCodec) -- a device round-trip isn't worth it for a
+    cold single block (the latency-SLO-vs-occupancy tradeoff from SURVEY.md
+    section 7 step 2).
+  * The batcher thread collects requests until `max_batch` or
+    `batch_timeout_s` after the first arrival, pads the batch to a bucketed
+    size (1/2/4/8/16/32...) to bound XLA compilations, runs the program, and
+    resolves futures.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import numpy as np
+
+from ..models.pipeline import ErasurePipeline, Geometry
+from ..object.codec import BlockCodec, HostCodec
+from ..ops import rs_matrix
+
+_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def _bucket(n: int) -> int:
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    return _BUCKETS[-1]
+
+
+@dataclass
+class _Request:
+    shards: np.ndarray  # [K, S] split data block
+    future: Future
+
+
+class BatchingDeviceCodec(BlockCodec):
+    """BlockCodec running full blocks through a batched device pipeline."""
+
+    def __init__(
+        self,
+        block_size: int = 1 << 20,
+        max_batch: int = 32,
+        batch_timeout_s: float = 0.0005,
+        mesh=None,
+    ):
+        self.block_size = block_size
+        self.max_batch = max_batch
+        self.batch_timeout_s = batch_timeout_s
+        self.mesh = mesh
+        self._host = HostCodec()
+        self._queues: dict[tuple[int, int], queue.Queue[_Request]] = {}
+        self._pipelines: dict[tuple[int, int], ErasurePipeline] = {}
+        self._threads: dict[tuple[int, int], threading.Thread] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    # -- worker management ---------------------------------------------------
+
+    def _ensure_worker(self, k: int, m: int) -> queue.Queue:
+        key = (k, m)
+        with self._lock:
+            if key not in self._queues:
+                q: queue.Queue[_Request] = queue.Queue()
+                self._queues[key] = q
+                self._pipelines[key] = ErasurePipeline(
+                    Geometry(k, m, self.block_size), mesh=self.mesh
+                )
+                t = threading.Thread(
+                    target=self._worker, args=(key,), daemon=True, name=f"encode-batch-{k}-{m}"
+                )
+                self._threads[key] = t
+                t.start()
+        return self._queues[key]
+
+    def _worker(self, key: tuple[int, int]) -> None:
+        k, m = key
+        q = self._queues[key]
+        pipe = self._pipelines[key]
+        while not self._stop.is_set():
+            try:
+                first = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            batch = [first]
+            deadline = threading.Event()
+            # Collect until the adaptive window closes or the batch is full.
+            t_end = self.batch_timeout_s
+            import time as _t
+
+            start = _t.monotonic()
+            while len(batch) < self.max_batch:
+                remaining = t_end - (_t.monotonic() - start)
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(q.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            self._run_batch(pipe, k, m, batch)
+
+    def _run_batch(self, pipe: ErasurePipeline, k: int, m: int, batch: list[_Request]) -> None:
+        try:
+            s = batch[0].shards.shape[1]
+            b_real = len(batch)
+            b_pad = _bucket(b_real)
+            arr = np.zeros((b_pad, k, s), dtype=np.uint8)
+            for i, req in enumerate(batch):
+                arr[i] = req.shards
+            shards, digests = pipe.encode(arr)
+            shards_np = np.asarray(shards)
+            digests_np = np.asarray(digests)
+            for i, req in enumerate(batch):
+                req.future.set_result(
+                    (
+                        [shards_np[i, j].tobytes() for j in range(k + m)],
+                        [digests_np[i, j].tobytes() for j in range(k + m)],
+                    )
+                )
+        except Exception as e:  # noqa: BLE001
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    # -- BlockCodec interface -------------------------------------------------
+
+    def encode(self, blocks, k, m):
+        shard_size_full = rs_matrix.shard_size(self.block_size, k)
+        futures: list[Future | None] = [None] * len(blocks)
+        host_idx: list[int] = []
+        q = None
+        for i, block in enumerate(blocks):
+            if len(block) == self.block_size:
+                if q is None:
+                    q = self._ensure_worker(k, m)
+                f: Future = Future()
+                q.put(_Request(rs_matrix.split(np.frombuffer(block, np.uint8), k), f))
+                futures[i] = f
+            else:
+                host_idx.append(i)
+        host_results = (
+            self._host.encode([blocks[i] for i in host_idx], k, m) if host_idx else []
+        )
+        out: list = [None] * len(blocks)
+        for j, i in enumerate(host_idx):
+            out[i] = host_results[j]
+        for i, f in enumerate(futures):
+            if f is not None:
+                out[i] = f.result(timeout=60)
+        return out
+
+    def reconstruct(self, shards, k, m, want):
+        return self._host.reconstruct(shards, k, m, want)
+
+    def close(self) -> None:
+        self._stop.set()

@@ -1,0 +1,70 @@
+"""Batching device codec tests: bit-identical with host codec, under
+concurrency."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from minio_tpu.object.codec import HostCodec
+from minio_tpu.parallel.batching import BatchingDeviceCodec
+
+BLOCK = 1 << 20
+
+
+@pytest.fixture(scope="module")
+def batcher():
+    b = BatchingDeviceCodec(block_size=BLOCK, max_batch=8, batch_timeout_s=0.002)
+    yield b
+    b.close()
+
+
+def test_single_block_matches_host(batcher):
+    rng = np.random.default_rng(0)
+    block = rng.integers(0, 256, BLOCK).astype(np.uint8).tobytes()
+    dev = batcher.encode([block], 4, 2)
+    host = HostCodec().encode([block], 4, 2)
+    assert dev[0][0] == host[0][0]
+    assert dev[0][1] == host[0][1]
+
+
+def test_partial_block_falls_back_to_host(batcher):
+    rng = np.random.default_rng(1)
+    block = rng.integers(0, 256, 12345).astype(np.uint8).tobytes()
+    dev = batcher.encode([block], 4, 2)
+    host = HostCodec().encode([block], 4, 2)
+    assert dev[0][0] == host[0][0]
+
+
+def test_concurrent_requests_batched(batcher):
+    rng = np.random.default_rng(2)
+    blocks = [rng.integers(0, 256, BLOCK).astype(np.uint8).tobytes() for _ in range(6)]
+    host = HostCodec().encode(blocks, 4, 2)
+    results = [None] * 6
+
+    def work(i):
+        results[i] = batcher.encode([blocks[i]], 4, 2)[0]
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    for i in range(6):
+        assert results[i] is not None, i
+        assert results[i][0] == host[i][0], i
+        assert results[i][1] == host[i][1], i
+
+
+def test_mixed_sizes_one_call(batcher):
+    rng = np.random.default_rng(3)
+    blocks = [
+        rng.integers(0, 256, BLOCK).astype(np.uint8).tobytes(),
+        rng.integers(0, 256, 777).astype(np.uint8).tobytes(),
+        rng.integers(0, 256, BLOCK).astype(np.uint8).tobytes(),
+    ]
+    dev = batcher.encode(blocks, 4, 2)
+    host = HostCodec().encode(blocks, 4, 2)
+    for i in range(3):
+        assert dev[i][0] == host[i][0], i
+        assert dev[i][1] == host[i][1], i
