@@ -396,11 +396,16 @@ class S3Server:
         )
 
     def _list_multipart_uploads(self, bucket: str, q) -> web.Response:
-        self.layer.get_bucket_info(bucket)
+        uploads = self.layer.list_multipart_uploads(bucket, q.get("prefix", ""))
+        items = "".join(
+            f"<Upload><Key>{escape(u['object'])}</Key><UploadId>{u['upload_id']}</UploadId>"
+            f"<Initiated>{_iso(u['initiated'])}</Initiated></Upload>"
+            for u in uploads
+        )
         return _xml(
             f'<ListMultipartUploadsResult xmlns="{XML_NS}">'
             f"<Bucket>{escape(bucket)}</Bucket><IsTruncated>false</IsTruncated>"
-            "</ListMultipartUploadsResult>"
+            f"{items}</ListMultipartUploadsResult>"
         )
 
     def _list_objects(self, bucket: str, q) -> web.Response:
@@ -521,17 +526,100 @@ class S3Server:
     ) -> web.Response:
         m = request.method
         q = request.rel_url.query
+        if m == "POST":
+            if "uploads" in q:
+                return await asyncio.to_thread(self._initiate_multipart, bucket, key, request)
+            if "uploadId" in q:
+                return await asyncio.to_thread(
+                    self._complete_multipart, bucket, key, q["uploadId"], body
+                )
+            raise S3Error("MethodNotAllowed")
         if m == "PUT":
+            if "uploadId" in q and "partNumber" in q:
+                return await asyncio.to_thread(
+                    self._upload_part, bucket, key, q["uploadId"], int(q["partNumber"]), body
+                )
             if "x-amz-copy-source" in request.headers:
                 return await asyncio.to_thread(
                     self._copy_object, bucket, key, request.headers["x-amz-copy-source"], request
                 )
             return await asyncio.to_thread(self._put_object, bucket, key, body, request)
+        if m == "GET" and "uploadId" in q:
+            return await asyncio.to_thread(self._list_parts, bucket, key, q)
         if m in ("GET", "HEAD"):
             return await asyncio.to_thread(self._get_object, bucket, key, request, m == "HEAD")
         if m == "DELETE":
+            if "uploadId" in q:
+                return await asyncio.to_thread(self._abort_multipart, bucket, key, q["uploadId"])
             return await asyncio.to_thread(self._delete_object, bucket, key, q)
         raise S3Error("MethodNotAllowed")
+
+    # -- multipart ------------------------------------------------------------
+
+    def _initiate_multipart(self, bucket: str, key: str, request: web.Request) -> web.Response:
+        opts = self._put_opts(bucket, request)
+        upload_id = self.layer.new_multipart_upload(bucket, key, opts)
+        return _xml(
+            f'<InitiateMultipartUploadResult xmlns="{XML_NS}">'
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<UploadId>{upload_id}</UploadId></InitiateMultipartUploadResult>"
+        )
+
+    def _upload_part(
+        self, bucket: str, key: str, upload_id: str, part_number: int, body: bytes
+    ) -> web.Response:
+        part = self.layer.put_object_part(bucket, key, upload_id, part_number, body)
+        return web.Response(status=200, headers={"ETag": f'"{part.etag}"'})
+
+    def _list_parts(self, bucket: str, key: str, q) -> web.Response:
+        upload_id = q["uploadId"]
+        marker = int(q.get("part-number-marker", "0"))
+        max_parts = int(q.get("max-parts", "1000"))
+        parts = self.layer.list_parts(bucket, key, upload_id, marker, max_parts)
+        items = "".join(
+            f"<Part><PartNumber>{p.number}</PartNumber><ETag>&quot;{p.etag}&quot;</ETag>"
+            f"<Size>{p.size}</Size><LastModified>{_iso(p.mod_time)}</LastModified></Part>"
+            for p in parts
+        )
+        return _xml(
+            f'<ListPartsResult xmlns="{XML_NS}">'
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<UploadId>{upload_id}</UploadId><IsTruncated>false</IsTruncated>"
+            f"{items}</ListPartsResult>"
+        )
+
+    def _complete_multipart(
+        self, bucket: str, key: str, upload_id: str, body: bytes
+    ) -> web.Response:
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        parts: list[tuple[int, str]] = []
+        for el in root.iter():
+            if el.tag.split("}")[-1] == "Part":
+                kv = {c.tag.split("}")[-1]: (c.text or "") for c in el}
+                try:
+                    parts.append((int(kv["PartNumber"]), kv["ETag"].strip()))
+                except (KeyError, ValueError):
+                    raise S3Error("MalformedXML")
+        oi = self.layer.complete_multipart_upload(bucket, key, upload_id, parts)
+        self._emit("s3:ObjectCreated:CompleteMultipartUpload", bucket, oi)
+        headers = {}
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        resp = _xml(
+            f'<CompleteMultipartUploadResult xmlns="{XML_NS}">'
+            f"<Location>/{escape(bucket)}/{escape(key)}</Location>"
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<ETag>&quot;{oi.etag}&quot;</ETag></CompleteMultipartUploadResult>"
+        )
+        resp.headers.update(headers)
+        return resp
+
+    def _abort_multipart(self, bucket: str, key: str, upload_id: str) -> web.Response:
+        self.layer.abort_multipart_upload(bucket, key, upload_id)
+        return web.Response(status=204)
 
     def _put_opts(self, bucket: str, request: web.Request) -> PutObjectOptions:
         meta = self.bucket_meta.get(bucket)

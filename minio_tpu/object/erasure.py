@@ -111,6 +111,15 @@ class ErasureObjects:
     # ------------------------------------------------------------------ util
 
     @property
+    def multipart(self):
+        """Lazy multipart manager (object/multipart.py)."""
+        if not hasattr(self, "_multipart"):
+            from .multipart import MultipartManager
+
+            self._multipart = MultipartManager(self)
+        return self._multipart
+
+    @property
     def drive_count(self) -> int:
         return len(self.disks)
 
@@ -371,10 +380,30 @@ class ErasureObjects:
             [m if o is not None else None for m, o in zip(metas, online)],
             fi.erasure.distribution,
         )
-        chunk_sizes = _shard_chunk_sizes(fi.size, k)
         inline = bool(fi.inline_data) or any(
             m is not None and m.inline_data for m in metas_by_shard
         )
+        out = bytearray()
+        for part in fi.parts:
+            out += self._read_part(
+                bucket, object_name, fi, by_shard, metas_by_shard, part, inline
+            )
+        return bytes(out[: fi.size])
+
+    def _read_part(
+        self,
+        bucket: str,
+        object_name: str,
+        fi: FileInfo,
+        by_shard: list[StorageAPI | None],
+        metas_by_shard,
+        part: ObjectPartInfo,
+        inline: bool,
+    ) -> bytes:
+        k = fi.erasure.data_blocks
+        mth = fi.erasure.parity_blocks
+        chunk_sizes = _shard_chunk_sizes(part.size, k)
+        part_file = f"part.{part.number}"
 
         def read_shard(j: int) -> list[tuple[bytes, bytes]] | None:
             """Frames for shard row j, or None if unavailable/corrupt."""
@@ -389,7 +418,7 @@ class ErasureObjects:
                         return None
                 else:
                     blob = disk.read_file(
-                        bucket, os.path.join(object_name, fi.data_dir, "part.1")
+                        bucket, os.path.join(object_name, fi.data_dir, part_file)
                     )
                 return _parse_frames(blob, chunk_sizes)
             except (errors.DiskError, errors.FileCorrupt):
@@ -418,8 +447,8 @@ class ErasureObjects:
             load_spares()
 
         out = bytearray()
-        total = fi.size
-        for b, chunk_size in enumerate(chunk_sizes):
+        total = part.size
+        for b in range(len(chunk_sizes)):
             def valid_rows() -> list[bytes | None]:
                 rows: list[bytes | None] = [None] * (k + mth)
                 for j in range(k + mth):
@@ -559,31 +588,39 @@ class ErasureObjects:
             [m if o is not None else None for m, o in zip(metas, online)],
             fi.erasure.distribution,
         )
-        chunk_sizes = _shard_chunk_sizes(fi.size, k)
-        inline = fi.size > 0 and fi.size < SMALL_FILE_THRESHOLD
+        inline = bool(fi.inline_data) or (
+            fi.size > 0 and fi.size < SMALL_FILE_THRESHOLD and not fi.data_dir
+        )
+        parts = fi.parts or [ObjectPartInfo(1, fi.size, fi.size)]
+        part_chunks = {p.number: _shard_chunk_sizes(p.size, k) for p in parts}
 
-        # Which shard rows need rebuilding? (missing drive, bad metadata, or
-        # failed shard verification.)
-        def shard_ok(j: int) -> bool:
+        def read_part_frames(j: int, part: ObjectPartInfo):
             disk = by_shard[j]
             if disk is None:
+                raise errors.DiskNotFound()
+            if inline:
+                m = metas_by_shard[j]
+                blob = m.inline_data if m is not None else b""
+                if not blob:
+                    raise errors.FileNotFound()
+            else:
+                blob = disk.read_file(
+                    bucket, os.path.join(object_name, fi.data_dir, f"part.{part.number}")
+                )
+            return _parse_frames(blob, part_chunks[part.number])
+
+        # Which shard rows need rebuilding? (missing drive, bad metadata, or
+        # failed verification of any part chunk.)
+        def shard_ok(j: int) -> bool:
+            if by_shard[j] is None:
                 return False
             if fi.size == 0:
                 return True
             try:
-                if inline:
-                    m = metas_by_shard[j]
-                    blob = m.inline_data if m is not None else b""
-                    if not blob:
-                        return False
-                else:
-                    blob = disk.read_file(
-                        bucket, os.path.join(object_name, fi.data_dir, "part.1")
-                    )
-                frames = _parse_frames(blob, chunk_sizes)
-                for digest, chunk in frames:
-                    if bitrot_mod.digest_of(chunk) != digest:
-                        return False
+                for part in parts:
+                    for digest, chunk in read_part_frames(j, part):
+                        if bitrot_mod.digest_of(chunk) != digest:
+                            return False
                 return True
             except (errors.DiskError, errors.FileCorrupt):
                 return False
@@ -600,37 +637,25 @@ class ErasureObjects:
             result.disks_healed = len(bad_rows)
             return result
 
-        # Rebuild bad rows block by block from surviving shards.
-        surviving = [j for j, ok in enumerate(oks) if ok]
-        frames_by_row: dict[int, list[tuple[bytes, bytes]]] = {}
-        for j in surviving:
-            disk = by_shard[j]
-            if fi.size == 0:
-                continue
-            if inline:
-                blob = metas_by_shard[j].inline_data  # type: ignore[union-attr]
-            else:
-                blob = disk.read_file(bucket, os.path.join(object_name, fi.data_dir, "part.1"))
-            frames_by_row[j] = _parse_frames(blob, chunk_sizes)
-
-        rebuilt_files: dict[int, bytes] = {}
-        if fi.size == 0:
-            for j in bad_rows:
-                rebuilt_files[j] = b""
-        else:
-            per_row_frames: dict[int, list[tuple[bytes, bytes]]] = {j: [] for j in bad_rows}
-            for b in range(len(chunk_sizes)):
-                rows: list[bytes | None] = [None] * (k + mth)
-                for j in surviving:
-                    rows[j] = frames_by_row[j][b][1]
-                rebuilt = self.codec.reconstruct(rows, k, mth, bad_rows)
-                for idx, j in enumerate(bad_rows):
-                    chunk = rebuilt[idx]
-                    per_row_frames[j].append((bitrot_mod.digest_of(chunk), chunk))
-            for j in bad_rows:
-                rebuilt_files[j] = _frame_shard(
-                    [c for _, c in per_row_frames[j]], [d for d, _ in per_row_frames[j]]
-                )
+        # Rebuild bad rows per part, block by block, from surviving shards.
+        surviving = [j for j, ok in enumerate(oks) if ok][: k]
+        rebuilt_files: dict[int, dict[int, bytes]] = {j: {} for j in bad_rows}  # row -> part -> blob
+        if fi.size > 0:
+            for part in parts:
+                frames_by_row = {j: read_part_frames(j, part) for j in surviving}
+                per_row: dict[int, list[tuple[bytes, bytes]]] = {j: [] for j in bad_rows}
+                for b in range(len(part_chunks[part.number])):
+                    rows: list[bytes | None] = [None] * (k + mth)
+                    for j in surviving:
+                        rows[j] = frames_by_row[j][b][1]
+                    rebuilt = self.codec.reconstruct(rows, k, mth, bad_rows)
+                    for idx, j in enumerate(bad_rows):
+                        chunk = rebuilt[idx]
+                        per_row[j].append((bitrot_mod.digest_of(chunk), chunk))
+                for j in bad_rows:
+                    rebuilt_files[j][part.number] = _frame_shard(
+                        [c for _, c in per_row[j]], [d for d, _ in per_row[j]]
+                    )
 
         # Write rebuilt shards to the drives that should hold them.
         healed = 0
@@ -657,14 +682,19 @@ class ErasureObjects:
                     index=j + 1,
                     distribution=list(fi.erasure.distribution),
                 ),
-                inline_data=rebuilt_files[j] if inline else b"",
+                inline_data=rebuilt_files[j].get(1, b"") if inline else b"",
             )
             try:
                 if inline or fi.size == 0:
                     disk.write_metadata(bucket, object_name, new_fi)
                 else:
                     tmp_path = f"tmp/{upload_id}/{j}"
-                    disk.create_file(META_BUCKET, f"{tmp_path}/part.1", rebuilt_files[j])
+                    for part in parts:
+                        disk.create_file(
+                            META_BUCKET,
+                            f"{tmp_path}/part.{part.number}",
+                            rebuilt_files[j][part.number],
+                        )
                     disk.rename_data(META_BUCKET, tmp_path, new_fi, bucket, object_name)
                 healed += 1
                 state[drive_index] = "healed"

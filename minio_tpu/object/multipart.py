@@ -1,0 +1,312 @@
+"""Multipart uploads on the erasure set.
+
+Role of the reference's erasure-multipart.go: parts are erasure-coded and
+staged under the system meta bucket
+(.minio_tpu.sys/multipart/<bucket>/<object>/<uploadId>/), then atomically
+assembled into the object on CompleteMultipartUpload by renaming the staged
+shard files into the object's data dir and publishing a multi-part FileInfo
+(parts carry per-part sizes so reads/heals can reframe each part's bitrot
+stream).
+
+Uses the same distribution as the final object (hash_order of bucket/object),
+so each drive keeps the same shard row across parts and completion is pure
+renames -- no re-coding.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import uuid
+
+from ..storage.types import ErasureInfo, FileInfo, ObjectPartInfo, now
+from ..utils import errors
+from ..utils.hashes import hash_order
+from . import metadata as meta_mod
+from .erasure import BLOCK_SIZE, META_BUCKET, ErasureObjects, _frame_shard
+from .types import ObjectInfo, PutObjectOptions
+
+MIN_PART_SIZE = 5 * (1 << 20)  # S3 minimum (except last part)
+MAX_PARTS = 10_000
+
+
+def _upload_dir(bucket: str, object_name: str, upload_id: str) -> str:
+    return f"multipart/{bucket}/{object_name}/{upload_id}"
+
+
+class MultipartManager:
+    def __init__(self, eo: ErasureObjects):
+        self.eo = eo
+
+    # -- initiate ------------------------------------------------------------
+
+    def new_multipart_upload(
+        self, bucket: str, object_name: str, opts: PutObjectOptions | None = None
+    ) -> str:
+        opts = opts or PutObjectOptions()
+        self.eo.get_bucket_info(bucket)
+        upload_id = str(uuid.uuid4())
+        doc = json.dumps(
+            {
+                "bucket": bucket,
+                "object": object_name,
+                "created": now(),
+                "content_type": opts.content_type,
+                "user_defined": opts.user_defined,
+                "versioned": opts.versioned,
+            }
+        ).encode()
+        path = _upload_dir(bucket, object_name, upload_id) + "/upload.json"
+
+        def write(d):
+            if d is None:
+                raise errors.DiskNotFound()
+            d.write_all(META_BUCKET, path, doc)
+
+        results = meta_mod.parallel_map(write, self.eo._online())
+        n_ok = sum(1 for _, e in results if e is None)
+        if n_ok < self.eo.drive_count // 2 + 1:
+            raise errors.ErasureWriteQuorum(bucket, object_name, "initiate multipart")
+        return upload_id
+
+    def _upload_meta(self, bucket: str, object_name: str, upload_id: str) -> dict:
+        path = _upload_dir(bucket, object_name, upload_id) + "/upload.json"
+        for d in self.eo._online():
+            if d is None:
+                continue
+            try:
+                return json.loads(d.read_all(META_BUCKET, path))
+            except errors.DiskError:
+                continue
+        raise errors.InvalidUploadID(bucket, object_name, upload_id)
+
+    # -- parts ---------------------------------------------------------------
+
+    def put_object_part(
+        self, bucket: str, object_name: str, upload_id: str, part_number: int, data: bytes
+    ) -> ObjectPartInfo:
+        if not (1 <= part_number <= MAX_PARTS):
+            raise errors.InvalidArgument(bucket, object_name, "bad part number")
+        self._upload_meta(bucket, object_name, upload_id)
+
+        n = self.eo.drive_count
+        m = self.eo.parity
+        k = n - m
+        distribution = hash_order(f"{bucket}/{object_name}", n)
+        etag = hashlib.md5(data).hexdigest()
+
+        blocks = [data[i : i + BLOCK_SIZE] for i in range(0, len(data), BLOCK_SIZE)]
+        encoded = self.eo.codec.encode(blocks, k, m) if blocks else []
+        shard_files = [
+            _frame_shard([e[0][row] for e in encoded], [e[1][row] for e in encoded])
+            for row in range(n)
+        ]
+        part_doc = json.dumps(
+            {"number": part_number, "size": len(data), "etag": etag, "mod_time": now()}
+        ).encode()
+        udir = _upload_dir(bucket, object_name, upload_id)
+
+        def write(args):
+            i, disk = args
+            if disk is None:
+                raise errors.DiskNotFound()
+            row = distribution[i] - 1
+            disk.create_file(META_BUCKET, f"{udir}/part.{part_number}", shard_files[row])
+            disk.write_all(META_BUCKET, f"{udir}/part.{part_number}.meta", part_doc)
+
+        results = meta_mod.parallel_map(write, list(enumerate(self.eo._online())))
+        n_ok = sum(1 for _, e in results if e is None)
+        write_quorum = k + 1 if k == m else k
+        if n_ok < write_quorum:
+            raise errors.ErasureWriteQuorum(bucket, object_name, "upload part quorum")
+        return ObjectPartInfo(part_number, len(data), len(data), now(), etag)
+
+    def list_parts(
+        self, bucket: str, object_name: str, upload_id: str, part_marker: int = 0, max_parts: int = 1000
+    ) -> list[ObjectPartInfo]:
+        self._upload_meta(bucket, object_name, upload_id)
+        udir = _upload_dir(bucket, object_name, upload_id)
+        out: dict[int, ObjectPartInfo] = {}
+        for d in self.eo._online():
+            if d is None:
+                continue
+            try:
+                names = d.list_dir(META_BUCKET, udir)
+            except errors.DiskError:
+                continue
+            for nme in names:
+                if nme.endswith(".meta"):
+                    try:
+                        doc = json.loads(d.read_all(META_BUCKET, f"{udir}/{nme}"))
+                        num = doc["number"]
+                        if num not in out:
+                            out[num] = ObjectPartInfo(
+                                num, doc["size"], doc["size"], doc.get("mod_time", 0.0), doc["etag"]
+                            )
+                    except (errors.DiskError, ValueError, KeyError):
+                        continue
+            break  # one good drive is enough for listing
+        parts = [out[nk] for nk in sorted(out) if nk > part_marker]
+        return parts[:max_parts]
+
+    # -- complete / abort ----------------------------------------------------
+
+    def complete_multipart_upload(
+        self, bucket: str, object_name: str, upload_id: str, parts: list[tuple[int, str]]
+    ) -> ObjectInfo:
+        meta_doc = self._upload_meta(bucket, object_name, upload_id)
+        if not parts:
+            raise errors.InvalidArgument(bucket, object_name, "no parts")
+        uploaded = {p.number: p for p in self.list_parts(bucket, object_name, upload_id, 0, MAX_PARTS)}
+        part_infos: list[ObjectPartInfo] = []
+        prev = 0
+        for idx, (num, etag) in enumerate(parts):
+            if num <= prev:
+                raise errors.InvalidArgument(bucket, object_name, "part order")
+            prev = num
+            got = uploaded.get(num)
+            if got is None or got.etag != etag.strip('"'):
+                raise errors.InvalidPart(bucket, object_name, f"part {num}")
+            if idx < len(parts) - 1 and got.size < MIN_PART_SIZE:
+                raise errors.InvalidArgument(
+                    bucket, object_name, f"part {num} below minimum size"
+                )
+            part_infos.append(got)
+
+        n = self.eo.drive_count
+        m = self.eo.parity
+        k = n - m
+        distribution = hash_order(f"{bucket}/{object_name}", n)
+        total_size = sum(p.size for p in part_infos)
+        # S3 multipart etag: md5 of the concatenated binary part md5s + "-N".
+        md5s = b"".join(bytes.fromhex(p.etag) for p in part_infos)
+        etag = hashlib.md5(md5s).hexdigest() + f"-{len(part_infos)}"
+        version_id = str(uuid.uuid4()) if meta_doc.get("versioned") else ""
+        data_dir = str(uuid.uuid4())
+        mod_time = now()
+        udir = _upload_dir(bucket, object_name, upload_id)
+        commit_id = str(uuid.uuid4())
+
+        base_meta = {
+            "etag": etag,
+            "content-type": meta_doc.get("content_type", "application/octet-stream"),
+            **meta_doc.get("user_defined", {}),
+        }
+
+        def commit(args):
+            i, disk = args
+            if disk is None:
+                raise errors.DiskNotFound()
+            row = distribution[i] - 1
+            tmp = f"tmp/{commit_id}/{i}"
+            # Renumber parts consecutively (S3 semantics: completed part list
+            # order defines part numbers 1..N for reads).
+            for new_num, p in enumerate(part_infos, start=1):
+                disk.rename_file(
+                    META_BUCKET, f"{udir}/part.{p.number}", META_BUCKET, f"{tmp}/part.{new_num}"
+                )
+            fi = FileInfo(
+                volume=bucket,
+                name=object_name,
+                version_id=version_id,
+                data_dir=data_dir,
+                mod_time=mod_time,
+                size=total_size,
+                metadata=dict(base_meta),
+                parts=[
+                    ObjectPartInfo(new_num, p.size, p.size, mod_time, p.etag)
+                    for new_num, p in enumerate(part_infos, start=1)
+                ],
+                erasure=ErasureInfo(
+                    data_blocks=k,
+                    parity_blocks=m,
+                    block_size=BLOCK_SIZE,
+                    index=row + 1,
+                    distribution=list(distribution),
+                ),
+            )
+            disk.rename_data(META_BUCKET, tmp, fi, bucket, object_name)
+
+        results = meta_mod.parallel_map(commit, list(enumerate(self.eo._online())))
+        n_ok = sum(1 for _, e in results if e is None)
+        write_quorum = k + 1 if k == m else k
+        if n_ok < write_quorum:
+            raise errors.ErasureWriteQuorum(bucket, object_name, "complete quorum")
+        self.abort_multipart_upload(bucket, object_name, upload_id, missing_ok=True)
+        oi = ObjectInfo(
+            bucket=bucket,
+            name=object_name,
+            mod_time=mod_time,
+            size=total_size,
+            etag=etag,
+            version_id=version_id,
+            content_type=base_meta["content-type"],
+        )
+        return oi
+
+    def abort_multipart_upload(
+        self, bucket: str, object_name: str, upload_id: str, missing_ok: bool = False
+    ) -> None:
+        if not missing_ok:
+            self._upload_meta(bucket, object_name, upload_id)
+        udir = _upload_dir(bucket, object_name, upload_id)
+
+        def rm(d):
+            if d is None:
+                return
+            try:
+                d.delete(META_BUCKET, udir, recursive=True)
+            except errors.DiskError:
+                pass
+
+        meta_mod.parallel_map(rm, self.eo._online())
+
+    def list_multipart_uploads(self, bucket: str, prefix: str = "") -> list[dict]:
+        self.eo.get_bucket_info(bucket)
+        out = []
+        seen = set()
+        for d in self.eo._online():
+            if d is None:
+                continue
+            base = f"multipart/{bucket}"
+            try:
+                objects = self._walk_uploads(d, base)
+            except errors.DiskError:
+                continue
+            for object_name, upload_id, doc in objects:
+                if (object_name, upload_id) in seen or not object_name.startswith(prefix):
+                    continue
+                seen.add((object_name, upload_id))
+                out.append(
+                    {
+                        "object": object_name,
+                        "upload_id": upload_id,
+                        "initiated": doc.get("created", 0.0),
+                    }
+                )
+            break
+        return sorted(out, key=lambda u: (u["object"], u["initiated"]))
+
+    def _walk_uploads(self, disk, base: str):
+        """Find (object, upload_id, meta) under multipart/<bucket>/."""
+        results = []
+
+        def recurse(path: str):
+            try:
+                names = disk.list_dir(META_BUCKET, path)
+            except errors.DiskError:
+                return
+            for nme in names:
+                if not nme.endswith("/"):
+                    continue
+                child = f"{path}/{nme[:-1]}"
+                try:
+                    disk.read_all(META_BUCKET, f"{child}/upload.json")
+                    doc = json.loads(disk.read_all(META_BUCKET, f"{child}/upload.json"))
+                    object_name = path[len(base) + 1 :]
+                    results.append((object_name, nme[:-1], doc))
+                except errors.DiskError:
+                    recurse(child)
+
+        recurse(base)
+        return results

@@ -252,6 +252,52 @@ class ServerPools:
             out.next_version_marker = out.objects[-1].version_id
         return out
 
+    # -- multipart --------------------------------------------------------------
+
+    def new_multipart_upload(self, bucket, object_name, opts: PutObjectOptions | None = None) -> str:
+        _validate_object_name(bucket, object_name)
+        try:
+            pool = self._pool_holding(bucket, object_name)
+        except errors.ObjectError:
+            pool = self._pool_with_space()
+        return pool.new_multipart_upload(bucket, object_name, opts)
+
+    def _pool_with_upload(self, bucket: str, object_name: str, upload_id: str):
+        last: Exception | None = None
+        for p in self.pools:
+            try:
+                p.list_parts(bucket, object_name, upload_id, 0, 1)
+                return p
+            except errors.ObjectError as e:
+                last = e
+        raise last or errors.InvalidUploadID(bucket, object_name, upload_id)
+
+    def put_object_part(self, bucket, object_name, upload_id, part_number, data):
+        return self._pool_with_upload(bucket, object_name, upload_id).put_object_part(
+            bucket, object_name, upload_id, part_number, data
+        )
+
+    def list_parts(self, bucket, object_name, upload_id, part_marker=0, max_parts=1000):
+        return self._pool_with_upload(bucket, object_name, upload_id).list_parts(
+            bucket, object_name, upload_id, part_marker, max_parts
+        )
+
+    def complete_multipart_upload(self, bucket, object_name, upload_id, parts):
+        return self._pool_with_upload(bucket, object_name, upload_id).complete_multipart_upload(
+            bucket, object_name, upload_id, parts
+        )
+
+    def abort_multipart_upload(self, bucket, object_name, upload_id):
+        return self._pool_with_upload(bucket, object_name, upload_id).abort_multipart_upload(
+            bucket, object_name, upload_id
+        )
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for p in self.pools:
+            out.extend(p.list_multipart_uploads(bucket, prefix))
+        return sorted(out, key=lambda u: (u["object"], u["initiated"]))
+
     # -- healing ---------------------------------------------------------------
 
     def heal_object(

@@ -151,6 +151,37 @@ class ErasureSets:
     def heal_object(self, bucket, object_name, version_id="", dry_run=False) -> HealResultItem:
         return self.get_hashed_set(object_name).heal_object(bucket, object_name, version_id, dry_run)
 
+    # -- multipart (route to one set) ------------------------------------------
+
+    def new_multipart_upload(self, bucket, object_name, opts: PutObjectOptions | None = None) -> str:
+        return self.get_hashed_set(object_name).multipart.new_multipart_upload(bucket, object_name, opts)
+
+    def put_object_part(self, bucket, object_name, upload_id, part_number, data):
+        return self.get_hashed_set(object_name).multipart.put_object_part(
+            bucket, object_name, upload_id, part_number, data
+        )
+
+    def list_parts(self, bucket, object_name, upload_id, part_marker=0, max_parts=1000):
+        return self.get_hashed_set(object_name).multipart.list_parts(
+            bucket, object_name, upload_id, part_marker, max_parts
+        )
+
+    def complete_multipart_upload(self, bucket, object_name, upload_id, parts):
+        return self.get_hashed_set(object_name).multipart.complete_multipart_upload(
+            bucket, object_name, upload_id, parts
+        )
+
+    def abort_multipart_upload(self, bucket, object_name, upload_id):
+        return self.get_hashed_set(object_name).multipart.abort_multipart_upload(
+            bucket, object_name, upload_id
+        )
+
+    def list_multipart_uploads(self, bucket, prefix=""):
+        out = []
+        for s in self.sets:
+            out.extend(s.multipart.list_multipart_uploads(bucket, prefix))
+        return sorted(out, key=lambda u: (u["object"], u["initiated"]))
+
     # -- listing (merge sorted per-drive walks; metacache-set.go's job) -------
 
     def _walk_merged(self, bucket: str, prefix: str = ""):
