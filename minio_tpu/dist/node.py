@@ -1,0 +1,216 @@
+"""Node bootstrap: endpoints -> drives -> format consensus -> full server.
+
+Role of the reference's server-main.go serverMain (:422) + endpoint.go
+CreateEndpoints (:538) + prepare-storage.go waitForFormatErasure: each node
+is given the SAME ordered endpoint list; it opens local paths directly and
+remote paths through the storage REST proxy, reaches format.json quorum
+(creating fresh formats when the whole cluster is unformatted and this node
+is the leader = owner of the first endpoint), then assembles the erasure
+pools and serves S3 + storage/lock/peer REST on one port.
+"""
+
+from __future__ import annotations
+
+import time
+import urllib.parse
+from dataclasses import dataclass
+
+from aiohttp import web
+
+from ..api.auth import Credentials
+from ..api.server import S3Server
+from ..control.iam import IAMSys
+from ..object import codec as codec_mod
+from ..object.pools import ServerPools
+from ..object.sets import ErasureSets
+from ..storage import format as fmt_mod
+from ..storage.local import LocalDrive
+from ..utils import errors
+from .locks import LOCK_PREFIX, LocalLocker, NamespaceLock, RemoteLocker, make_lock_app
+from .peer import PEER_PREFIX, NotificationSys, PeerClient, make_peer_app
+from .storage_rest import PREFIX as STORAGE_PREFIX
+from .storage_rest import RemoteDrive, make_storage_app
+from .transport import cluster_token
+
+
+@dataclass
+class Endpoint:
+    url: str  # "" for pure-local path endpoints
+    path: str
+
+    @property
+    def is_local_path(self) -> bool:
+        return not self.url
+
+    @classmethod
+    def parse(cls, raw: str) -> "Endpoint":
+        if raw.startswith(("http://", "https://")):
+            u = urllib.parse.urlparse(raw)
+            return cls(url=f"{u.scheme}://{u.netloc}", path=u.path)
+        return cls(url="", path=raw)
+
+
+class Node:
+    def __init__(
+        self,
+        endpoints: list[str],
+        url: str = "",
+        root_user: str = "minioadmin",
+        root_password: str = "minioadmin",
+        set_drive_count: int | None = None,
+        parity: int | None = None,
+        region: str = "us-east-1",
+        codec: codec_mod.BlockCodec | None = None,
+        check_skew: bool = False,
+    ):
+        self.url = url.rstrip("/")
+        self.endpoints = [Endpoint.parse(e) for e in endpoints]
+        self.token = cluster_token(root_password)
+        self.creds = Credentials(root_user, root_password)
+        self.region = region
+        self.codec = codec
+
+        # Drive construction: local paths open directly, remote via REST.
+        self.local_drives: dict[str, LocalDrive] = {}
+        self.drives = []
+        peer_urls: set[str] = set()
+        for ep in self.endpoints:
+            if ep.is_local_path or ep.url == self.url:
+                d = LocalDrive(ep.path)
+                self.local_drives[ep.path] = d
+                self.drives.append(d)
+            else:
+                peer_urls.add(ep.url)
+                self.drives.append(RemoteDrive(ep.url, ep.path, self.token))
+        self.peer_urls = sorted(peer_urls)
+
+        n = len(self.drives)
+        self.set_drive_count = set_drive_count or _default_set_count(n)
+        if n % self.set_drive_count:
+            raise ValueError(f"{n} drives not divisible into sets of {self.set_drive_count}")
+        self.parity = parity
+        # Leader = the node owning the first endpoint (server-main.go:507
+        # "first local" orchestrates format).
+        first = self.endpoints[0]
+        self.is_leader = first.is_local_path or first.url == self.url
+
+        self.locker = LocalLocker()
+        self.iam: IAMSys | None = None
+        self.s3: S3Server | None = None
+        self.pools: ServerPools | None = None
+        self.ns_lock: NamespaceLock | None = None
+        self.notification: NotificationSys | None = None
+
+    # -- format consensus ----------------------------------------------------
+
+    def _read_formats(self) -> list[fmt_mod.DriveFormat | None]:
+        out: list[fmt_mod.DriveFormat | None] = []
+        for d in self.drives:
+            try:
+                raw = d.read_all(fmt_mod.SYS_DIR, fmt_mod.FORMAT_FILE)
+                out.append(fmt_mod.DriveFormat.from_json(raw.decode()))
+            except (errors.DiskError, errors.FileCorrupt):
+                out.append(None)
+        return out
+
+    def wait_for_format(self, timeout: float = 30.0) -> fmt_mod.DriveFormat:
+        """Reach format quorum, creating fresh formats if the whole cluster
+        is unformatted and this node leads (prepare-storage.go role)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            formats = self._read_formats()
+            n_fmt = sum(1 for f in formats if f is not None)
+            if n_fmt == 0 and self.is_leader:
+                n_sets = len(self.drives) // self.set_drive_count
+                fresh = fmt_mod.init_format(n_sets, self.set_drive_count)
+                for d, f in zip(self.drives, fresh):
+                    try:
+                        d.write_all(fmt_mod.SYS_DIR, fmt_mod.FORMAT_FILE, f.to_json().encode())
+                    except errors.DiskError:
+                        pass
+                continue
+            if n_fmt > 0:
+                try:
+                    quorum = fmt_mod.quorum_format(formats)
+                except errors.StorageError:
+                    quorum = None
+                if quorum is not None:
+                    # Heal format onto unformatted drives that we can reach:
+                    # give each missing slot the id the quorum expects.
+                    flat_ids = [i for s in quorum.sets for i in s]
+                    for d, f in zip(self.drives, formats):
+                        if f is None and d.is_online():
+                            # Which slot is this drive? By position in the
+                            # endpoint list (the reference heals by position
+                            # too, format-erasure.go:783).
+                            idx = self.drives.index(d)
+                            if idx < len(flat_ids):
+                                healed = fmt_mod.DriveFormat(
+                                    deployment_id=quorum.deployment_id,
+                                    this_id=flat_ids[idx],
+                                    sets=quorum.sets,
+                                    distribution_algo=quorum.distribution_algo,
+                                )
+                                try:
+                                    d.write_all(
+                                        fmt_mod.SYS_DIR,
+                                        fmt_mod.FORMAT_FILE,
+                                        healed.to_json().encode(),
+                                    )
+                                except errors.DiskError:
+                                    pass
+                    return quorum
+            if time.monotonic() > deadline:
+                raise errors.UnformattedDisk("format quorum not reached")
+            time.sleep(0.25)
+
+    # -- assembly ------------------------------------------------------------
+
+    def build(self) -> "Node":
+        quorum = self.wait_for_format()
+        sets = ErasureSets.from_drives(
+            list(self.drives), quorum, parity=self.parity, codec=self.codec
+        )
+        self.pools = ServerPools([sets])
+        lockers: list = [self.locker] + [RemoteLocker(u, self.token) for u in self.peer_urls]
+        self.ns_lock = NamespaceLock(lockers)
+        self.pools.ns_lock = self.ns_lock
+        self.iam = IAMSys(self.creds.access_key, self.creds.secret_key)
+        self.s3 = S3Server(self.pools, self.iam, region=self.region, check_skew=False)
+        self.notification = NotificationSys(
+            [PeerClient(u, self.token) for u in self.peer_urls]
+        )
+        return self
+
+    def make_app(self) -> web.Application:
+        """One aiohttp app: internode routers first, S3 catch-all last
+        (routers.go:65 ordering). Servable BEFORE build() -- the S3 handler
+        503s until the object layer is up, so peers can reach this node's
+        storage REST during the format handshake (the reference starts its
+        dist routers before waitForFormatErasure too, server-main.go:495-521).
+        """
+        app = web.Application(client_max_size=1 << 31)
+        app.add_subapp(STORAGE_PREFIX, make_storage_app(self.local_drives, self.token))
+        app.add_subapp(LOCK_PREFIX, make_lock_app(self.locker, self.token))
+        app.add_subapp(PEER_PREFIX, make_peer_app(self, self.token))
+
+        async def s3_entry(request: web.Request):
+            if self.s3 is None:
+                return web.Response(status=503, text="server initializing")
+            return await self.s3._entry(request)
+
+        app.router.add_route("*", "/{tail:.*}", s3_entry)
+        return app
+
+
+def _default_set_count(n: int) -> int:
+    """Largest set size in [4..16] dividing n; else n itself (small rigs).
+
+    The reference computes symmetric set sizes from the ellipses pattern
+    (endpoint-ellipses.go:68 possibleSetCounts); this is the same idea for
+    explicit endpoint lists.
+    """
+    for size in range(16, 3, -1):
+        if n % size == 0:
+            return size
+    return n

@@ -1,0 +1,175 @@
+"""Peer REST: node-to-node control plane.
+
+Role of the reference's peer REST v16 (cmd/peer-rest-{client,server}.go,
+notification.go NotificationSys): config/IAM/bucket-metadata propagation,
+health pings, lock listing, and admin fan-out. Data never rides this channel
+-- it is DCN-latency-tolerant control traffic.
+"""
+
+from __future__ import annotations
+
+import time
+
+import msgpack
+from aiohttp import web
+
+from ..utils import errors
+from .transport import ERROR_HEADER, TOKEN_HEADER, RestClient
+
+PEER_PREFIX = "/mtpu/peer/v1"
+START_TIME = time.time()
+
+
+def make_peer_app(node, token: str) -> web.Application:
+    app = web.Application()
+
+    def handler(fn):
+        async def wrapped(request: web.Request):
+            import asyncio
+
+            if request.headers.get(TOKEN_HEADER) != token:
+                return web.Response(status=403)
+            body = await request.read()
+            a = msgpack.unpackb(body, raw=False) if body else {}
+            try:
+                result = await asyncio.to_thread(fn, a)
+                return web.Response(
+                    body=msgpack.packb(result, use_bin_type=True),
+                    content_type="application/x-msgpack",
+                )
+            except Exception as e:  # noqa: BLE001
+                return web.Response(
+                    status=500, headers={ERROR_HEADER: type(e).__name__}, text=str(e)
+                )
+
+        return wrapped
+
+    def h_ping(a):
+        return {"pong": True, "node": node.url}
+
+    def h_server_info(a):
+        drives = []
+        for d in node.local_drives.values():
+            try:
+                di = d.disk_info()
+                drives.append(
+                    {"path": di.mount_path, "total": di.total, "free": di.free, "ok": True}
+                )
+            except errors.DiskError:
+                drives.append({"path": d.root, "ok": False})
+        return {
+            "node": node.url,
+            "uptime": time.time() - START_TIME,
+            "drives": drives,
+            "version": "0.1.0",
+        }
+
+    def h_reload_iam(a):
+        if node.iam is not None:
+            node.iam.load()
+        return {"ok": True}
+
+    def h_reload_bucket_meta(a):
+        if node.s3 is not None:
+            node.s3.bucket_meta.invalidate(a.get("bucket", ""))
+        return {"ok": True}
+
+    def h_top_locks(a):
+        return node.locker.top_locks()
+
+    def h_speedtest(a):
+        """Self-benchmark PUT+GET through the object layer
+        (peer-rest-server.go:1137 selfSpeedtest)."""
+        import os as _os
+        import time as _time
+
+        size = int(a.get("size", 1 << 20))
+        count = int(a.get("count", 4))
+        bucket = ".minio_tpu.sys"
+        payload = _os.urandom(size)
+        t0 = _time.perf_counter()
+        for i in range(count):
+            node.pools.pools[0].put_object(bucket, f"speedtest/obj-{i}", payload)
+        put_t = _time.perf_counter() - t0
+        t0 = _time.perf_counter()
+        for i in range(count):
+            node.pools.pools[0].get_object(bucket, f"speedtest/obj-{i}")
+        get_t = _time.perf_counter() - t0
+        for i in range(count):
+            try:
+                node.pools.pools[0].delete_object(bucket, f"speedtest/obj-{i}")
+            except errors.StorageError:
+                pass
+        return {
+            "put_bytes_per_s": size * count / put_t if put_t else 0,
+            "get_bytes_per_s": size * count / get_t if get_t else 0,
+        }
+
+    for name, fn in {
+        "ping": h_ping,
+        "serverinfo": h_server_info,
+        "reloadiam": h_reload_iam,
+        "reloadbucketmeta": h_reload_bucket_meta,
+        "toplocks": h_top_locks,
+        "speedtest": h_speedtest,
+    }.items():
+        app.router.add_post(f"/{name}", handler(fn))
+    return app
+
+
+class PeerClient:
+    def __init__(self, node_url: str, token: str):
+        self.url = node_url
+        self.client = RestClient(node_url.rstrip("/") + PEER_PREFIX, token, timeout=10.0)
+
+    def ping(self) -> bool:
+        try:
+            r = self.client.call("/ping", {})
+            return bool(r and r.get("pong"))
+        except errors.StorageError:
+            return False
+
+    def server_info(self) -> dict:
+        return self.client.call("/serverinfo", {})
+
+    def reload_iam(self) -> None:
+        self.client.call("/reloadiam", {})
+
+    def reload_bucket_meta(self, bucket: str = "") -> None:
+        self.client.call("/reloadbucketmeta", {"bucket": bucket})
+
+    def top_locks(self) -> list:
+        return self.client.call("/toplocks", {})
+
+    def speedtest(self, size: int = 1 << 20, count: int = 4) -> dict:
+        return self.client.call("/speedtest", {"size": size, "count": count}, timeout=120.0)
+
+
+class NotificationSys:
+    """Fan-out helper to all peers (cmd/notification.go:50 role)."""
+
+    def __init__(self, peers: list[PeerClient]):
+        self.peers = peers
+
+    def reload_iam_all(self) -> None:
+        for p in self.peers:
+            try:
+                p.reload_iam()
+            except errors.StorageError:
+                continue
+
+    def reload_bucket_meta_all(self, bucket: str = "") -> None:
+        for p in self.peers:
+            try:
+                p.reload_bucket_meta(bucket)
+            except errors.StorageError:
+                continue
+
+    def server_info_all(self) -> list[dict]:
+        out = []
+        for p in self.peers:
+            try:
+                out.append(p.server_info())
+            except errors.StorageError:
+                out.append({"node": p.url, "offline": True})
+        return out

@@ -1,0 +1,114 @@
+"""Inter-node REST plumbing: msgpack-over-HTTP with typed error transport.
+
+Role of the reference's internal/rest (client.go:76 Client with health checks
+and backoff) + the msgp wire encoding of storage-rest: all inter-node traffic
+is HTTP with msgpack bodies on the DCN control path; shard payloads ride raw
+request/response bodies. Errors cross the wire as exception class names and
+re-raise as the same minio_tpu.utils.errors type on the caller.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import threading
+import time
+
+import msgpack
+import requests
+
+from ..utils import errors
+
+ERROR_HEADER = "X-Mtpu-Error"
+TOKEN_HEADER = "X-Mtpu-Token"
+
+
+def cluster_token(secret: str) -> str:
+    """Shared-secret auth token for intra-cluster REST (the reference signs
+    internode requests with the root credentials; same idea)."""
+    return hmac.new(secret.encode(), b"minio-tpu-internode", hashlib.sha256).hexdigest()
+
+
+def error_to_name(e: Exception) -> str:
+    return type(e).__name__
+
+
+def name_to_error(name: str, msg: str = "") -> Exception:
+    cls = getattr(errors, name, None)
+    if cls is not None and isinstance(cls, type) and issubclass(cls, Exception):
+        try:
+            return cls(msg)
+        except TypeError:
+            return cls()
+    return errors.StorageError(f"{name}: {msg}")
+
+
+class RestClient:
+    """HTTP client to one peer with connection reuse, failure tracking and
+    periodic reconnect probing (internal/rest/client.go behavior)."""
+
+    HEALTH_INTERVAL = 3.0
+
+    def __init__(self, base_url: str, token: str, timeout: float = 30.0):
+        self.base_url = base_url.rstrip("/")
+        self.token = token
+        self.timeout = timeout
+        self.session = requests.Session()
+        self.session.headers[TOKEN_HEADER] = token
+        self._online = True
+        self._last_failure = 0.0
+        self._lock = threading.Lock()
+
+    def is_online(self) -> bool:
+        with self._lock:
+            if self._online:
+                return True
+            # Off-line: allow a probe every HEALTH_INTERVAL.
+            return (time.monotonic() - self._last_failure) > self.HEALTH_INTERVAL
+
+    def _mark(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self._online = True
+            else:
+                self._online = False
+                self._last_failure = time.monotonic()
+
+    def call(
+        self,
+        path: str,
+        args: dict | None = None,
+        body: bytes | None = None,
+        raw_response: bool = False,
+        timeout: float | None = None,
+    ):
+        """POST base/path. args -> msgpack body (or query when body given).
+        Returns msgpack-decoded object, or raw bytes if raw_response."""
+        url = self.base_url + path
+        try:
+            if body is not None:
+                r = self.session.post(
+                    url,
+                    params={k: str(v) for k, v in (args or {}).items()},
+                    data=body,
+                    timeout=timeout or self.timeout,
+                )
+            else:
+                r = self.session.post(
+                    url,
+                    data=msgpack.packb(args or {}, use_bin_type=True),
+                    headers={"Content-Type": "application/x-msgpack"},
+                    timeout=timeout or self.timeout,
+                )
+        except requests.RequestException as e:
+            self._mark(False)
+            raise errors.DiskNotFound(f"{url}: {e}")
+        self._mark(True)
+        if r.status_code != 200:
+            name = r.headers.get(ERROR_HEADER, "StorageError")
+            raise name_to_error(name, r.text[:200])
+        if raw_response:
+            return r.content
+        if not r.content:
+            return None
+        return msgpack.unpackb(r.content, raw=False, strict_map_key=False)
