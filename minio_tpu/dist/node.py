@@ -175,6 +175,8 @@ class Node:
         lockers: list = [self.locker] + [RemoteLocker(u, self.token) for u in self.peer_urls]
         self.ns_lock = NamespaceLock(lockers)
         self.pools.ns_lock = self.ns_lock
+        for s in sets.sets:
+            s.ns_lock = self.ns_lock
         self.iam = IAMSys(self.creds.access_key, self.creds.secret_key)
         self.s3 = S3Server(self.pools, self.iam, region=self.region, check_skew=False)
         self.notification = NotificationSys(

@@ -42,6 +42,18 @@ BLOCK_SIZE = 1 << 20  # blockSizeV2 (cmd/object-api-common.go:40)
 META_BUCKET = ".minio_tpu.sys"
 DIGEST_LEN = 32
 
+_NS_LOCK_SINGLETON = None
+
+
+def _process_ns_lock():
+    """Shared per-process namespace lock (single-node default)."""
+    global _NS_LOCK_SINGLETON
+    if _NS_LOCK_SINGLETON is None:
+        from ..dist.locks import NamespaceLock
+
+        _NS_LOCK_SINGLETON = NamespaceLock()
+    return _NS_LOCK_SINGLETON
+
 
 def default_parity(drive_count: int) -> int:
     """Drive-count-based default parity (getDefaultParityBlocks,
@@ -101,12 +113,17 @@ class ErasureObjects:
         codec: codec_mod.BlockCodec | None = None,
         set_index: int = 0,
         pool_index: int = 0,
+        ns_lock=None,
     ):
         self.disks = disks
         self.set_index = set_index
         self.pool_index = pool_index
         self.parity = default_parity(len(disks)) if parity is None else parity
         self.codec = codec or codec_mod.default_codec()
+        # Namespace lock: serializes writers per object. Defaults to a
+        # process-local locker; Node.build swaps in the dsync quorum lockers
+        # (reference: NSLock via dsync, cmd/erasure-object.go:933-941).
+        self.ns_lock = ns_lock if ns_lock is not None else _process_ns_lock()
 
     # ------------------------------------------------------------------ util
 
@@ -267,7 +284,13 @@ class ErasureObjects:
             disk.create_file(META_BUCKET, f"{tmp_path}/part.1", shard_files[shard_row])
             disk.rename_data(META_BUCKET, tmp_path, fi, bucket, object_name)
 
-        results = meta_mod.parallel_map(write_one, list(enumerate(self._online())))
+        lk = self.ns_lock.new(bucket, object_name)
+        if not lk.acquire(writer=True, timeout=30):
+            raise errors.ErasureWriteQuorum(bucket, object_name, "namespace lock timeout")
+        try:
+            results = meta_mod.parallel_map(write_one, list(enumerate(self._online())))
+        finally:
+            lk.release()
         errs = [e for _, e in results]
         n_ok = sum(1 for e in errs if e is None)
         if n_ok < write_quorum:
