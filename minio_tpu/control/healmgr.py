@@ -1,0 +1,143 @@
+"""Background healing: MRF queue, heal sequences, new-disk monitor.
+
+Role of the reference's heal trio (SURVEY.md section 2.7 Healing):
+  * MRFState (cmd/mrf.go): "most recently failed" writes -- puts that
+    succeeded at quorum but failed on some drives -- queued for async repair
+    (fed from erasure-object.go:1430 addPartial);
+  * healSequence (cmd/admin-heal-ops.go:396): admin-triggered namespace
+    sweeps with progress state the admin API can poll;
+  * new-disk monitor (cmd/background-newdisks-heal-ops.go:314): detects
+    drives that came back empty/unformatted and re-protects their data.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ..utils import errors
+
+
+@dataclass
+class MRFEntry:
+    bucket: str
+    object_name: str
+    version_id: str = ""
+    queued: float = field(default_factory=time.time)
+
+
+class MRFQueue:
+    """Async repair queue for partially-failed writes."""
+
+    def __init__(self, layer, maxsize: int = 100_000):
+        self.layer = layer
+        self.q: queue.Queue[MRFEntry] = queue.Queue(maxsize=maxsize)
+        self.healed = 0
+        self.failed = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="mrf-heal")
+        self._thread.start()
+
+    def add(self, bucket: str, object_name: str, version_id: str = "") -> None:
+        try:
+            self.q.put_nowait(MRFEntry(bucket, object_name, version_id))
+        except queue.Full:
+            pass  # the scanner sweep will find it later
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                entry = self.q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            try:
+                self.layer.heal_object(entry.bucket, entry.object_name, entry.version_id)
+                self.healed += 1
+            except errors.StorageError:
+                self.failed += 1
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def pending(self) -> int:
+        return self.q.qsize()
+
+
+@dataclass
+class HealSequenceStatus:
+    seq_id: str
+    path: str
+    started: float
+    finished: float = 0.0
+    scanned: int = 0
+    healed: int = 0
+    failed: int = 0
+    running: bool = True
+
+
+class HealManager:
+    """Admin-facing heal sequences + drive monitor."""
+
+    def __init__(self, layer):
+        self.layer = layer
+        self.sequences: dict[str, HealSequenceStatus] = {}
+        self._lock = threading.Lock()
+
+    # -- heal sequences ------------------------------------------------------
+
+    def start_sequence(self, bucket: str = "", prefix: str = "") -> str:
+        seq_id = uuid.uuid4().hex[:12]
+        status = HealSequenceStatus(seq_id=seq_id, path=f"{bucket}/{prefix}", started=time.time())
+        with self._lock:
+            self.sequences[seq_id] = status
+        t = threading.Thread(
+            target=self._run_sequence, args=(status, bucket, prefix), daemon=True
+        )
+        t.start()
+        return seq_id
+
+    def _run_sequence(self, status: HealSequenceStatus, bucket: str, prefix: str) -> None:
+        try:
+            buckets = (
+                [bucket] if bucket else [b.name for b in self.layer.list_buckets()]
+            )
+            for b in buckets:
+                self.layer.heal_bucket(b)
+                for pool in self.layer.pools:
+                    try:
+                        names = [n for n, _ in pool._walk_merged(b, prefix)]
+                    except errors.StorageError:
+                        continue
+                    for name in names:
+                        status.scanned += 1
+                        try:
+                            res = self.layer.heal_object(b, name)
+                            if res.disks_healed:
+                                status.healed += 1
+                        except errors.StorageError:
+                            status.failed += 1
+        finally:
+            status.running = False
+            status.finished = time.time()
+
+    def get_status(self, seq_id: str) -> HealSequenceStatus | None:
+        with self._lock:
+            return self.sequences.get(seq_id)
+
+    # -- drive monitor -------------------------------------------------------
+
+    def check_drives(self) -> list[str]:
+        """Drives currently offline or missing format (monitor loop body;
+        callers run this periodically)."""
+        bad = []
+        for pool in self.layer.pools:
+            for s in pool.sets:
+                for d in s.disks:
+                    if d is None:
+                        bad.append("<missing>")
+                    elif not d.is_online() or not d.disk_id():
+                        bad.append(d.endpoint())
+        return bad

@@ -1,0 +1,119 @@
+"""Structured logging + per-request audit log.
+
+Role of the reference's internal/logger (console/HTTP targets, audit.go,
+reqinfo.go, logonce.go): JSON-structured server logs with pluggable targets,
+an audit record for every API call, and once-per-error deduplication.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+import traceback
+from typing import Any
+
+from .pubsub import PubSub
+
+
+class LogTarget:
+    def send(self, entry: dict) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class ConsoleTarget(LogTarget):
+    def __init__(self, stream=None, as_json: bool = False):
+        self.stream = stream or sys.stderr
+        self.as_json = as_json
+
+    def send(self, entry: dict) -> None:
+        if self.as_json:
+            self.stream.write(json.dumps(entry) + "\n")
+        else:
+            lvl = entry.get("level", "INFO")
+            self.stream.write(f"[{lvl}] {entry.get('message', '')}\n")
+        self.stream.flush()
+
+
+class WebhookTarget(LogTarget):
+    """HTTP log/audit sink (internal/logger/target/http role)."""
+
+    def __init__(self, endpoint: str, timeout: float = 5.0):
+        import requests
+
+        self.endpoint = endpoint
+        self.session = requests.Session()
+        self.timeout = timeout
+
+    def send(self, entry: dict) -> None:
+        try:
+            self.session.post(self.endpoint, json=entry, timeout=self.timeout)
+        except Exception:  # noqa: BLE001 - logging must never take down serving
+            pass
+
+
+class Logger:
+    def __init__(self):
+        self.targets: list[LogTarget] = [ConsoleTarget()]
+        self.audit_targets: list[LogTarget] = []
+        self.audit_hub = PubSub()  # live `admin trace --call audit` style taps
+        self._once: set[str] = set()
+        self._lock = threading.Lock()
+
+    def log(self, level: str, message: str, **fields: Any) -> None:
+        entry = {"level": level, "message": message, "time": time.time(), **fields}
+        for t in self.targets:
+            t.send(entry)
+
+    def info(self, message: str, **fields: Any) -> None:
+        self.log("INFO", message, **fields)
+
+    def error(self, message: str, exc: BaseException | None = None, **fields: Any) -> None:
+        if exc is not None:
+            fields["trace"] = "".join(
+                traceback.format_exception(type(exc), exc, exc.__traceback__)
+            )[-4000:]
+        self.log("ERROR", message, **fields)
+
+    def log_once(self, message: str, key: str | None = None, **fields: Any) -> None:
+        """Deduplicated error logging (internal/logger/logonce.go role)."""
+        k = key or message
+        with self._lock:
+            if k in self._once:
+                return
+            self._once.add(k)
+        self.error(message, **fields)
+
+    # -- audit (logger/audit.go role: one record per API call) ---------------
+
+    def audit(
+        self,
+        api: str,
+        bucket: str = "",
+        object_name: str = "",
+        status_code: int = 0,
+        duration_ms: float = 0.0,
+        access_key: str = "",
+        remote: str = "",
+        request_id: str = "",
+        **extra: Any,
+    ) -> None:
+        if not self.audit_targets and self.audit_hub.num_subscribers() == 0:
+            return
+        entry = {
+            "version": "1",
+            "time": time.time(),
+            "api": {"name": api, "bucket": bucket, "object": object_name,
+                    "statusCode": status_code, "timeToResponseMs": duration_ms},
+            "accessKey": access_key,
+            "remotehost": remote,
+            "requestID": request_id,
+            **extra,
+        }
+        self.audit_hub.publish(entry)
+        for t in self.audit_targets:
+            t.send(entry)
+
+
+GLOBAL_LOGGER = Logger()

@@ -1,0 +1,153 @@
+"""Prometheus metrics: request counters, latency windows, storage gauges.
+
+Role of the reference's cmd/metrics-v2.go (MetricsGroup cached collectors,
+TTFB histograms :977) + http-stats.go + last-minute.go: per-API counters and
+latency tracking exposed as Prometheus text at /minio/v2/metrics/cluster.
+Pure stdlib -- the exposition format is simple text.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+
+
+class LastMinuteLatency:
+    """Sliding 60s window of (count, total_seconds) per second bucket
+    (cmd/last-minute.go role)."""
+
+    def __init__(self):
+        self._buckets: deque[tuple[int, int, float]] = deque()  # (sec, n, total)
+        self._lock = threading.Lock()
+
+    def add(self, seconds: float) -> None:
+        now = int(time.time())
+        with self._lock:
+            if self._buckets and self._buckets[-1][0] == now:
+                s, n, t = self._buckets[-1]
+                self._buckets[-1] = (s, n + 1, t + seconds)
+            else:
+                self._buckets.append((now, 1, seconds))
+            cutoff = now - 60
+            while self._buckets and self._buckets[0][0] < cutoff:
+                self._buckets.popleft()
+
+    def stats(self) -> tuple[int, float]:
+        now = int(time.time())
+        cutoff = now - 60
+        with self._lock:
+            n = sum(b[1] for b in self._buckets if b[0] >= cutoff)
+            t = sum(b[2] for b in self._buckets if b[0] >= cutoff)
+        return n, t
+
+
+class MetricsSys:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.http_requests: dict[tuple[str, int], int] = defaultdict(int)
+        self.api_calls: dict[str, int] = defaultdict(int)
+        self.api_errors: dict[str, int] = defaultdict(int)
+        self.api_latency: dict[str, LastMinuteLatency] = defaultdict(LastMinuteLatency)
+        self.bytes_received = 0
+        self.bytes_sent = 0
+        self.encode_batches = 0
+        self.encode_blocks = 0
+        self.encode_device_ns = 0
+        self.start_time = time.time()
+        self.layer = None  # set by the server for storage gauges
+
+    # -- recording -----------------------------------------------------------
+
+    def record_http(self, method: str, status: int) -> None:
+        with self._lock:
+            self.http_requests[(method, status)] += 1
+
+    def record_api(self, api: str, seconds: float, ok: bool, rx: int = 0, tx: int = 0) -> None:
+        with self._lock:
+            self.api_calls[api] += 1
+            if not ok:
+                self.api_errors[api] += 1
+            self.bytes_received += rx
+            self.bytes_sent += tx
+        self.api_latency[api].add(seconds)
+
+    def record_encode(self, blocks: int, device_ns: int) -> None:
+        with self._lock:
+            self.encode_batches += 1
+            self.encode_blocks += blocks
+            self.encode_device_ns += device_ns
+
+    # -- exposition ----------------------------------------------------------
+
+    def render(self) -> str:
+        lines: list[str] = []
+
+        def metric(name: str, value, labels: dict | None = None, help_: str = ""):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+                lines.append(f"# TYPE {name} counter")
+            if labels:
+                lab = ",".join(f'{k}="{v}"' for k, v in labels.items())
+                lines.append(f"{name}{{{lab}}} {value}")
+            else:
+                lines.append(f"{name} {value}")
+
+        with self._lock:
+            http = dict(self.http_requests)
+            calls = dict(self.api_calls)
+            errs = dict(self.api_errors)
+            rx, tx = self.bytes_received, self.bytes_sent
+            enc = (self.encode_batches, self.encode_blocks, self.encode_device_ns)
+
+        metric("minio_tpu_uptime_seconds", round(time.time() - self.start_time, 1),
+               help_="Server uptime.")
+        metric("minio_tpu_s3_traffic_received_bytes", rx, help_="Total S3 bytes received.")
+        metric("minio_tpu_s3_traffic_sent_bytes", tx, help_="Total S3 bytes sent.")
+        lines.append("# HELP minio_tpu_http_requests_total HTTP requests by method/status.")
+        lines.append("# TYPE minio_tpu_http_requests_total counter")
+        for (method, status), n in sorted(http.items()):
+            metric("minio_tpu_http_requests_total", n, {"method": method, "status": status})
+        lines.append("# HELP minio_tpu_s3_requests_total S3 API calls.")
+        lines.append("# TYPE minio_tpu_s3_requests_total counter")
+        for api, n in sorted(calls.items()):
+            metric("minio_tpu_s3_requests_total", n, {"api": api})
+        for api, n in sorted(errs.items()):
+            metric("minio_tpu_s3_requests_errors_total", n, {"api": api})
+        for api, lat in self.api_latency.items():
+            n, t = lat.stats()
+            if n:
+                metric(
+                    "minio_tpu_s3_request_seconds_last_minute",
+                    round(t / n, 6),
+                    {"api": api},
+                )
+        metric("minio_tpu_encode_batches_total", enc[0],
+               help_="Device encode batches run.")
+        metric("minio_tpu_encode_blocks_total", enc[1])
+        metric("minio_tpu_encode_device_seconds_total", round(enc[2] / 1e9, 6))
+
+        if self.layer is not None:
+            total = free = 0
+            online = offline = 0
+            for p in self.layer.pools:
+                for d in p.disks:
+                    if d is None or not d.is_online():
+                        offline += 1
+                        continue
+                    online += 1
+                    try:
+                        di = d.disk_info()
+                        total += di.total
+                        free += di.free
+                    except Exception:  # noqa: BLE001
+                        offline += 1
+            metric("minio_tpu_cluster_capacity_raw_total_bytes", total,
+                   help_="Total raw capacity.")
+            metric("minio_tpu_cluster_capacity_raw_free_bytes", free)
+            metric("minio_tpu_cluster_drives_online_total", online)
+            metric("minio_tpu_cluster_drives_offline_total", offline)
+        return "\n".join(lines) + "\n"
+
+
+GLOBAL_METRICS = MetricsSys()

@@ -1,0 +1,158 @@
+"""Background data scanner: usage accounting, heal sampling, lifecycle.
+
+Role of the reference's cmd/data-scanner.go (initDataScanner :73, scanFolder
+:368, dynamicSleeper :1277): a background loop that walks the namespace,
+accumulates the usage tree, deep-scans a sample of objects for bitrot /
+missing shards (1-in-N like the reference's 1/1024 sampling), triggers heals,
+and evaluates lifecycle expiry. In a cluster the scanner runs only on the
+node holding the leader lock (runDataScanner :99-111).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+from ..storage.xlmeta import XLMeta
+from ..utils import errors
+from .lifecycle import Lifecycle
+from .usage import DataUsageCache
+
+HEAL_SAMPLE = 128  # deep-check 1 in N objects per cycle (ref: 1/1024)
+
+
+class DynamicSleeper:
+    """Load-adaptive throttle: sleep proportional to work time
+    (data-scanner.go:1277)."""
+
+    def __init__(self, factor: float = 10.0, max_sleep: float = 1.0):
+        self.factor = factor
+        self.max_sleep = max_sleep
+
+    def sleep(self, work_seconds: float) -> None:
+        time.sleep(min(work_seconds * self.factor, self.max_sleep))
+
+
+class DataScanner:
+    def __init__(
+        self,
+        layer,
+        bucket_meta=None,
+        notifier=None,
+        cycle_seconds: float = 60.0,
+        heal_sample: int = HEAL_SAMPLE,
+        leader_lock=None,
+        store=None,
+    ):
+        self.layer = layer
+        self.bucket_meta = bucket_meta
+        self.notifier = notifier
+        self.cycle_seconds = cycle_seconds
+        self.heal_sample = heal_sample
+        self.leader_lock = leader_lock
+        self.store = store
+        self.usage = DataUsageCache()
+        self.cycles_completed = 0
+        self.objects_healed = 0
+        self.objects_expired = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._sleeper = DynamicSleeper()
+        self._rng = random.Random(0x5CA77E2)
+
+    # -- lifecycle of the scanner itself -------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True, name="data-scanner")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                if self.leader_lock is None or self.leader_lock.acquire(
+                    writer=True, timeout=1.0
+                ):
+                    try:
+                        self.scan_cycle()
+                    finally:
+                        if self.leader_lock is not None:
+                            self.leader_lock.release()
+            except Exception:  # noqa: BLE001 - scanner must never die
+                pass
+            self._stop.wait(self.cycle_seconds)
+
+    # -- one cycle -----------------------------------------------------------
+
+    def scan_cycle(self) -> None:
+        fresh = DataUsageCache()
+        for bucket in [b.name for b in self.layer.list_buckets()]:
+            lc = self._lifecycle_for(bucket)
+            for pool in self.layer.pools:
+                try:
+                    walker = pool._walk_merged(bucket)
+                except errors.StorageError:
+                    continue
+                for name, raw in walker:
+                    t0 = time.perf_counter()
+                    try:
+                        meta = XLMeta.from_bytes(raw)
+                        fi = meta.file_info("")
+                    except errors.StorageError:
+                        continue
+                    if not fi.deleted:
+                        fresh.record(bucket, name, fi.size, len(meta.versions))
+                    # Lifecycle expiry.
+                    if lc is not None:
+                        action = lc.eval(name, fi.mod_time, fi.deleted)
+                        if action == "expire":
+                            self._expire(bucket, name)
+                            continue
+                    # Heal sampling: deep-verify 1 in heal_sample objects.
+                    if self._rng.randrange(self.heal_sample) == 0:
+                        self._deep_check(bucket, name)
+                    self._sleeper.sleep(time.perf_counter() - t0)
+        fresh.finish()
+        self.usage = fresh
+        self.cycles_completed += 1
+        if self.store is not None:
+            try:
+                self.store.put("scanner/data-usage.json", fresh.to_bytes())
+            except errors.StorageError:
+                pass
+
+    def _lifecycle_for(self, bucket: str) -> Lifecycle | None:
+        if self.bucket_meta is None:
+            return None
+        raw = self.bucket_meta.get(bucket).lifecycle_xml
+        if not raw:
+            return None
+        try:
+            return Lifecycle.from_xml(raw)
+        except Exception:  # noqa: BLE001
+            return None
+
+    def _expire(self, bucket: str, name: str) -> None:
+        try:
+            self.layer.delete_object(bucket, name)
+            self.objects_expired += 1
+            if self.notifier is not None:
+                from .events import Event
+
+                self.notifier.emit(
+                    Event(name="s3:ObjectRemoved:Expired", bucket=bucket, object_name=name)
+                )
+        except errors.StorageError:
+            pass
+
+    def _deep_check(self, bucket: str, name: str) -> None:
+        try:
+            res = self.layer.heal_object(bucket, name, dry_run=True)
+            if res.disks_healed:
+                real = self.layer.heal_object(bucket, name)
+                self.objects_healed += real.disks_healed and 1 or 0
+        except errors.StorageError:
+            pass
