@@ -1,0 +1,338 @@
+"""Admin REST API: cluster management surface.
+
+Role of the reference's admin handlers (cmd/admin-handlers*.go, ~5K LoC,
+mounted at /minio/admin/v3): server/cluster info, data usage, config KV,
+user/policy/service-account management, heal control, top locks, live trace
+streaming, profiling, speedtest. Mounted at /mtpu/admin/v1; every call is
+SigV4-authenticated and authorized against the admin:* action namespace.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+import urllib.parse
+from dataclasses import dataclass
+
+from aiohttp import web
+
+from ..control.iam import IAMSys
+from ..utils import errors as oerr
+from .auth import SigV4Verifier
+from .errors import S3Error
+
+ADMIN_PREFIX = "/mtpu/admin/v1"
+
+
+@dataclass
+class AdminContext:
+    layer: object
+    iam: IAMSys
+    verifier: SigV4Verifier
+    config: object | None = None
+    scanner: object | None = None
+    healmgr: object | None = None
+    metrics: object | None = None
+    trace: object | None = None
+    locker: object | None = None
+    notification: object | None = None  # peer fan-out
+
+
+def make_admin_app(ctx: AdminContext) -> web.Application:
+    app = web.Application()
+
+    async def authenticate(request: web.Request, body: bytes) -> str:
+        headers = dict(request.headers)
+        query = [(k, v) for k, v in request.rel_url.query.items()]
+        path = urllib.parse.unquote(request.path_qs.split("?")[0])
+        ak = await asyncio.to_thread(
+            ctx.verifier.verify_signed, request.method, path, query, headers, body
+        )
+        if not ctx.iam.is_allowed(ak, "admin:*", "arn:aws:s3:::*"):
+            raise S3Error("AccessDenied")
+        return ak
+
+    def handler(fn, stream: bool = False):
+        async def wrapped(request: web.Request):
+            if not getattr(ctx, "ready", True):
+                return web.json_response({"Code": "ServerNotInitialized"}, status=503)
+            body = await request.read()
+            try:
+                await authenticate(request, body)
+                if stream:
+                    return await fn(request, body)
+                result = await asyncio.to_thread(fn, request, body)
+                if isinstance(result, web.Response):
+                    return result
+                return web.json_response(result)
+            except S3Error as e:
+                return web.json_response(
+                    {"Code": e.code, "Message": e.message}, status=e.api.http_status
+                )
+            except oerr.StorageError as e:
+                return web.json_response(
+                    {"Code": type(e).__name__, "Message": str(e)}, status=400
+                )
+
+        return wrapped
+
+    # -- info / usage --------------------------------------------------------
+
+    def h_info(request, body):
+        drives = []
+        online = offline = 0
+        for p in ctx.layer.pools:
+            for d in p.disks:
+                if d is None:
+                    offline += 1
+                    drives.append({"state": "offline"})
+                    continue
+                try:
+                    di = d.disk_info()
+                    online += 1
+                    drives.append(
+                        {
+                            "endpoint": di.endpoint,
+                            "state": "ok",
+                            "totalspace": di.total,
+                            "availspace": di.free,
+                            "uuid": di.disk_id,
+                        }
+                    )
+                except oerr.DiskError:
+                    offline += 1
+                    drives.append({"endpoint": d.endpoint(), "state": "offline"})
+        info = {
+            "mode": "online",
+            "deploymentID": getattr(ctx.layer.pools[0], "deployment_id", ""),
+            "drives": drives,
+            "drivesOnline": online,
+            "drivesOffline": offline,
+            "buckets": {"count": len(ctx.layer.list_buckets())},
+        }
+        if ctx.scanner is not None:
+            info["usage"] = ctx.scanner.usage.summary()
+        if ctx.notification is not None:
+            info["servers"] = ctx.notification.server_info_all()
+        return info
+
+    def h_datausage(request, body):
+        if ctx.scanner is None:
+            return {}
+        return ctx.scanner.usage.summary()
+
+    # -- config --------------------------------------------------------------
+
+    def h_get_config(request, body):
+        if ctx.config is None:
+            return {}
+        return ctx.config.dump()
+
+    def h_set_config(request, body):
+        if ctx.config is None:
+            raise S3Error("NotImplemented")
+        doc = json.loads(body)
+        dynamic = ctx.config.set(doc["subsys"], doc["key"], doc["value"])
+        return {"dynamic": dynamic, "restart": not dynamic}
+
+    # -- users / policies ----------------------------------------------------
+
+    def h_list_users(request, body):
+        return {
+            ak: {"status": u.status, "policies": u.policies}
+            for ak, u in ctx.iam.list_users().items()
+        }
+
+    def h_add_user(request, body):
+        doc = json.loads(body)
+        ctx.iam.add_user(doc["accessKey"], doc["secretKey"], doc.get("policies", []))
+        if ctx.notification is not None:
+            ctx.notification.reload_iam_all()
+        return {"ok": True}
+
+    def h_remove_user(request, body):
+        ctx.iam.remove_user(request.match_info["ak"])
+        return {"ok": True}
+
+    def h_user_status(request, body):
+        doc = json.loads(body)
+        ctx.iam.set_user_status(request.match_info["ak"], doc["status"])
+        return {"ok": True}
+
+    def h_user_policy(request, body):
+        doc = json.loads(body)
+        ctx.iam.attach_policy(request.match_info["ak"], doc["policies"])
+        return {"ok": True}
+
+    def h_list_policies(request, body):
+        from ..control import policy as policy_mod
+
+        out = dict(ctx.iam.custom_policies)
+        for name, doc in policy_mod.CANNED.items():
+            out.setdefault(name, doc)
+        return out
+
+    def h_put_policy(request, body):
+        ctx.iam.set_policy(request.match_info["name"], json.loads(body))
+        return {"ok": True}
+
+    def h_delete_policy(request, body):
+        ctx.iam.delete_policy(request.match_info["name"])
+        return {"ok": True}
+
+    def h_service_account(request, body):
+        doc = json.loads(body) if body else {}
+        parent = doc.get("parent") or ctx.iam.root.access_key
+        creds = ctx.iam.new_service_account(parent, doc.get("policy"))
+        return {"accessKey": creds.access_key, "secretKey": creds.secret_key}
+
+    # -- heal ----------------------------------------------------------------
+
+    def h_heal_start(request, body):
+        if ctx.healmgr is None:
+            raise S3Error("NotImplemented")
+        doc = json.loads(body) if body else {}
+        seq = ctx.healmgr.start_sequence(doc.get("bucket", ""), doc.get("prefix", ""))
+        return {"healSequence": seq}
+
+    def h_heal_status(request, body):
+        st = ctx.healmgr.get_status(request.match_info["seq"]) if ctx.healmgr else None
+        if st is None:
+            raise S3Error("InvalidArgument", "unknown heal sequence")
+        return {
+            "id": st.seq_id,
+            "path": st.path,
+            "running": st.running,
+            "scanned": st.scanned,
+            "healed": st.healed,
+            "failed": st.failed,
+        }
+
+    # -- locks / service -----------------------------------------------------
+
+    def h_top_locks(request, body):
+        if ctx.locker is None:
+            return []
+        return ctx.locker.top_locks()
+
+    def h_force_unlock(request, body):
+        doc = json.loads(body)
+        if ctx.locker is not None:
+            ctx.locker.force_unlock(doc["resource"])
+        return {"ok": True}
+
+    def h_service(request, body):
+        doc = json.loads(body) if body else {}
+        action = doc.get("action", "")
+        if action not in ("restart", "stop"):
+            raise S3Error("InvalidArgument", "action must be restart|stop")
+        # In-process server: acknowledge; the process manager does the rest
+        # (the reference signals itself, cmd/service.go).
+        return {"ok": True, "action": action}
+
+    def h_metrics(request, body):
+        if ctx.metrics is None:
+            raise S3Error("NotImplemented")
+        return web.Response(text=ctx.metrics.render(), content_type="text/plain")
+
+    def h_speedtest(request, body):
+        doc = json.loads(body) if body else {}
+        size = int(doc.get("size", 1 << 20))
+        count = int(doc.get("count", 8))
+        import os as _os
+
+        payload = _os.urandom(size)
+        bucket = ".minio_tpu.sys"
+        t0 = time.perf_counter()
+        for i in range(count):
+            ctx.layer.pools[0].put_object(bucket, f"speedtest/o{i}", payload)
+        put_t = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for i in range(count):
+            ctx.layer.pools[0].get_object(bucket, f"speedtest/o{i}")
+        get_t = time.perf_counter() - t0
+        for i in range(count):
+            try:
+                ctx.layer.pools[0].delete_object(bucket, f"speedtest/o{i}")
+            except oerr.StorageError:
+                pass
+        return {
+            "putSpeedBytesPerSec": size * count / put_t if put_t else 0,
+            "getSpeedBytesPerSec": size * count / get_t if get_t else 0,
+        }
+
+    # -- profiling (admin-handlers.go:511 role, via cProfile) ----------------
+
+    _profiler: dict = {}
+
+    def h_profile_start(request, body):
+        import cProfile
+
+        if "p" in _profiler:
+            raise S3Error("InvalidArgument", "profiling already running")
+        p = cProfile.Profile()
+        p.enable()
+        _profiler["p"] = p
+        return {"ok": True}
+
+    def h_profile_stop(request, body):
+        import io
+        import pstats
+
+        p = _profiler.pop("p", None)
+        if p is None:
+            raise S3Error("InvalidArgument", "profiling not running")
+        p.disable()
+        buf = io.StringIO()
+        pstats.Stats(p, stream=buf).sort_stats("cumulative").print_stats(50)
+        return web.Response(text=buf.getvalue(), content_type="text/plain")
+
+    # -- trace streaming (admin-handlers.go:1103 role) -----------------------
+
+    async def h_trace(request: web.Request, body):
+        if ctx.trace is None:
+            raise S3Error("NotImplemented")
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-ndjson"
+        await resp.prepare(request)
+        sub = ctx.trace.subscribe()
+        try:
+            while True:
+                try:
+                    item = await asyncio.to_thread(sub.get, True, 1.0)
+                except Exception:  # queue.Empty
+                    try:
+                        await resp.write(b"")  # liveness check
+                    except (ConnectionResetError, RuntimeError):
+                        break
+                    continue
+                await resp.write((json.dumps(item) + "\n").encode())
+        finally:
+            ctx.trace.unsubscribe(sub)
+        return resp
+
+    app.router.add_get("/info", handler(h_info))
+    app.router.add_get("/datausage", handler(h_datausage))
+    app.router.add_get("/config", handler(h_get_config))
+    app.router.add_put("/config", handler(h_set_config))
+    app.router.add_get("/users", handler(h_list_users))
+    app.router.add_post("/users", handler(h_add_user))
+    app.router.add_delete("/users/{ak}", handler(h_remove_user))
+    app.router.add_put("/users/{ak}/status", handler(h_user_status))
+    app.router.add_put("/users/{ak}/policy", handler(h_user_policy))
+    app.router.add_get("/policies", handler(h_list_policies))
+    app.router.add_put("/policies/{name}", handler(h_put_policy))
+    app.router.add_delete("/policies/{name}", handler(h_delete_policy))
+    app.router.add_post("/service-accounts", handler(h_service_account))
+    app.router.add_post("/heal", handler(h_heal_start))
+    app.router.add_get("/heal/{seq}", handler(h_heal_status))
+    app.router.add_get("/toplocks", handler(h_top_locks))
+    app.router.add_post("/force-unlock", handler(h_force_unlock))
+    app.router.add_post("/service", handler(h_service))
+    app.router.add_get("/metrics", handler(h_metrics))
+    app.router.add_post("/speedtest", handler(h_speedtest))
+    app.router.add_post("/profile/start", handler(h_profile_start))
+    app.router.add_post("/profile/stop", handler(h_profile_stop))
+    app.router.add_get("/trace", handler(h_trace, stream=True))
+    return app

@@ -18,6 +18,7 @@ import base64
 import datetime
 import hashlib
 import secrets
+import time as _time
 import urllib.parse
 import xml.etree.ElementTree as ET
 from xml.sax.saxutils import escape
@@ -95,11 +96,14 @@ class S3Server:
         self.on_event = None
         self.metrics = None
         self.trace = None
+        self.notifier = None
+        self.logger = None
 
     # -- plumbing -------------------------------------------------------------
 
     async def _entry(self, request: web.Request) -> web.Response:
         request_id = secrets.token_hex(8).upper()
+        t0 = _time.perf_counter()
         try:
             resp = await self._dispatch(request, request_id)
         except S3Error as e:
@@ -112,10 +116,34 @@ class S3Server:
                 else S3Error("InvalidArgument", str(e))
             )
             resp = _xml(s3e.to_xml(request_id), s3e.api.http_status)
+        duration = _time.perf_counter() - t0
         resp.headers["x-amz-request-id"] = request_id
         resp.headers.setdefault("Server", "MinIO-TPU")
         if self.metrics is not None:
             self.metrics.record_http(request.method, resp.status)
+            bucket, key = self._split_path(request)
+            api_name = _api_name(request.method, bucket, key, request.rel_url.query)
+            self.metrics.record_api(api_name, duration, resp.status < 400)
+        if self.trace is not None and self.trace.enabled():
+            self.trace.publish(
+                "http",
+                method=request.method,
+                path=request.path,
+                status=resp.status,
+                duration_ms=round(duration * 1000, 3),
+                request_id=request_id,
+            )
+        if self.logger is not None:
+            bucket, key = self._split_path(request)
+            self.logger.audit(
+                api=_api_name(request.method, bucket, key, request.rel_url.query),
+                bucket=bucket,
+                object_name=key,
+                status_code=resp.status,
+                duration_ms=round(duration * 1000, 3),
+                remote=request.remote or "",
+                request_id=request_id,
+            )
         return resp
 
     def _split_path(self, request: web.Request) -> tuple[str, str]:
@@ -152,10 +180,27 @@ class S3Server:
         raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
 
     async def _dispatch(self, request: web.Request, request_id: str) -> web.Response:
+        if request.path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node"):
+            if self.metrics is None:
+                raise S3Error("NotImplemented")
+            return web.Response(text=self.metrics.render(), content_type="text/plain")
         bucket, key = self._split_path(request)
         body = await request.read()
         access_key = await asyncio.to_thread(self._authenticate, request, body)
         q = request.rel_url.query
+
+        # STS rides the root path and needs authentication only -- any
+        # signed principal may request temporary credentials
+        # (sts-handlers.go AssumeRole: auth, not policy).
+        if not bucket and request.method == "POST":
+            from . import sts as sts_mod
+
+            form = sts_mod.parse_form(body)
+            if "Action" in form:
+                return await asyncio.to_thread(
+                    sts_mod.handle_sts, self.iam, access_key, form
+                )
+
         action = policy_mod.s3_action(request.method, bucket, key, q)
         await asyncio.to_thread(self._authorize, access_key, action, bucket, key)
 
@@ -367,6 +412,8 @@ class S3Server:
             except ET.ParseError:
                 raise S3Error("MalformedXML")
         self.bucket_meta.update(bucket, **{field: body.decode() if body else ""})
+        if field == "notification_xml" and self.notifier is not None:
+            self.notifier.set_bucket_rules_from_xml(bucket, body)
         return web.Response(status=200 if body else 204)
 
     def _get_bucket_config(self, bucket: str, field: str, missing_code: str) -> web.Response:
@@ -744,11 +791,42 @@ class S3Server:
         return web.Response(status=204, headers=headers)
 
     def _emit(self, event_name: str, bucket: str, oi: ObjectInfo) -> None:
+        if self.notifier is not None:
+            from ..control.events import Event
+
+            try:
+                self.notifier.emit(
+                    Event(
+                        name=event_name,
+                        bucket=bucket,
+                        object_name=oi.name,
+                        etag=oi.etag,
+                        size=oi.size,
+                        version_id=oi.version_id,
+                        region=self.region,
+                    )
+                )
+            except Exception:
+                pass
         if self.on_event is not None:
             try:
                 self.on_event(event_name, bucket, oi)
             except Exception:
                 pass
+
+
+def _api_name(method: str, bucket: str, key: str, q) -> str:
+    if not bucket:
+        return "ListBuckets" if method == "GET" else "STS"
+    if key:
+        base = {"GET": "GetObject", "HEAD": "HeadObject", "PUT": "PutObject",
+                "DELETE": "DeleteObject", "POST": "PostObject"}.get(method, method)
+        if "uploadId" in q or "uploads" in q:
+            return "Multipart" + base
+        return base
+    names = {"GET": "ListObjects", "HEAD": "HeadBucket", "PUT": "PutBucket",
+             "DELETE": "DeleteBucket", "POST": "DeleteMultipleObjects"}
+    return names.get(method, method)
 
 
 def _parse_range(rng: str) -> tuple[int, int, bool]:

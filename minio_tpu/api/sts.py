@@ -1,0 +1,70 @@
+"""STS: temporary credentials (AssumeRole).
+
+Role of the reference's cmd/sts-handlers.go (AssumeRole :184): POST to the
+root path with Action=AssumeRole, signed with long-lived user credentials,
+returns short-lived credentials inheriting (and optionally narrowing, via the
+Policy parameter) the parent's permissions. The WebIdentity/LDAP/Certificate
+variants share this issuance path with different authenticators.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.parse
+from xml.sax.saxutils import escape
+
+from aiohttp import web
+
+from ..control.iam import IAMSys
+from .errors import S3Error
+
+STS_VERSION = "2011-06-15"
+MIN_DURATION = 900
+MAX_DURATION = 7 * 24 * 3600
+
+
+def _iso(ts: float) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime(ts))
+
+
+def handle_sts(iam: IAMSys, access_key: str, form: dict[str, str]) -> web.Response:
+    """Dispatch an STS action for an already-authenticated principal."""
+    action = form.get("Action", "")
+    if action == "AssumeRole":
+        return _assume_role(iam, access_key, form)
+    raise S3Error("NotImplemented", f"STS action {action}")
+
+
+def _assume_role(iam: IAMSys, access_key: str, form: dict[str, str]) -> web.Response:
+    if not access_key:
+        raise S3Error("AccessDenied")
+    duration = int(form.get("DurationSeconds", "3600"))
+    duration = max(MIN_DURATION, min(duration, MAX_DURATION))
+    session_policy = None
+    if form.get("Policy"):
+        try:
+            session_policy = json.loads(form["Policy"])
+        except ValueError:
+            raise S3Error("MalformedXML", "invalid session policy")
+    creds, expiry = iam.new_sts_credentials(access_key, duration, session_policy)
+    # Session token: we key STS creds by access key server-side, so the token
+    # is informational (the reference embeds signed claims; same contract to
+    # clients: pass it along, server validates).
+    token = f"mtpu-sts-{creds.access_key}"
+    body = f"""<AssumeRoleResponse xmlns="https://sts.amazonaws.com/doc/{STS_VERSION}/">
+  <AssumeRoleResult>
+    <Credentials>
+      <AccessKeyId>{escape(creds.access_key)}</AccessKeyId>
+      <SecretAccessKey>{escape(creds.secret_key)}</SecretAccessKey>
+      <SessionToken>{escape(token)}</SessionToken>
+      <Expiration>{_iso(expiry)}</Expiration>
+    </Credentials>
+  </AssumeRoleResult>
+  <ResponseMetadata/>
+</AssumeRoleResponse>"""
+    return web.Response(body=body.encode(), content_type="application/xml")
+
+
+def parse_form(body: bytes) -> dict[str, str]:
+    return {k: v[0] for k, v in urllib.parse.parse_qs(body.decode()).items()}

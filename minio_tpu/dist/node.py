@@ -182,6 +182,42 @@ class Node:
         self.notification = NotificationSys(
             [PeerClient(u, self.token) for u in self.peer_urls]
         )
+
+        # Control plane assembly (newAllSubsystems role, server-main.go:451).
+        from ..control.config import ConfigStore, ConfigSys
+        from ..control.events import EventNotifier
+        from ..control.healmgr import HealManager, MRFQueue
+        from ..control.logging import GLOBAL_LOGGER
+        from ..control.metrics import MetricsSys
+        from ..control.pubsub import GLOBAL_TRACE
+        from ..control.scanner import DataScanner
+
+        store = ConfigStore(self.pools)
+        self.config = ConfigSys(store)
+        try:
+            self.config.load()
+        except errors.StorageError:
+            pass
+        self.metrics = MetricsSys()
+        self.metrics.layer = self.pools
+        self.trace = GLOBAL_TRACE
+        self.logger = GLOBAL_LOGGER
+        self.notifier = EventNotifier()
+        self.healmgr = HealManager(self.pools)
+        self.mrf = MRFQueue(self.pools)
+        # Scanner leadership via a never-released dsync lock (runDataScanner
+        # :99-111); only one node in the cluster scans at a time.
+        self.scanner = DataScanner(
+            self.pools,
+            bucket_meta=self.s3.bucket_meta,
+            notifier=self.notifier,
+            leader_lock=self.ns_lock.new(".minio_tpu.sys", "leader/data-scanner"),
+            store=store,
+        )
+        self.s3.metrics = self.metrics
+        self.s3.trace = self.trace
+        self.s3.logger = self.logger
+        self.s3.notifier = self.notifier
         return self
 
     def make_app(self) -> web.Application:
@@ -195,6 +231,9 @@ class Node:
         app.add_subapp(STORAGE_PREFIX, make_storage_app(self.local_drives, self.token))
         app.add_subapp(LOCK_PREFIX, make_lock_app(self.locker, self.token))
         app.add_subapp(PEER_PREFIX, make_peer_app(self, self.token))
+        from ..api.admin import ADMIN_PREFIX, make_admin_app
+
+        app.add_subapp(ADMIN_PREFIX, make_admin_app(_LazyAdminContext(self)))
 
         async def s3_entry(request: web.Request):
             if self.s3 is None:
@@ -203,6 +242,58 @@ class Node:
 
         app.router.add_route("*", "/{tail:.*}", s3_entry)
         return app
+
+
+class _LazyAdminContext:
+    """Admin context resolving node components at request time, so the admin
+    router can be mounted before build() completes (it 503s until ready)."""
+
+    def __init__(self, node: "Node"):
+        self._node = node
+
+    @property
+    def ready(self) -> bool:
+        return self._node.s3 is not None
+
+    @property
+    def layer(self):
+        return self._node.pools
+
+    @property
+    def iam(self):
+        return self._node.iam
+
+    @property
+    def verifier(self):
+        return self._node.s3.verifier
+
+    @property
+    def config(self):
+        return getattr(self._node, "config", None)
+
+    @property
+    def scanner(self):
+        return getattr(self._node, "scanner", None)
+
+    @property
+    def healmgr(self):
+        return getattr(self._node, "healmgr", None)
+
+    @property
+    def metrics(self):
+        return getattr(self._node, "metrics", None)
+
+    @property
+    def trace(self):
+        return getattr(self._node, "trace", None)
+
+    @property
+    def locker(self):
+        return self._node.locker
+
+    @property
+    def notification(self):
+        return self._node.notification
 
 
 def _default_set_count(n: int) -> int:

@@ -1,0 +1,229 @@
+"""Admin API + STS tests against a full single-node server."""
+
+import json
+import threading
+import time
+import xml.etree.ElementTree as ET
+from types import SimpleNamespace
+
+import pytest
+
+from minio_tpu.api.server import ThreadedServer
+from minio_tpu.dist.node import Node
+from tests.s3client import S3TestClient
+from tests.test_dist import _free_port
+
+ROOT = "adminroot"
+SECRET = "admin-secret-key"
+ADMIN = "/mtpu/admin/v1"
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("adminsrv")
+    endpoints = [str(tmp / f"d{i}") for i in range(4)]
+    node = Node(endpoints, root_user=ROOT, root_password=SECRET)
+    port = _free_port()
+    ts = ThreadedServer(SimpleNamespace(app=node.make_app()), port=port)
+    ts.start()
+    node.build()
+    url = f"http://127.0.0.1:{port}"
+    client = S3TestClient(url, ROOT, SECRET)
+    yield {"client": client, "node": node, "url": url}
+    ts.stop()
+
+
+class TestAdmin:
+    def test_info(self, srv):
+        r = srv["client"].request("GET", f"{ADMIN}/info")
+        assert r.status_code == 200, r.text
+        info = r.json()
+        assert info["drivesOnline"] == 4
+        assert info["mode"] == "online"
+
+    def test_config_roundtrip(self, srv):
+        c = srv["client"]
+        r = c.request("GET", f"{ADMIN}/config")
+        assert r.json()["scanner"]["delay"] == "10"
+        r = c.request(
+            "PUT",
+            f"{ADMIN}/config",
+            body=json.dumps({"subsys": "scanner", "key": "delay", "value": "30"}).encode(),
+        )
+        assert r.json()["dynamic"] is True
+        assert c.request("GET", f"{ADMIN}/config").json()["scanner"]["delay"] == "30"
+
+    def test_user_management(self, srv):
+        c = srv["client"]
+        r = c.request(
+            "POST",
+            f"{ADMIN}/users",
+            body=json.dumps(
+                {"accessKey": "alice", "secretKey": "alice-secret-12", "policies": ["readwrite"]}
+            ).encode(),
+        )
+        assert r.status_code == 200, r.text
+        users = c.request("GET", f"{ADMIN}/users").json()
+        assert users["alice"]["policies"] == ["readwrite"]
+        # Alice can use S3 but not admin.
+        alice = S3TestClient(srv["url"], "alice", "alice-secret-12")
+        assert alice.make_bucket("alicebkt").status_code == 200
+        assert alice.request("GET", f"{ADMIN}/info").status_code == 403
+        # Disable and remove.
+        c.request("PUT", f"{ADMIN}/users/alice/status", body=b'{"status": "disabled"}')
+        assert alice.request("GET", "/").status_code == 403
+        assert c.request("DELETE", f"{ADMIN}/users/alice").status_code == 200
+
+    def test_policies_crud(self, srv):
+        c = srv["client"]
+        doc = {
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow", "Action": ["s3:GetObject"], "Resource": ["arn:aws:s3:::pub/*"]}],
+        }
+        assert c.request("PUT", f"{ADMIN}/policies/getonly", body=json.dumps(doc).encode()).status_code == 200
+        pols = c.request("GET", f"{ADMIN}/policies").json()
+        assert "getonly" in pols and "readonly" in pols
+        assert c.request("DELETE", f"{ADMIN}/policies/getonly").status_code == 200
+
+    def test_service_account(self, srv):
+        c = srv["client"]
+        r = c.request("POST", f"{ADMIN}/service-accounts", body=b"{}")
+        sa = r.json()
+        sa_client = S3TestClient(srv["url"], sa["accessKey"], sa["secretKey"])
+        assert sa_client.request("GET", "/").status_code == 200  # inherits root
+
+    def test_heal_sequence_api(self, srv):
+        c = srv["client"]
+        c.make_bucket("healapib")
+        c.put_object("healapib", "obj", b"y" * 200_000)
+        r = c.request("POST", f"{ADMIN}/heal", body=b"{}")
+        seq = r.json()["healSequence"]
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            st = c.request("GET", f"{ADMIN}/heal/{seq}").json()
+            if not st["running"]:
+                break
+            time.sleep(0.05)
+        assert st["scanned"] >= 1
+
+    def test_speedtest(self, srv):
+        r = srv["client"].request("POST", f"{ADMIN}/speedtest", body=b'{"size": 8192, "count": 2}')
+        res = r.json()
+        assert res["putSpeedBytesPerSec"] > 0
+
+    def test_toplocks_and_service(self, srv):
+        c = srv["client"]
+        assert c.request("GET", f"{ADMIN}/toplocks").status_code == 200
+        r = c.request("POST", f"{ADMIN}/service", body=b'{"action": "restart"}')
+        assert r.json()["ok"] is True
+        assert c.request("POST", f"{ADMIN}/service", body=b'{"action": "bogus"}').status_code == 400
+
+    def test_profiling(self, srv):
+        c = srv["client"]
+        assert c.request("POST", f"{ADMIN}/profile/start").status_code == 200
+        c.request("GET", "/")  # some work
+        r = c.request("POST", f"{ADMIN}/profile/stop")
+        assert r.status_code == 200
+        assert "cumulative" in r.text
+
+    def test_metrics_endpoints(self, srv):
+        c = srv["client"]
+        r = c.request("GET", f"{ADMIN}/metrics")
+        assert "minio_tpu_uptime_seconds" in r.text
+        # Public prometheus path (unauthenticated scrape).
+        import requests
+
+        r = requests.get(srv["url"] + "/minio/v2/metrics/cluster")
+        assert r.status_code == 200
+        assert "minio_tpu_cluster_drives_online_total 4" in r.text
+
+    def test_trace_stream(self, srv):
+        c = srv["client"]
+        results = []
+
+        def consume():
+            import requests
+
+            from minio_tpu.api.auth import sign_request
+
+            headers = sign_request(
+                c.creds, "GET", f"{ADMIN}/trace", [], {"host": c.host}, b""
+            )
+            headers.pop("host")
+            with requests.get(
+                srv["url"] + f"{ADMIN}/trace", headers=headers, stream=True, timeout=10
+            ) as r:
+                for line in r.iter_lines():
+                    if line:
+                        results.append(json.loads(line))
+                        break
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        for _ in range(5):
+            c.request("GET", "/")
+            time.sleep(0.1)
+        t.join(5)
+        assert results and results[0]["type"] == "http"
+
+
+class TestSTS:
+    def test_assume_role(self, srv):
+        c = srv["client"]
+        c.request(
+            "POST",
+            f"{ADMIN}/users",
+            body=json.dumps(
+                {"accessKey": "bob", "secretKey": "bob-secret-123", "policies": ["readonly"]}
+            ).encode(),
+        )
+        bob = S3TestClient(srv["url"], "bob", "bob-secret-123")
+        r = bob.request(
+            "POST",
+            "/",
+            body=b"Action=AssumeRole&Version=2011-06-15&DurationSeconds=900",
+        )
+        assert r.status_code == 200, r.text
+        root = ET.fromstring(r.content)
+        ns = "{https://sts.amazonaws.com/doc/2011-06-15/}"
+        ak = root.find(f".//{ns}AccessKeyId").text
+        sk = root.find(f".//{ns}SecretAccessKey").text
+        temp = S3TestClient(srv["url"], ak, sk)
+        # Inherits bob's readonly: can read objects, cannot create buckets
+        # (readonly does not grant ListAllMyBuckets, as in the reference).
+        c.make_bucket("stsread")
+        c.put_object("stsread", "k", b"readonly-data")
+        assert temp.get_object("stsread", "k").content == b"readonly-data"
+        assert temp.make_bucket("stsbkt").status_code == 403
+
+    def test_assume_role_with_session_policy(self, srv):
+        c = srv["client"]
+        c.make_bucket("stsdata")
+        c.put_object("stsdata", "k", b"v")
+        import urllib.parse
+
+        policy = json.dumps(
+            {
+                "Version": "2012-10-17",
+                "Statement": [
+                    {"Effect": "Allow", "Action": ["s3:GetObject"], "Resource": ["arn:aws:s3:::stsdata/*"]}
+                ],
+            }
+        )
+        r = c.request(
+            "POST",
+            "/",
+            body=f"Action=AssumeRole&Version=2011-06-15&Policy={urllib.parse.quote(policy)}".encode(),
+        )
+        assert r.status_code == 200, r.text
+        ns = "{https://sts.amazonaws.com/doc/2011-06-15/}"
+        root = ET.fromstring(r.content)
+        temp = S3TestClient(
+            srv["url"],
+            root.find(f".//{ns}AccessKeyId").text,
+            root.find(f".//{ns}SecretAccessKey").text,
+        )
+        assert temp.get_object("stsdata", "k").content == b"v"
+        # Session policy narrows root: no bucket creation.
+        assert temp.make_bucket("other-bkt").status_code == 403
