@@ -84,10 +84,14 @@ class S3Server:
         iam: IAMSys,
         region: str = "us-east-1",
         check_skew: bool = True,
+        kms=None,
+        config=None,
     ):
         self.layer = layer
         self.iam = iam
         self.region = region
+        self.kms = kms
+        self.config = config
         self.bucket_meta = BucketMetadataSys(layer)
         self.verifier = SigV4Verifier(iam.lookup, region, check_skew)
         self.app = web.Application(client_max_size=MAX_OBJECT_SIZE)
@@ -684,6 +688,112 @@ class S3Server:
             content_type=request.headers.get("Content-Type", "application/octet-stream"),
         )
 
+    # -- SSE / compression transforms (encryption-v1.go + compression role) --
+
+    def _parse_ssec_key(self, request: web.Request, prefix: str = "") -> bytes | None:
+        algo = request.headers.get(f"x-amz-{prefix}server-side-encryption-customer-algorithm", "")
+        if not algo:
+            return None
+        if algo != "AES256":
+            raise S3Error("NotImplemented", "only AES256 SSE-C")
+        key = base64.b64decode(
+            request.headers.get(f"x-amz-{prefix}server-side-encryption-customer-key", "")
+        )
+        md5_b64 = request.headers.get(
+            f"x-amz-{prefix}server-side-encryption-customer-key-md5", ""
+        )
+        if md5_b64 and base64.b64encode(hashlib.md5(key).digest()).decode() != md5_b64:
+            raise S3Error("InvalidDigest", "SSE-C key MD5 mismatch")
+        if len(key) != 32:
+            raise S3Error("InvalidArgument", "SSE-C key must be 256 bits")
+        return key
+
+    def _bucket_default_sse(self, bucket: str) -> bool:
+        meta = self.bucket_meta.get(bucket)
+        return bool(meta.encryption_xml) and "AES256" in meta.encryption_xml
+
+    def _transform_put(
+        self, bucket: str, key: str, body: bytes, request: web.Request, opts: PutObjectOptions
+    ) -> bytes:
+        """Apply compression then encryption; records internal metadata."""
+        from ..control import compress as compress_mod
+        from ..control import crypto as crypto_mod
+
+        ssec_key = self._parse_ssec_key(request)
+        wants_sse_s3 = (
+            request.headers.get("x-amz-server-side-encryption", "") in ("AES256", "aws:kms")
+            or self._bucket_default_sse(bucket)
+        )
+        compression_on = False
+        if self.config is not None:
+            try:
+                from ..control.config import SUBSYS_COMPRESSION
+
+                compression_on = self.config.get_bool(SUBSYS_COMPRESSION, "enable")
+            except Exception:
+                compression_on = False
+        if compression_on and compress_mod.is_compressible(key, opts.content_type):
+            body, cmeta = compress_mod.compress(body)
+            opts.user_defined.update(cmeta)
+        if ssec_key is not None:
+            res = crypto_mod.sse_c_encrypt(body, ssec_key, bucket, key)
+            opts.user_defined.update(res.metadata)
+            opts.user_defined.setdefault(crypto_mod.META_ACTUAL_SIZE, res.metadata[crypto_mod.META_ACTUAL_SIZE])
+            return res.data
+        if wants_sse_s3:
+            if self.kms is None:
+                raise S3Error("NotImplemented", "no KMS configured")
+            res = crypto_mod.sse_s3_encrypt(body, self.kms, bucket, key)
+            opts.user_defined.update(res.metadata)
+            return res.data
+        return body
+
+    def _transform_get(
+        self, bucket: str, key: str, data: bytes, oi: ObjectInfo, request: web.Request
+    ) -> bytes:
+        from ..control import compress as compress_mod
+        from ..control import crypto as crypto_mod
+
+        algo = crypto_mod.is_encrypted(oi.internal)
+        if algo == crypto_mod.ALGO_SSE_C:
+            client_key = self._parse_ssec_key(request)
+            if client_key is None:
+                raise S3Error("InvalidRequest", "object is SSE-C encrypted; key required")
+            data = crypto_mod.sse_c_decrypt(data, oi.internal, client_key, bucket, key)
+        elif algo == crypto_mod.ALGO_SSE_S3:
+            if self.kms is None:
+                raise S3Error("InternalError", "no KMS to decrypt")
+            data = crypto_mod.sse_s3_decrypt(data, oi.internal, self.kms, bucket, key)
+        if compress_mod.is_compressed(oi.internal):
+            data = compress_mod.decompress(data, oi.internal)
+        return data
+
+    @staticmethod
+    def _is_transformed(oi: ObjectInfo) -> bool:
+        from ..control import compress as compress_mod
+        from ..control import crypto as crypto_mod
+
+        return bool(crypto_mod.is_encrypted(oi.internal)) or compress_mod.is_compressed(oi.internal)
+
+    @staticmethod
+    def _logical_size(oi: ObjectInfo) -> int:
+        from ..control.crypto import META_ACTUAL_SIZE
+
+        raw = oi.internal.get(META_ACTUAL_SIZE, "")
+        return int(raw) if raw else oi.size
+
+    def _sse_response_headers(self, oi: ObjectInfo) -> dict[str, str]:
+        from ..control import crypto as crypto_mod
+
+        algo = crypto_mod.is_encrypted(oi.internal)
+        if algo == crypto_mod.ALGO_SSE_S3:
+            return {"x-amz-server-side-encryption": "AES256"}
+        if algo == crypto_mod.ALGO_SSE_C:
+            return {
+                "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            }
+        return {}
+
     def _put_object(self, bucket: str, key: str, body: bytes, request: web.Request) -> web.Response:
         if len(body) > MAX_OBJECT_SIZE:
             raise S3Error("EntityTooLarge")
@@ -692,8 +802,11 @@ class S3Server:
             if hashlib.md5(body).digest() != want:
                 raise S3Error("BadDigest")
         opts = self._put_opts(bucket, request)
+        opts.etag = hashlib.md5(body).hexdigest()
+        body = self._transform_put(bucket, key, body, request, opts)
         oi = self.layer.put_object(bucket, key, body, opts)
         headers = {"ETag": f'"{oi.etag}"'}
+        headers.update(self._sse_response_headers(oi))
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
         self._emit("s3:ObjectCreated:Put", bucket, oi)
@@ -749,19 +862,36 @@ class S3Server:
             if head:
                 oi = self.layer.get_object_info(bucket, key, opts)
                 headers = self._object_headers(oi)
-                headers["Content-Length"] = str(oi.size)
+                headers.update(self._sse_response_headers(oi))
+                headers["Content-Length"] = str(self._logical_size(oi))
                 return web.Response(status=200, headers=headers)
             offset, length = 0, -1
             if rng:
                 offset, length, total_needed = _parse_range(rng)
-            oi, data = self.layer.get_object(bucket, key, opts, offset=offset, length=length)
+            probe = self.layer.get_object_info(bucket, key, opts)
+            if self._is_transformed(probe):
+                # Transformed payloads: fetch whole, undo transforms, then
+                # apply the range on logical bytes.
+                oi, data = self.layer.get_object(bucket, key, opts)
+                data = self._transform_get(bucket, key, data, oi, request)
+                logical = len(data)
+                if rng:
+                    if offset >= logical > 0:
+                        raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
+                    end = logical if length < 0 else min(offset + length, logical)
+                    data = data[offset:end]
+                oi.size = logical
+            else:
+                oi, data = self.layer.get_object(bucket, key, opts, offset=offset, length=length)
             if rng and offset >= oi.size and oi.size > 0:
                 raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
             headers = self._object_headers(oi)
+            headers.update(self._sse_response_headers(oi))
             status = 200
             if rng:
+                total = self._logical_size(oi) if self._is_transformed(oi) else oi.size
                 end = offset + len(data) - 1
-                headers["Content-Range"] = f"bytes {offset}-{end}/{oi.size}"
+                headers["Content-Range"] = f"bytes {offset}-{end}/{total}"
                 status = 206
             # Conditional requests.
             inm = request.headers.get("If-None-Match", "")

@@ -1,0 +1,52 @@
+"""Transparent object compression.
+
+Role of the reference's compression path (cmd/object-api-utils.go:442
+isCompressible, :907 s2 writer, :686 readahead+s2 reader): objects whose
+extension/MIME matches the configured filters are compressed before erasure
+coding, with the pre-compression size kept in internal metadata so S3
+semantics (Content-Length, ranges) are preserved. Codec here is zlib (the
+host C library); the reference's S2 serves the same role -- a fast host-side
+byte codec, deliberately NOT a device workload (SURVEY.md section 2.9: "TPU
+not a fit").
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import zlib
+
+META_COMPRESSION = "x-internal-compression"
+META_ACTUAL_SIZE = "x-internal-actual-size"
+ALGO = "zlib"
+
+# Incompressible content is skipped by extension/MIME, as in the reference.
+DEFAULT_EXTENSIONS = [".txt", ".log", ".csv", ".json", ".tar", ".xml", ".bin"]
+DEFAULT_MIME = ["text/*", "application/json", "application/xml"]
+
+
+def is_compressible(
+    object_name: str,
+    content_type: str,
+    extensions: list[str] | None = None,
+    mime_types: list[str] | None = None,
+) -> bool:
+    exts = extensions if extensions is not None else DEFAULT_EXTENSIONS
+    mimes = mime_types if mime_types is not None else DEFAULT_MIME
+    if any(object_name.endswith(e) for e in exts):
+        return True
+    return any(fnmatch.fnmatchcase(content_type, m) for m in mimes)
+
+
+def compress(data: bytes) -> tuple[bytes, dict[str, str]]:
+    out = zlib.compress(data, level=1)  # speed-oriented, like S2
+    return out, {META_COMPRESSION: ALGO, META_ACTUAL_SIZE: str(len(data))}
+
+
+def decompress(blob: bytes, meta: dict[str, str]) -> bytes:
+    if meta.get(META_COMPRESSION) != ALGO:
+        return blob
+    return zlib.decompress(blob)
+
+
+def is_compressed(meta: dict[str, str]) -> bool:
+    return META_COMPRESSION in meta

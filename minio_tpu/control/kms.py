@@ -1,0 +1,77 @@
+"""KMS: key management for server-side encryption.
+
+Role of the reference's internal/kms (kms.go KMS interface :29, single static
+key, KES client kes.go:54): generate data keys wrapped by a named master key,
+and unwrap them on reads. The static single-key backend is the default (as in
+the reference's MINIO_KMS_SECRET_KEY); an external KES-style service slots in
+behind the same interface.
+"""
+
+from __future__ import annotations
+
+import base64
+import os
+import secrets
+from dataclasses import dataclass
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from ..utils import errors
+
+
+@dataclass
+class DataKey:
+    key_id: str
+    plaintext: bytes  # 32 bytes
+    ciphertext: bytes  # sealed by the master key
+
+
+class KMS:
+    def generate_key(self, key_id: str = "", context: str = "") -> DataKey:  # pragma: no cover
+        raise NotImplementedError
+
+    def decrypt_key(self, key_id: str, ciphertext: bytes, context: str = "") -> bytes:  # pragma: no cover
+        raise NotImplementedError
+
+    def stat(self) -> dict:  # pragma: no cover
+        raise NotImplementedError
+
+
+class StaticKeyKMS(KMS):
+    """Single master key (MINIO_TPU_KMS_SECRET_KEY=<name>:<base64-32-bytes>)."""
+
+    def __init__(self, name: str = "default-key", master: bytes | None = None):
+        self.name = name
+        self.master = master or secrets.token_bytes(32)
+
+    @classmethod
+    def from_env(cls) -> "StaticKeyKMS | None":
+        raw = os.environ.get("MINIO_TPU_KMS_SECRET_KEY", "")
+        if not raw or ":" not in raw:
+            return None
+        name, b64 = raw.split(":", 1)
+        key = base64.b64decode(b64)
+        if len(key) != 32:
+            raise errors.InvalidArgument(msg="KMS master key must be 32 bytes")
+        return cls(name, key)
+
+    def generate_key(self, key_id: str = "", context: str = "") -> DataKey:
+        key_id = key_id or self.name
+        if key_id != self.name:
+            raise errors.InvalidArgument(msg=f"unknown KMS key {key_id}")
+        plaintext = secrets.token_bytes(32)
+        nonce = secrets.token_bytes(12)
+        sealed = nonce + AESGCM(self.master).encrypt(nonce, plaintext, context.encode())
+        return DataKey(key_id=key_id, plaintext=plaintext, ciphertext=sealed)
+
+    def decrypt_key(self, key_id: str, ciphertext: bytes, context: str = "") -> bytes:
+        if key_id != self.name:
+            raise errors.InvalidArgument(msg=f"unknown KMS key {key_id}")
+        nonce, ct = ciphertext[:12], ciphertext[12:]
+        try:
+            return AESGCM(self.master).decrypt(nonce, ct, context.encode())
+        except Exception:
+            raise errors.FileCorrupt("KMS unseal failed")
+
+    def stat(self) -> dict:
+        return {"name": "static-key", "default_key": self.name, "online": True}

@@ -178,7 +178,9 @@ class Node:
         for s in sets.sets:
             s.ns_lock = self.ns_lock
         self.iam = IAMSys(self.creds.access_key, self.creds.secret_key)
-        self.s3 = S3Server(self.pools, self.iam, region=self.region, check_skew=False)
+        from ..control.kms import StaticKeyKMS
+
+        self.kms = StaticKeyKMS.from_env() or StaticKeyKMS()
         self.notification = NotificationSys(
             [PeerClient(u, self.token) for u in self.peer_urls]
         )
@@ -198,6 +200,14 @@ class Node:
             self.config.load()
         except errors.StorageError:
             pass
+        self.s3 = S3Server(
+            self.pools,
+            self.iam,
+            region=self.region,
+            check_skew=False,
+            kms=self.kms,
+            config=self.config,
+        )
         self.metrics = MetricsSys()
         self.metrics.layer = self.pools
         self.trace = GLOBAL_TRACE

@@ -227,7 +227,7 @@ class ErasureObjects:
         distribution = hash_order(f"{bucket}/{object_name}", n)
         version_id = opts.version_id or (str(uuid.uuid4()) if opts.versioned else "")
         mod_time = now()
-        etag = hashlib.md5(data).hexdigest()
+        etag = opts.etag or hashlib.md5(data).hexdigest()
         inline = size < SMALL_FILE_THRESHOLD
         data_dir = "" if inline else str(uuid.uuid4())
 

@@ -27,6 +27,7 @@ class ObjectInfo:
     num_versions: int = 0
     actual_size: int | None = None
     storage_class: str = "STANDARD"
+    internal: dict[str, str] = field(default_factory=dict)
 
     @classmethod
     def from_file_info(cls, fi: FileInfo, bucket: str, name: str) -> "ObjectInfo":
@@ -34,6 +35,7 @@ class ObjectInfo:
         etag = meta.pop("etag", "")
         content_type = meta.pop("content-type", "application/octet-stream")
         user = {k: v for k, v in meta.items() if not k.startswith("x-internal-")}
+        internal = {k: v for k, v in meta.items() if k.startswith("x-internal-")}
         return cls(
             bucket=bucket,
             name=name,
@@ -47,6 +49,7 @@ class ObjectInfo:
             user_defined=user,
             parts=list(fi.parts),
             num_versions=fi.num_versions,
+            internal=internal,
         )
 
 
@@ -80,6 +83,7 @@ class PutObjectOptions:
     versioned: bool = False
     version_id: str = ""
     content_type: str = "application/octet-stream"
+    etag: str = ""  # override (transformed payloads keep the plaintext etag)
 
 
 @dataclass

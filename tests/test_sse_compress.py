@@ -1,0 +1,190 @@
+"""SSE (SSE-S3 + SSE-C) and transparent compression tests."""
+
+import base64
+import hashlib
+
+import pytest
+
+from minio_tpu.control import compress as compress_mod
+from minio_tpu.control import crypto as crypto_mod
+from minio_tpu.control.kms import StaticKeyKMS
+from minio_tpu.utils import errors
+
+
+class TestCrypto:
+    def test_package_roundtrip(self):
+        key = b"k" * 32
+        for n in [0, 1, 100, 64 * 1024, 64 * 1024 + 1, 200_000]:
+            data = bytes(i % 251 for i in range(n))
+            blob = crypto_mod.encrypt_stream(data, key)
+            assert crypto_mod.decrypt_stream(blob, key) == data
+
+    def test_tamper_detected(self):
+        key = b"k" * 32
+        blob = bytearray(crypto_mod.encrypt_stream(b"secret data", key))
+        blob[20] ^= 1
+        with pytest.raises(errors.FileCorrupt):
+            crypto_mod.decrypt_stream(bytes(blob), key)
+
+    def test_sse_s3_seal_unseal(self):
+        kms = StaticKeyKMS()
+        res = crypto_mod.sse_s3_encrypt(b"payload", kms, "b", "o")
+        assert res.data != b"payload"
+        out = crypto_mod.sse_s3_decrypt(res.data, res.metadata, kms, "b", "o")
+        assert out == b"payload"
+        # Wrong KMS master fails.
+        with pytest.raises(errors.StorageError):
+            crypto_mod.sse_s3_decrypt(res.data, res.metadata, StaticKeyKMS(), "b", "o")
+
+    def test_sse_c_wrong_key_rejected(self):
+        k1, k2 = b"1" * 32, b"2" * 32
+        res = crypto_mod.sse_c_encrypt(b"data", k1, "b", "o")
+        assert crypto_mod.sse_c_decrypt(res.data, res.metadata, k1, "b", "o") == b"data"
+        with pytest.raises(errors.PreconditionFailed):
+            crypto_mod.sse_c_decrypt(res.data, res.metadata, k2, "b", "o")
+
+    def test_kms_env(self, monkeypatch):
+        master = base64.b64encode(b"m" * 32).decode()
+        monkeypatch.setenv("MINIO_TPU_KMS_SECRET_KEY", f"mykey:{master}")
+        kms = StaticKeyKMS.from_env()
+        assert kms.name == "mykey"
+        dk = kms.generate_key()
+        assert kms.decrypt_key(dk.key_id, dk.ciphertext) == dk.plaintext
+
+
+class TestCompress:
+    def test_roundtrip_and_filters(self):
+        data = b"abc " * 10000
+        blob, meta = compress_mod.compress(data)
+        assert len(blob) < len(data)
+        assert compress_mod.decompress(blob, meta) == data
+        assert compress_mod.is_compressible("a.txt", "application/octet-stream")
+        assert compress_mod.is_compressible("a.dat", "text/plain")
+        assert not compress_mod.is_compressible("a.jpg", "image/jpeg")
+
+
+class TestAPIIntegration:
+    @pytest.fixture(scope="class")
+    def stack(self, tmp_path_factory):
+        from minio_tpu.api.server import S3Server, ThreadedServer
+        from minio_tpu.control.config import ConfigSys
+        from minio_tpu.control.iam import IAMSys
+        from minio_tpu.object.pools import ServerPools
+        from minio_tpu.object.sets import ErasureSets
+        from tests.harness import ErasureHarness
+        from tests.s3client import S3TestClient
+
+        tmp = tmp_path_factory.mktemp("sse")
+        hz = ErasureHarness(tmp, n_disks=8)
+        layer = ServerPools([ErasureSets(list(hz.drives), 8)])
+        iam = IAMSys("ak", "sk-secret")
+        cfg = ConfigSys()
+        srv = S3Server(layer, iam, check_skew=False, kms=StaticKeyKMS(), config=cfg)
+        ts = ThreadedServer(srv)
+        endpoint = ts.start()
+        client = S3TestClient(endpoint, "ak", "sk-secret")
+        client.make_bucket("sseb")
+        yield {"client": client, "config": cfg, "hz": hz}
+        ts.stop()
+
+    def test_sse_s3_roundtrip(self, stack):
+        c = stack["client"]
+        data = b"top-secret-bytes" * 1000
+        r = c.put_object("sseb", "enc", data, headers={"x-amz-server-side-encryption": "AES256"})
+        assert r.status_code == 200, r.text
+        assert r.headers.get("x-amz-server-side-encryption") == "AES256"
+        # Ciphertext at rest: raw shards differ from plaintext path.
+        r = c.get_object("sseb", "enc")
+        assert r.content == data
+        assert r.headers.get("x-amz-server-side-encryption") == "AES256"
+        # HEAD reports logical size.
+        assert int(c.head_object("sseb", "enc").headers["Content-Length"]) == len(data)
+
+    def test_sse_s3_at_rest_is_ciphertext(self, stack):
+        c = stack["client"]
+        hz = stack["hz"]
+        plaintext = b"findable-plaintext-marker" * 100
+        c.put_object("sseb", "enc2", plaintext, headers={"x-amz-server-side-encryption": "AES256"})
+        # No shard on any disk contains the plaintext marker.
+        import os
+
+        for i in range(8):
+            root = hz.dirs[i]
+            for dirpath, _, files in os.walk(os.path.join(root, "sseb")):
+                for f in files:
+                    with open(os.path.join(dirpath, f), "rb") as fh:
+                        assert b"findable-plaintext-marker" not in fh.read()
+
+    def test_sse_c_roundtrip(self, stack):
+        c = stack["client"]
+        key = b"s" * 32
+        headers = {
+            "x-amz-server-side-encryption-customer-algorithm": "AES256",
+            "x-amz-server-side-encryption-customer-key": base64.b64encode(key).decode(),
+            "x-amz-server-side-encryption-customer-key-md5": base64.b64encode(
+                hashlib.md5(key).digest()
+            ).decode(),
+        }
+        data = b"client-encrypted" * 500
+        assert c.put_object("sseb", "ssec", data, headers=headers).status_code == 200
+        # GET without the key fails.
+        assert c.get_object("sseb", "ssec").status_code == 400
+        # GET with the key succeeds.
+        r = c.get_object("sseb", "ssec", headers=headers)
+        assert r.content == data
+        # Wrong key rejected.
+        bad = dict(headers)
+        bad["x-amz-server-side-encryption-customer-key"] = base64.b64encode(b"x" * 32).decode()
+        bad["x-amz-server-side-encryption-customer-key-md5"] = base64.b64encode(
+            hashlib.md5(b"x" * 32).digest()
+        ).decode()
+        assert c.get_object("sseb", "ssec", headers=bad).status_code == 412
+
+    def test_range_on_encrypted(self, stack):
+        c = stack["client"]
+        data = bytes(range(256)) * 500
+        c.put_object("sseb", "encrange", data, headers={"x-amz-server-side-encryption": "AES256"})
+        r = c.get_object("sseb", "encrange", headers={"Range": "bytes=1000-1099"})
+        assert r.status_code == 206
+        assert r.content == data[1000:1100]
+
+    def test_compression_transparent(self, stack):
+        c = stack["client"]
+        stack["config"].set("compression", "enable", "on")
+        try:
+            data = b"compress me please " * 50_000  # ~1 MB, very compressible
+            r = c.put_object("sseb", "logs/app.log", data)
+            assert r.status_code == 200
+            # Stored object is smaller than logical size.
+            oi_stored = None
+            from minio_tpu.object.types import GetObjectOptions
+
+            hz = stack["hz"]
+            oi, raw = hz.layer.get_object("sseb", "logs/app.log")
+            assert len(raw) < len(data)
+            # API returns original bytes + logical length.
+            r = c.get_object("sseb", "logs/app.log")
+            assert r.content == data
+            assert int(c.head_object("sseb", "logs/app.log").headers["Content-Length"]) == len(data)
+            # Ranges on logical bytes.
+            r = c.get_object("sseb", "logs/app.log", headers={"Range": "bytes=5-24"})
+            assert r.content == data[5:25]
+        finally:
+            stack["config"].unset("compression", "enable")
+
+    def test_bucket_default_encryption(self, stack):
+        c = stack["client"]
+        NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+        xml = (
+            f'<ServerSideEncryptionConfiguration xmlns="{NS}"><Rule>'
+            "<ApplyServerSideEncryptionByDefault><SSEAlgorithm>AES256</SSEAlgorithm>"
+            "</ApplyServerSideEncryptionByDefault></Rule></ServerSideEncryptionConfiguration>"
+        )
+        assert c.request("PUT", "/sseb", query=[("encryption", "")], body=xml.encode()).status_code == 200
+        try:
+            c.put_object("sseb", "auto-enc", b"auto-encrypted-data")
+            r = c.get_object("sseb", "auto-enc")
+            assert r.content == b"auto-encrypted-data"
+            assert r.headers.get("x-amz-server-side-encryption") == "AES256"
+        finally:
+            c.request("PUT", "/sseb", query=[("encryption", "")], body=b"")
