@@ -80,7 +80,6 @@ class S3Error(Exception):
 
     def to_xml(self, request_id: str = "") -> str:
         return (
-            '<?xml version="1.0" encoding="UTF-8"?>\n'
             f"<Error><Code>{escape(self.code)}</Code>"
             f"<Message>{escape(self.message)}</Message>"
             f"<Resource>{escape(self.resource)}</Resource>"

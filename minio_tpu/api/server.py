@@ -578,6 +578,8 @@ class S3Server:
         m = request.method
         q = request.rel_url.query
         if m == "POST":
+            if "select" in q and q.get("select-type") == "2":
+                return await asyncio.to_thread(self._select_object, bucket, key, body, request)
             if "uploads" in q:
                 return await asyncio.to_thread(self._initiate_multipart, bucket, key, request)
             if "uploadId" in q:
@@ -904,6 +906,48 @@ class S3Server:
         except oerr.MethodNotAllowed:
             # GET on a delete marker by version id.
             return web.Response(status=405, headers={"x-amz-delete-marker": "true"})
+
+    def _select_object(
+        self, bucket: str, key: str, body: bytes, request: web.Request
+    ) -> web.Response:
+        """SelectObjectContent — SQL over an object, event-stream response.
+
+        Reference: object-handlers.go SelectObjectContentHandler +
+        internal/s3select (re-designed in minio_tpu/s3select/).
+        """
+        from ..s3select import S3SelectRequest, run_select
+        from ..s3select.select import SelectError
+
+        def select_err(e: SelectError) -> web.Response:
+            return _xml(
+                f"<Error><Code>{escape(e.code)}</Code>"
+                f"<Message>{escape(e.message)}</Message>"
+                f"<Resource>/{escape(bucket)}/{escape(key)}</Resource>"
+                "</Error>",
+                e.status,
+            )
+
+        try:
+            sreq = S3SelectRequest.from_xml(body)
+        except SelectError as e:
+            return select_err(e)
+
+        def get_data(_off, _ln) -> bytes:
+            info, data = self.layer.get_object(bucket, key, GetObjectOptions())
+            return self._transform_get(bucket, key, data, info, request)
+
+        # Probe existence first so NoSuchKey surfaces as a plain S3 error
+        # (the event stream has not started yet).
+        self.layer.get_object_info(bucket, key, GetObjectOptions())
+        try:
+            frames = list(run_select(sreq, get_data))
+        except SelectError as e:
+            return select_err(e)
+        return web.Response(
+            status=200,
+            body=b"".join(frames),
+            headers={"Content-Type": "application/octet-stream"},
+        )
 
     def _delete_object(self, bucket: str, key: str, q) -> web.Response:
         vid = q.get("versionId", "")

@@ -123,6 +123,8 @@ def s3_action(method: str, bucket: str, key: str, query: dict[str, str]) -> str:
         if method == "DELETE":
             return "s3:DeleteObject"
         if method == "POST":
+            if "select" in query and query.get("select-type") == "2":
+                return "s3:GetObject"
             return "s3:PutObject"
     else:
         if method == "GET" or method == "HEAD":

@@ -1,0 +1,18 @@
+"""S3 Select: streaming SQL over objects.
+
+TPU-native framework equivalent of the reference's ``internal/s3select``
+(select.go:218 ``S3Select``, sql/ parser+evaluator, csv/ and json/ readers).
+Hand-rolled recursive-descent SQL parser (the reference uses participle),
+streaming record pipeline, AWS event-stream response framing.
+"""
+
+from .select import S3SelectRequest, SelectError, run_select
+from .eventstream import encode_message, decode_messages
+
+__all__ = [
+    "S3SelectRequest",
+    "SelectError",
+    "run_select",
+    "encode_message",
+    "decode_messages",
+]
