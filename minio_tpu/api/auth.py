@@ -96,20 +96,24 @@ def sign_request(
     region: str = "us-east-1",
     timestamp: datetime.datetime | None = None,
     unsigned_payload: bool = False,
+    payload_hash: str | None = None,
 ) -> dict[str, str]:
     """Produce the headers for a signed request (test client / internal RPC).
 
-    Returns the full header dict including Authorization.
+    Returns the full header dict including Authorization. ``payload_hash``
+    overrides the computed hash (e.g. STREAMING-AWS4-HMAC-SHA256-PAYLOAD for
+    aws-chunked uploads).
     """
     t = timestamp or datetime.datetime.now(datetime.timezone.utc)
     amz_date = t.strftime("%Y%m%dT%H%M%SZ")
     date = amz_date[:8]
     headers = {k.lower(): v for k, v in headers.items()}
     headers["x-amz-date"] = amz_date
-    if unsigned_payload or payload is None:
-        payload_hash = UNSIGNED_PAYLOAD
-    else:
-        payload_hash = hashlib.sha256(payload).hexdigest()
+    if payload_hash is None:
+        if unsigned_payload or payload is None:
+            payload_hash = UNSIGNED_PAYLOAD
+        else:
+            payload_hash = hashlib.sha256(payload).hexdigest()
     headers["x-amz-content-sha256"] = payload_hash
     signed = sorted(set(headers) | {"host"})
     scope = f"{date}/{region}/s3/aws4_request"

@@ -30,6 +30,8 @@ ERRORS = {
     ),
     "BucketNotEmpty": APIError("BucketNotEmpty", "The bucket you tried to delete is not empty.", 409),
     "EntityTooLarge": APIError("EntityTooLarge", "Your proposed upload exceeds the maximum allowed object size.", 400),
+    "EntityTooSmall": APIError("EntityTooSmall", "Your proposed upload is smaller than the minimum allowed object size.", 400),
+    "MalformedPOSTRequest": APIError("MalformedPOSTRequest", "The body of your POST request is not well-formed multipart/form-data.", 400),
     "IncompleteBody": APIError("IncompleteBody", "You did not provide the number of bytes specified by the Content-Length HTTP header.", 400),
     "InternalError": APIError("InternalError", "We encountered an internal error, please try again.", 500),
     "InvalidAccessKeyId": APIError("InvalidAccessKeyId", "The Access Key Id you provided does not exist in our records.", 403),

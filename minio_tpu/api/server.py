@@ -157,16 +157,46 @@ class S3Server:
         key = parts[1] if len(parts) > 1 else ""
         return bucket, key
 
-    def _authenticate(self, request: web.Request, body: bytes) -> str:
-        """Returns the authenticated access key; "" for anonymous."""
+    def _authenticate(self, request: web.Request, body: bytes) -> tuple[str, bytes]:
+        """Returns (authenticated access key, effective payload bytes).
+
+        Auth types (getRequestAuthType, cmd/auth-handler.go equivalent):
+        V4 signed / presigned, V4 streaming-signed (aws-chunked), V2 signed /
+        presigned, anonymous. Streaming requests return the decoded payload.
+        """
+        from . import sigv2 as sigv2_mod
+        from . import streaming as streaming_mod
+
         headers = dict(request.headers)
         query = [(k, v) for k, v in request.rel_url.query.items()]
         path = urllib.parse.unquote(request.path)
         if "X-Amz-Signature" in request.rel_url.query:
-            return self.verifier.verify_presigned(request.method, path, query, headers)
+            return self.verifier.verify_presigned(request.method, path, query, headers), body
+        if sigv2_mod.is_v2_presigned(request.rel_url.query):
+            v2 = sigv2_mod.SigV2Verifier(self.iam.lookup)
+            return v2.verify_presigned(request.method, path, query), body
+        if sigv2_mod.is_v2_signed(headers):
+            v2 = sigv2_mod.SigV2Verifier(self.iam.lookup)
+            return v2.verify_signed(request.method, path, query, headers), body
         if "Authorization" in request.headers:
-            return self.verifier.verify_signed(request.method, path, query, headers, body)
-        return ""  # anonymous
+            access_key = self.verifier.verify_signed(
+                request.method, path, query, headers, body
+            )
+            if streaming_mod.is_streaming_request(headers):
+                from .auth import parse_authorization
+
+                h = {k.lower(): v for k, v in headers.items()}
+                auth = parse_authorization(h.get("authorization", ""))
+                creds = self.iam.lookup(auth.access_key)
+                body = streaming_mod.decode_chunked(
+                    body,
+                    seed_signature=auth.signature,
+                    secret_key=creds.secret_key,
+                    amz_date=h.get("x-amz-date", ""),
+                    region=auth.region,
+                )
+            return access_key, body
+        return "", body  # anonymous
 
     def _authorize(self, access_key: str, action: str, bucket: str, key: str) -> None:
         resource = policy_mod.resource_arn(bucket, key)
@@ -190,7 +220,17 @@ class S3Server:
             return web.Response(text=self.metrics.render(), content_type="text/plain")
         bucket, key = self._split_path(request)
         body = await request.read()
-        access_key = await asyncio.to_thread(self._authenticate, request, body)
+        # POST policy form uploads authenticate via the policy signature in
+        # the form, not request headers (PostPolicyBucketHandler equivalent).
+        ctype = request.headers.get("Content-Type", "")
+        if (
+            bucket
+            and not key
+            and request.method == "POST"
+            and ctype.startswith("multipart/form-data")
+        ):
+            return await asyncio.to_thread(self._post_policy_upload, bucket, body, ctype)
+        access_key, body = await asyncio.to_thread(self._authenticate, request, body)
         q = request.rel_url.query
 
         # STS rides the root path and needs authentication only -- any
@@ -331,6 +371,63 @@ class S3Server:
                 return await asyncio.to_thread(self._bulk_delete, bucket, body)
             raise S3Error("MethodNotAllowed")
         raise S3Error("MethodNotAllowed")
+
+    def _post_policy_upload(self, bucket: str, body: bytes, ctype: str) -> web.Response:
+        """Browser POST upload with a signed policy document
+        (PostPolicyBucketHandler, cmd/bucket-handlers.go equivalent)."""
+        from . import postpolicy as pp
+
+        form = pp.parse_multipart_form(body, ctype)
+        if "file" not in form:
+            raise S3Error("MalformedPOSTRequest", "missing file field")
+        data = form["file"]
+        access_key = pp.verify_post_signature(form, self.iam.lookup)
+        policy = pp.PostPolicy.parse(base64.b64decode(form.get("policy", b"")))
+        policy.check(form, len(data), bucket=bucket)
+        key = form.get("key", b"").decode()
+        if not key:
+            raise S3Error("MalformedPOSTRequest", "missing key field")
+        filename = form.get("__filename__", b"upload").decode() or "upload"
+        key = key.replace("${filename}", filename)
+        self._authorize(access_key, "s3:PutObject", bucket, key)
+        meta = self.bucket_meta.get(bucket)
+        user_defined = {
+            k.lower(): v.decode("utf-8", "replace")
+            for k, v in form.items()
+            if k.lower().startswith("x-amz-meta-")
+        }
+        opts = PutObjectOptions(
+            user_defined=user_defined,
+            versioned=meta.versioning_enabled(),
+            content_type=form.get("Content-Type", b"application/octet-stream").decode(),
+            etag=hashlib.md5(data).hexdigest(),
+        )
+
+        # Route through the same SSE/compression transforms as PUT, exposing
+        # form fields as pseudo request headers (x-amz-server-side-encryption
+        # et al.) so bucket-default SSE applies to browser uploads too.
+        class _FormRequest:
+            headers = {
+                k.lower(): v.decode("utf-8", "replace")
+                for k, v in form.items()
+                if k not in ("file", "policy", "__filename__")
+            }
+
+        data = self._transform_put(bucket, key, data, _FormRequest(), opts)
+        oi = self.layer.put_object(bucket, key, data, opts)
+        self._emit("s3:ObjectCreated:Post", bucket, oi)
+        status = form.get("success_action_status", b"204").decode()
+        headers = {"ETag": f'"{oi.etag}"'}
+        if oi.version_id:
+            headers["x-amz-version-id"] = oi.version_id
+        if status == "201":
+            return _xml(
+                f'<PostResponse><Location>/{escape(bucket)}/{escape(key)}</Location>'
+                f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+                f"<ETag>&quot;{oi.etag}&quot;</ETag></PostResponse>",
+                201,
+            )
+        return web.Response(status=int(status) if status in ("200", "204") else 204, headers=headers)
 
     def _make_bucket(self, bucket: str) -> web.Response:
         self.layer.make_bucket(bucket)
