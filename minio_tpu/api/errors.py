@@ -37,6 +37,7 @@ ERRORS = {
     "InvalidAccessKeyId": APIError("InvalidAccessKeyId", "The Access Key Id you provided does not exist in our records.", 403),
     "InvalidArgument": APIError("InvalidArgument", "Invalid Argument.", 400),
     "InvalidBucketName": APIError("InvalidBucketName", "The specified bucket is not valid.", 400),
+    "InvalidBucketState": APIError("InvalidBucketState", "The request is not valid with the current state of the bucket.", 409),
     "InvalidDigest": APIError("InvalidDigest", "The Content-Md5 you specified is not valid.", 400),
     "InvalidPart": APIError("InvalidPart", "One or more of the specified parts could not be found.", 400),
     "InvalidPartOrder": APIError("InvalidPartOrder", "The list of parts was not in ascending order.", 400),
@@ -57,6 +58,7 @@ ERRORS = {
     "ServerSideEncryptionConfigurationNotFoundError": APIError("ServerSideEncryptionConfigurationNotFoundError", "The server side encryption configuration was not found.", 404),
     "NoSuchCORSConfiguration": APIError("NoSuchCORSConfiguration", "The CORS configuration does not exist.", 404),
     "ObjectLockConfigurationNotFoundError": APIError("ObjectLockConfigurationNotFoundError", "Object Lock configuration does not exist for this bucket.", 404),
+    "NoSuchObjectLockConfiguration": APIError("NoSuchObjectLockConfiguration", "The specified object does not have an ObjectLock configuration.", 404),
     "NotImplemented": APIError("NotImplemented", "A header you provided implies functionality that is not implemented.", 501),
     "PreconditionFailed": APIError("PreconditionFailed", "At least one of the pre-conditions you specified did not hold.", 412),
     "RequestTimeTooSkewed": APIError("RequestTimeTooSkewed", "The difference between the request time and the server's time is too large.", 403),

@@ -26,6 +26,7 @@ from xml.sax.saxutils import escape
 from aiohttp import web
 
 from ..control.bucket_meta import BucketMetadataSys
+from ..control import objectlock as ol
 from ..control.iam import IAMSys
 from ..control import policy as policy_mod
 from ..object.pools import ServerPools
@@ -231,6 +232,7 @@ class S3Server:
         ):
             return await asyncio.to_thread(self._post_policy_upload, bucket, body, ctype)
         access_key, body = await asyncio.to_thread(self._authenticate, request, body)
+        request["access_key"] = access_key
         q = request.rel_url.query
 
         # STS rides the root path and needs authentication only -- any
@@ -305,12 +307,10 @@ class S3Server:
                     self._put_bucket_config, bucket, "notification_xml", body
                 )
             if "object-lock" in q:
-                return await asyncio.to_thread(
-                    self._put_bucket_config, bucket, "object_lock_xml", body
-                )
+                return await asyncio.to_thread(self._put_object_lock_config, bucket, body)
             if "cors" in q:
                 return await asyncio.to_thread(self._put_bucket_config, bucket, "cors_xml", body)
-            return await asyncio.to_thread(self._make_bucket, bucket)
+            return await asyncio.to_thread(self._make_bucket, bucket, request)
         if m == "GET":
             if "location" in q:
                 await asyncio.to_thread(self.layer.get_bucket_info, bucket)
@@ -368,7 +368,7 @@ class S3Server:
             return await asyncio.to_thread(self._delete_bucket, bucket)
         if m == "POST":
             if "delete" in q:
-                return await asyncio.to_thread(self._bulk_delete, bucket, body)
+                return await asyncio.to_thread(self._bulk_delete, bucket, body, request)
             raise S3Error("MethodNotAllowed")
         raise S3Error("MethodNotAllowed")
 
@@ -429,9 +429,21 @@ class S3Server:
             )
         return web.Response(status=int(status) if status in ("200", "204") else 204, headers=headers)
 
-    def _make_bucket(self, bucket: str) -> web.Response:
+    def _make_bucket(self, bucket: str, request: web.Request | None = None) -> web.Response:
         self.layer.make_bucket(bucket)
-        self.bucket_meta.save(self.bucket_meta.get(bucket))
+        meta = self.bucket_meta.get(bucket)
+        if (
+            request is not None
+            and request.headers.get("x-amz-bucket-object-lock-enabled", "").lower() == "true"
+        ):
+            # Lock-enabled buckets are always versioned (AWS invariant).
+            meta.versioning = "Enabled"
+            meta.object_lock_xml = (
+                "<ObjectLockConfiguration>"
+                "<ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+                "</ObjectLockConfiguration>"
+            )
+        self.bucket_meta.save(meta)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
     def _delete_bucket(self, bucket: str) -> web.Response:
@@ -448,6 +460,11 @@ class S3Server:
             raise S3Error("MalformedXML")
         if status not in ("Enabled", "Suspended"):
             raise S3Error("MalformedXML")
+        if status == "Suspended" and self.bucket_meta.get(bucket).object_lock_xml:
+            raise S3Error(
+                "InvalidBucketState",
+                "versioning cannot be suspended on an object-lock enabled bucket",
+            )
         self.bucket_meta.update(bucket, versioning=status)
         return web.Response(status=200)
 
@@ -640,7 +657,7 @@ class S3Server:
             f"{''.join(entries)}{prefixes}</ListVersionsResult>"
         )
 
-    def _bulk_delete(self, bucket: str, body: bytes) -> web.Response:
+    def _bulk_delete(self, bucket: str, body: bytes, request: web.Request | None = None) -> web.Response:
         try:
             root = ET.fromstring(body)
         except ET.ParseError:
@@ -652,10 +669,57 @@ class S3Server:
                 kv = {c.tag.split("}")[-1]: (c.text or "") for c in obj}
                 if "Key" in kv:
                     objects.append((kv["Key"], kv.get("VersionId", "")))
-        versioned = self.bucket_meta.get(bucket).versioning_enabled()
-        results = self.layer.delete_objects(bucket, objects, versioned=versioned)
+        meta = self.bucket_meta.get(bucket)
+        versioned = meta.versioning_enabled()
+
+        # WORM: each versioned delete must pass the same object-lock check
+        # as the single-object path (DeleteMultipleObjects shares
+        # enforceRetentionForDeletion in the reference).
+        locked_errors: dict[tuple[str, str], S3Error] = {}
+        if meta.object_lock_xml:
+            bypass = bool(
+                request is not None
+                and request.headers.get("x-amz-bypass-governance-retention", "").lower() == "true"
+            )
+            may_bypass = False
+            if request is not None and bypass:
+                ak = request.get("access_key", "")
+                may_bypass = bool(ak) and self.iam.is_allowed(
+                    ak, "s3:BypassGovernanceRetention", policy_mod.resource_arn(bucket, "*")
+                )
+            survivors = []
+            for name, vid in objects:
+                if vid:
+                    try:
+                        oi = self.layer.get_object_info(bucket, name, GetObjectOptions(vid))
+                        ol.check_delete_allowed(oi.user_defined, bypass, may_bypass)
+                    except S3Error as e:
+                        locked_errors[(name, vid)] = e
+                        continue
+                    except oerr.StorageError:
+                        pass  # missing objects fall through to the layer
+                survivors.append((name, vid))
+            objects_to_delete = survivors
+        else:
+            objects_to_delete = objects
+        results_by_obj = dict(
+            zip(
+                objects_to_delete,
+                self.layer.delete_objects(bucket, objects_to_delete, versioned=versioned),
+            )
+        )
+        results = [
+            results_by_obj.get((name, vid), (None, locked_errors.get((name, vid))))
+            for name, vid in objects
+        ]
         parts = []
         for (name, vid), (oi, err) in zip(objects, results):
+            if isinstance(err, S3Error):
+                parts.append(
+                    f"<Error><Key>{escape(name)}</Key><Code>{err.code}</Code>"
+                    f"<Message>{escape(err.message)}</Message></Error>"
+                )
+                continue
             if err is None:
                 if not quiet:
                     parts.append(f"<Deleted><Key>{escape(name)}</Key></Deleted>")
@@ -685,6 +749,16 @@ class S3Server:
                 )
             raise S3Error("MethodNotAllowed")
         if m == "PUT":
+            if "tagging" in q:
+                return await asyncio.to_thread(self._put_object_tagging, bucket, key, q, body)
+            if "retention" in q:
+                return await asyncio.to_thread(
+                    self._put_object_retention, bucket, key, q, body, request
+                )
+            if "legal-hold" in q:
+                return await asyncio.to_thread(
+                    self._put_object_legal_hold, bucket, key, q, body
+                )
             if "uploadId" in q and "partNumber" in q:
                 return await asyncio.to_thread(
                     self._upload_part, bucket, key, q["uploadId"], int(q["partNumber"]), body
@@ -696,12 +770,20 @@ class S3Server:
             return await asyncio.to_thread(self._put_object, bucket, key, body, request)
         if m == "GET" and "uploadId" in q:
             return await asyncio.to_thread(self._list_parts, bucket, key, q)
+        if m == "GET" and "tagging" in q:
+            return await asyncio.to_thread(self._get_object_tagging, bucket, key, q)
+        if m == "GET" and "retention" in q:
+            return await asyncio.to_thread(self._get_object_retention, bucket, key, q)
+        if m == "GET" and "legal-hold" in q:
+            return await asyncio.to_thread(self._get_object_legal_hold, bucket, key, q)
         if m in ("GET", "HEAD"):
             return await asyncio.to_thread(self._get_object, bucket, key, request, m == "HEAD")
         if m == "DELETE":
+            if "tagging" in q:
+                return await asyncio.to_thread(self._delete_object_tagging, bucket, key, q)
             if "uploadId" in q:
                 return await asyncio.to_thread(self._abort_multipart, bucket, key, q["uploadId"])
-            return await asyncio.to_thread(self._delete_object, bucket, key, q)
+            return await asyncio.to_thread(self._delete_object, bucket, key, q, request)
         raise S3Error("MethodNotAllowed")
 
     # -- multipart ------------------------------------------------------------
@@ -781,6 +863,40 @@ class S3Server:
         for h in ("cache-control", "content-disposition", "content-encoding", "content-language"):
             if h in request.headers:
                 user_defined[h] = request.headers[h]
+        # Object tags supplied at upload time (x-amz-tagging, query-encoded).
+        if "x-amz-tagging" in request.headers:
+            tags = urllib.parse.parse_qsl(
+                request.headers["x-amz-tagging"], keep_blank_values=True
+            )
+            if len(tags) > 10:
+                raise S3Error("InvalidArgument", "at most 10 tags per object")
+            user_defined[self.TAGS_META] = urllib.parse.urlencode(tags)
+        # Object lock headers / bucket default retention.
+        lock_cfg = ol.LockConfig.from_xml(meta.object_lock_xml)
+        mode = request.headers.get("x-amz-object-lock-mode", "").upper()
+        until = request.headers.get("x-amz-object-lock-retain-until-date", "")
+        hold = request.headers.get("x-amz-object-lock-legal-hold", "").upper()
+        if mode or until or hold:
+            if not lock_cfg.enabled:
+                raise S3Error(
+                    "InvalidRequest", "bucket is missing object lock configuration"
+                )
+        if mode or until:
+            if not mode or not until or mode not in ol.MODES:
+                raise S3Error("InvalidArgument", "both lock mode and retain-until required")
+            try:
+                if ol.parse_iso(until) <= datetime.datetime.now(datetime.timezone.utc):
+                    raise S3Error("InvalidArgument", "retain-until date must be in the future")
+            except ValueError:
+                raise S3Error("InvalidArgument", "bad retain-until date")
+            user_defined[ol.META_MODE] = mode
+            user_defined[ol.META_RETAIN_UNTIL] = until
+        elif lock_cfg.enabled:
+            user_defined.update(lock_cfg.default_retention_meta(_time.time()))
+        if hold:
+            if hold not in ("ON", "OFF"):
+                raise S3Error("InvalidArgument", "bad legal hold status")
+            user_defined[ol.META_LEGAL_HOLD] = hold
         return PutObjectOptions(
             user_defined=user_defined,
             versioned=meta.versioning_enabled(),
@@ -947,6 +1063,11 @@ class S3Server:
             headers["x-amz-version-id"] = oi.version_id
         for k, v in oi.user_defined.items():
             headers[k] = v
+        raw_tags = oi.internal.get(self.TAGS_META, "")
+        if raw_tags:
+            headers["x-amz-tagging-count"] = str(
+                len(urllib.parse.parse_qsl(raw_tags, keep_blank_values=True))
+            )
         return headers
 
     def _get_object(
@@ -1004,6 +1125,117 @@ class S3Server:
             # GET on a delete marker by version id.
             return web.Response(status=405, headers={"x-amz-delete-marker": "true"})
 
+    # -- object tagging / object lock ----------------------------------------
+
+    TAGS_META = "x-internal-tags"
+
+    def _put_object_lock_config(self, bucket: str, body: bytes) -> web.Response:
+        """PUT ?object-lock: validated, and only on versioned buckets
+        (lock implies versioning — AWS invariant)."""
+        cfg = ol.LockConfig.from_xml(body.decode("utf-8", "replace"))
+        if not cfg.enabled:
+            raise S3Error("MalformedXML", "ObjectLockEnabled must be 'Enabled'")
+        meta = self.bucket_meta.get(bucket)
+        if not meta.versioning_enabled():
+            raise S3Error(
+                "InvalidBucketState",
+                "object lock requires bucket versioning to be enabled",
+            )
+        self.bucket_meta.update(bucket, object_lock_xml=body.decode("utf-8", "replace"))
+        return web.Response(status=200)
+
+    @staticmethod
+    def _vid(q) -> str:
+        vid = q.get("versionId", "")
+        return "" if vid == "null" else vid
+
+    def _put_object_tagging(self, bucket: str, key: str, q, body: bytes) -> web.Response:
+        try:
+            root = ET.fromstring(body)
+        except ET.ParseError:
+            raise S3Error("MalformedXML")
+        tags = []
+        for el in root.iter():
+            if el.tag.split("}")[-1] == "Tag":
+                kv = {c.tag.split("}")[-1]: (c.text or "") for c in el}
+                if "Key" not in kv:
+                    raise S3Error("MalformedXML")
+                tags.append((kv["Key"], kv.get("Value", "")))
+        if len(tags) > 10:
+            raise S3Error("InvalidArgument", "at most 10 tags per object")
+        encoded = urllib.parse.urlencode(tags)
+        self.layer.put_object_metadata(
+            bucket, key, self._vid(q), updates={self.TAGS_META: encoded}
+        )
+        return web.Response(status=200)
+
+    def _get_object_tagging(self, bucket: str, key: str, q) -> web.Response:
+        oi = self.layer.get_object_info(bucket, key, GetObjectOptions(self._vid(q)))
+        raw = oi.internal.get(self.TAGS_META, "")
+        tags = urllib.parse.parse_qsl(raw, keep_blank_values=True)
+        items = "".join(
+            f"<Tag><Key>{escape(k)}</Key><Value>{escape(v)}</Value></Tag>" for k, v in tags
+        )
+        return _xml(
+            f'<Tagging xmlns="{XML_NS}"><TagSet>{items}</TagSet></Tagging>'
+        )
+
+    def _delete_object_tagging(self, bucket: str, key: str, q) -> web.Response:
+        self.layer.put_object_metadata(
+            bucket, key, self._vid(q), removes=[self.TAGS_META]
+        )
+        return web.Response(status=204)
+
+    def _require_lock_bucket(self, bucket: str):
+        meta = self.bucket_meta.get(bucket)
+        cfg = ol.LockConfig.from_xml(meta.object_lock_xml)
+        if not cfg.enabled:
+            raise S3Error(
+                "InvalidRequest", "bucket is missing object lock configuration"
+            )
+        return cfg
+
+    def _put_object_retention(
+        self, bucket: str, key: str, q, body: bytes, request: web.Request
+    ) -> web.Response:
+        self._require_lock_bucket(bucket)
+        mode, until = ol.parse_retention_xml(body)
+        oi = self.layer.get_object_info(bucket, key, GetObjectOptions(self._vid(q)))
+        old = ol.LockState.from_meta(oi.user_defined)
+        bypass = request.headers.get("x-amz-bypass-governance-retention", "").lower() == "true"
+        ak = request.get("access_key", "")
+        may_bypass = bool(ak) and self.iam.is_allowed(
+            ak, "s3:BypassGovernanceRetention", policy_mod.resource_arn(bucket, key)
+        )
+        ol.check_retention_tighten(old, mode, until, bypass, may_bypass)
+        self.layer.put_object_metadata(
+            bucket, key, self._vid(q),
+            updates={ol.META_MODE: mode, ol.META_RETAIN_UNTIL: until},
+        )
+        return web.Response(status=200)
+
+    def _get_object_retention(self, bucket: str, key: str, q) -> web.Response:
+        self._require_lock_bucket(bucket)
+        oi = self.layer.get_object_info(bucket, key, GetObjectOptions(self._vid(q)))
+        st = ol.LockState.from_meta(oi.user_defined)
+        if not st.mode:
+            raise S3Error("NoSuchObjectLockConfiguration")
+        return _xml(ol.retention_xml(st.mode, st.retain_until))
+
+    def _put_object_legal_hold(self, bucket: str, key: str, q, body: bytes) -> web.Response:
+        self._require_lock_bucket(bucket)
+        status = ol.parse_legal_hold_xml(body)
+        self.layer.put_object_metadata(
+            bucket, key, self._vid(q), updates={ol.META_LEGAL_HOLD: status}
+        )
+        return web.Response(status=200)
+
+    def _get_object_legal_hold(self, bucket: str, key: str, q) -> web.Response:
+        self._require_lock_bucket(bucket)
+        oi = self.layer.get_object_info(bucket, key, GetObjectOptions(self._vid(q)))
+        st = ol.LockState.from_meta(oi.user_defined)
+        return _xml(ol.legal_hold_xml(st.legal_hold or "OFF"))
+
     def _select_object(
         self, bucket: str, key: str, body: bytes, request: web.Request
     ) -> web.Response:
@@ -1046,11 +1278,29 @@ class S3Server:
             headers={"Content-Type": "application/octet-stream"},
         )
 
-    def _delete_object(self, bucket: str, key: str, q) -> web.Response:
-        vid = q.get("versionId", "")
-        if vid == "null":
-            vid = ""
+    def _delete_object(self, bucket: str, key: str, q, request=None) -> web.Response:
+        vid = self._vid(q)
         meta = self.bucket_meta.get(bucket)
+        if vid and meta.object_lock_xml:
+            # WORM: deleting a specific version checks retention/legal hold.
+            try:
+                oi = self.layer.get_object_info(bucket, key, GetObjectOptions(vid))
+            except (oerr.ObjectNotFound, oerr.VersionNotFound, oerr.MethodNotAllowed):
+                oi = None
+            if oi is not None:
+                bypass = bool(
+                    request is not None
+                    and request.headers.get("x-amz-bypass-governance-retention", "").lower()
+                    == "true"
+                )
+                may_bypass = False
+                if request is not None and bypass:
+                    ak = request.get("access_key", "")
+                    may_bypass = bool(ak) and self.iam.is_allowed(
+                        ak, "s3:BypassGovernanceRetention",
+                        policy_mod.resource_arn(bucket, key),
+                    )
+                ol.check_delete_allowed(oi.user_defined, bypass, may_bypass)
         opts = DeleteObjectOptions(version_id=vid, versioned=meta.versioning_enabled())
         oi = self.layer.delete_object(bucket, key, opts)
         headers = {}

@@ -117,10 +117,24 @@ def s3_action(method: str, bucket: str, key: str, query: dict[str, str]) -> str:
         return "s3:ListAllMyBuckets"
     if key:
         if method in ("GET", "HEAD"):
+            if "tagging" in query:
+                return "s3:GetObjectTagging"
+            if "retention" in query:
+                return "s3:GetObjectRetention"
+            if "legal-hold" in query:
+                return "s3:GetObjectLegalHold"
             return "s3:GetObject"
         if method == "PUT":
+            if "tagging" in query:
+                return "s3:PutObjectTagging"
+            if "retention" in query:
+                return "s3:PutObjectRetention"
+            if "legal-hold" in query:
+                return "s3:PutObjectLegalHold"
             return "s3:PutObject"
         if method == "DELETE":
+            if "tagging" in query:
+                return "s3:DeleteObjectTagging"
             return "s3:DeleteObject"
         if method == "POST":
             if "select" in query and query.get("select-type") == "2":

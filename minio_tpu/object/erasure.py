@@ -502,6 +502,49 @@ class ErasureObjects:
 
     # ---------------------------------------------------------------- delete
 
+    def put_object_metadata(
+        self,
+        bucket: str,
+        object_name: str,
+        version_id: str = "",
+        updates: dict[str, str] | None = None,
+        removes: list[str] | None = None,
+    ) -> ObjectInfo:
+        """Update user metadata of an existing version in place
+        (PutObjectMetadata / PutObjectTags, cmd/erasure-object.go equivalent:
+        read quorum FileInfo, mutate metadata, update xl.meta on all drives)."""
+        self.get_bucket_info(bucket)
+        fi, metas, disks = self._read_quorum_fi(bucket, object_name, version_id)
+        if fi.deleted:
+            raise errors.MethodNotAllowed(bucket, object_name)
+        for k in removes or []:
+            fi.metadata.pop(k, None)
+        fi.metadata.update(updates or {})
+
+        # Each drive keeps ITS OWN FileInfo (per-drive erasure index and
+        # shard checksums differ) -- only the metadata dict is replaced.
+        # Writing the quorum FileInfo verbatim to every drive would clobber
+        # shard identity and corrupt reads.
+        def upd(args):
+            i, d = args
+            if d is None:
+                raise errors.DiskNotFound()
+            own = metas[i]
+            if own is None:
+                raise errors.FileNotFound(bucket, object_name)
+            own.metadata = dict(fi.metadata)
+            d.update_metadata(bucket, object_name, own)
+
+        results = meta_mod.parallel_map(upd, list(enumerate(disks)))
+        errs = [e for _, e in results]
+        write_quorum = fi.write_quorum(self.parity)
+        err = errors.reduce_quorum_errs(
+            errs, write_quorum, errors.InsufficientWriteQuorum(bucket, object_name)
+        )
+        if err is not None:
+            raise err
+        return ObjectInfo.from_file_info(fi, bucket, object_name)
+
     def delete_object(
         self, bucket: str, object_name: str, opts: DeleteObjectOptions | None = None
     ) -> ObjectInfo:

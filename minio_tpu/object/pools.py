@@ -155,6 +155,12 @@ class ServerPools:
                 last = e
         raise last
 
+    def put_object_metadata(
+        self, bucket, object_name, version_id: str = "", updates=None, removes=None
+    ) -> ObjectInfo:
+        pool = self._pool_holding(bucket, object_name, version_id)
+        return pool.put_object_metadata(bucket, object_name, version_id, updates, removes)
+
     def delete_object(
         self, bucket: str, object_name: str, opts: DeleteObjectOptions | None = None
     ) -> ObjectInfo:

@@ -145,6 +145,11 @@ class ErasureSets:
     def get_object_info(self, bucket, object_name, opts: GetObjectOptions | None = None):
         return self.get_hashed_set(object_name).get_object_info(bucket, object_name, opts)
 
+    def put_object_metadata(self, bucket, object_name, version_id="", updates=None, removes=None):
+        return self.get_hashed_set(object_name).put_object_metadata(
+            bucket, object_name, version_id, updates, removes
+        )
+
     def delete_object(self, bucket, object_name, opts: DeleteObjectOptions | None = None):
         return self.get_hashed_set(object_name).delete_object(bucket, object_name, opts)
 

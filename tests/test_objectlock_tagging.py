@@ -1,0 +1,330 @@
+"""Object tagging, object lock (retention / legal hold), WORM enforcement.
+
+Mirrors the reference's object-lock tests (internal/bucket/object/lock) and
+the API-level tagging/retention handler behavior.
+"""
+
+import datetime
+
+import pytest
+
+from minio_tpu.control import objectlock as ol
+from minio_tpu.api.errors import S3Error
+
+
+@pytest.fixture(scope="module")
+def http_stack(tmp_path_factory):
+    from minio_tpu.api.server import S3Server, ThreadedServer
+    from minio_tpu.control.iam import IAMSys
+    from minio_tpu.object.pools import ServerPools
+    from minio_tpu.object.sets import ErasureSets
+    from tests.harness import ErasureHarness
+    from tests.s3client import S3TestClient
+
+    tmp = tmp_path_factory.mktemp("olock")
+    hz = ErasureHarness(tmp, n_disks=8)
+    layer = ServerPools([ErasureSets([d for d in hz.drives], 8)])
+    iam = IAMSys("lockak", "lock-secret")
+    srv = S3Server(layer, iam, check_skew=False)
+    ts = ThreadedServer(srv)
+    endpoint = ts.start()
+    client = S3TestClient(endpoint, "lockak", "lock-secret")
+    yield {"client": client, "iam": iam}
+    ts.stop()
+
+
+def _future(days=1):
+    return ol.format_iso(
+        datetime.datetime.now(datetime.timezone.utc) + datetime.timedelta(days=days)
+    )
+
+
+# ---------------------------------------------------------------- unit level
+
+
+class TestLockConfig:
+    def test_parse_enabled(self):
+        cfg = ol.LockConfig.from_xml(
+            "<ObjectLockConfiguration><ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+            "</ObjectLockConfiguration>"
+        )
+        assert cfg.enabled and cfg.default is None
+
+    def test_parse_default_retention(self):
+        cfg = ol.LockConfig.from_xml(
+            "<ObjectLockConfiguration><ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+            "<Rule><DefaultRetention><Mode>GOVERNANCE</Mode><Days>30</Days>"
+            "</DefaultRetention></Rule></ObjectLockConfiguration>"
+        )
+        assert cfg.default.mode == "GOVERNANCE" and cfg.default.days == 30
+        meta = cfg.default_retention_meta(0.0)
+        assert meta[ol.META_MODE] == "GOVERNANCE"
+        assert meta[ol.META_RETAIN_UNTIL].startswith("1970-01-31")
+
+    def test_days_and_years_rejected(self):
+        with pytest.raises(S3Error):
+            ol.LockConfig.from_xml(
+                "<ObjectLockConfiguration><ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+                "<Rule><DefaultRetention><Mode>GOVERNANCE</Mode><Days>1</Days>"
+                "<Years>1</Years></DefaultRetention></Rule></ObjectLockConfiguration>"
+            )
+
+    def test_delete_checks(self):
+        future = _future()
+        compliance = {ol.META_MODE: "COMPLIANCE", ol.META_RETAIN_UNTIL: future}
+        governance = {ol.META_MODE: "GOVERNANCE", ol.META_RETAIN_UNTIL: future}
+        hold = {ol.META_LEGAL_HOLD: "ON"}
+        expired = {ol.META_MODE: "COMPLIANCE", ol.META_RETAIN_UNTIL: "2000-01-01T00:00:00Z"}
+        with pytest.raises(S3Error):
+            ol.check_delete_allowed(compliance, True, True)
+        with pytest.raises(S3Error):
+            ol.check_delete_allowed(governance, False, False)
+        with pytest.raises(S3Error):
+            ol.check_delete_allowed(governance, True, False)  # header but no perm
+        ol.check_delete_allowed(governance, True, True)  # bypass ok
+        with pytest.raises(S3Error):
+            ol.check_delete_allowed(hold, True, True)
+        ol.check_delete_allowed(expired, False, False)
+
+    def test_retention_tighten(self):
+        future = _future(1)
+        later = _future(10)
+        old = ol.LockState("GOVERNANCE", future, "")
+        ol.check_retention_tighten(old, "GOVERNANCE", later, False, False)  # extend ok
+        with pytest.raises(S3Error):
+            ol.check_retention_tighten(old, "GOVERNANCE", "2000-01-01T00:00:00Z", False, False)
+        old_c = ol.LockState("COMPLIANCE", later, "")
+        with pytest.raises(S3Error):
+            ol.check_retention_tighten(old_c, "COMPLIANCE", future, True, True)
+
+
+# ----------------------------------------------------------------- HTTP e2e
+
+
+class TestTaggingE2E:
+    def test_tagging_crud(self, http_stack):
+        c = http_stack["client"]
+        c.make_bucket("tagbkt")
+        c.put_object("tagbkt", "obj", b"data")
+        body = (
+            "<Tagging><TagSet>"
+            "<Tag><Key>env</Key><Value>prod</Value></Tag>"
+            "<Tag><Key>team</Key><Value>storage</Value></Tag>"
+            "</TagSet></Tagging>"
+        ).encode()
+        r = c.request("PUT", "/tagbkt/obj", query=[("tagging", "")], body=body)
+        assert r.status_code == 200, r.text
+        r = c.request("GET", "/tagbkt/obj", query=[("tagging", "")])
+        assert r.status_code == 200
+        assert "<Key>env</Key>" in r.text and "<Value>prod</Value>" in r.text
+        # tag count header on GET object
+        r = c.get_object("tagbkt", "obj")
+        assert r.headers.get("x-amz-tagging-count") == "2"
+        r = c.request("DELETE", "/tagbkt/obj", query=[("tagging", "")])
+        assert r.status_code == 204
+        r = c.request("GET", "/tagbkt/obj", query=[("tagging", "")])
+        assert "<Tag>" not in r.text
+
+    def test_tagging_header_on_put(self, http_stack):
+        c = http_stack["client"]
+        c.make_bucket("tagbkt2")
+        c.put_object("tagbkt2", "o2", b"x", headers={"x-amz-tagging": "a=1&b=2"})
+        r = c.request("GET", "/tagbkt2/o2", query=[("tagging", "")])
+        assert "<Key>a</Key>" in r.text
+
+    def test_too_many_tags(self, http_stack):
+        c = http_stack["client"]
+        tags = "&".join(f"k{i}={i}" for i in range(11))
+        r = c.put_object("tagbkt2", "o3", b"x", headers={"x-amz-tagging": tags})
+        assert r.status_code == 400
+
+
+class TestObjectLockE2E:
+    def test_lock_bucket_creation(self, http_stack):
+        c = http_stack["client"]
+        r = c.request(
+            "PUT", "/lockbkt", headers={"x-amz-bucket-object-lock-enabled": "true"}
+        )
+        assert r.status_code == 200
+        r = c.request("GET", "/lockbkt", query=[("object-lock", "")])
+        assert "Enabled" in r.text
+        r = c.request("GET", "/lockbkt", query=[("versioning", "")])
+        assert "Enabled" in r.text
+
+    def test_retention_on_unlocked_bucket_rejected(self, http_stack):
+        c = http_stack["client"]
+        c.make_bucket("plainbkt")
+        c.put_object("plainbkt", "o", b"x")
+        body = f"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>{_future()}</RetainUntilDate></Retention>".encode()
+        r = c.request("PUT", "/plainbkt/o", query=[("retention", "")], body=body)
+        assert r.status_code == 400
+
+    def test_retention_and_delete_protection(self, http_stack):
+        c = http_stack["client"]
+        r = c.put_object("lockbkt", "held", b"precious")
+        vid = r.headers.get("x-amz-version-id", "")
+        assert vid
+        body = f"<Retention><Mode>COMPLIANCE</Mode><RetainUntilDate>{_future()}</RetainUntilDate></Retention>".encode()
+        r = c.request("PUT", "/lockbkt/held", query=[("retention", "")], body=body)
+        assert r.status_code == 200, r.text
+        r = c.request("GET", "/lockbkt/held", query=[("retention", "")])
+        assert "<Mode>COMPLIANCE</Mode>" in r.text
+        # deleting the locked version is denied (root has bypass permission,
+        # but COMPLIANCE can never be bypassed)
+        r = c.delete_object("lockbkt", "held", query=[("versionId", vid)])
+        assert r.status_code == 403
+        # delete marker (no versionId) is still allowed
+        r = c.delete_object("lockbkt", "held")
+        assert r.status_code == 204
+
+    def test_governance_bypass(self, http_stack):
+        c = http_stack["client"]
+        r = c.put_object("lockbkt", "gov", b"guarded")
+        vid = r.headers["x-amz-version-id"]
+        body = f"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>{_future()}</RetainUntilDate></Retention>".encode()
+        assert c.request("PUT", "/lockbkt/gov", query=[("retention", "")], body=body).status_code == 200
+        # without bypass header: denied
+        r = c.delete_object("lockbkt", "gov", query=[("versionId", vid)])
+        assert r.status_code == 403
+        # with bypass header (root is allowed everything): succeeds
+        r = c.request(
+            "DELETE", "/lockbkt/gov", query=[("versionId", vid)],
+            headers={"x-amz-bypass-governance-retention": "true"},
+        )
+        assert r.status_code == 204, r.text
+
+    def test_legal_hold(self, http_stack):
+        c = http_stack["client"]
+        r = c.put_object("lockbkt", "lh", b"on hold")
+        vid = r.headers["x-amz-version-id"]
+        r = c.request(
+            "PUT", "/lockbkt/lh", query=[("legal-hold", "")],
+            body=b"<LegalHold><Status>ON</Status></LegalHold>",
+        )
+        assert r.status_code == 200, r.text
+        r = c.request("GET", "/lockbkt/lh", query=[("legal-hold", "")])
+        assert "<Status>ON</Status>" in r.text
+        r = c.request(
+            "DELETE", "/lockbkt/lh", query=[("versionId", vid)],
+            headers={"x-amz-bypass-governance-retention": "true"},
+        )
+        assert r.status_code == 403  # legal hold ignores governance bypass
+        r = c.request(
+            "PUT", "/lockbkt/lh", query=[("legal-hold", "")],
+            body=b"<LegalHold><Status>OFF</Status></LegalHold>",
+        )
+        assert r.status_code == 200
+        r = c.delete_object("lockbkt", "lh", query=[("versionId", vid)])
+        assert r.status_code == 204
+
+    def test_lock_headers_on_put(self, http_stack):
+        c = http_stack["client"]
+        until = _future()
+        r = c.put_object(
+            "lockbkt", "hdr", b"x",
+            headers={
+                "x-amz-object-lock-mode": "GOVERNANCE",
+                "x-amz-object-lock-retain-until-date": until,
+            },
+        )
+        assert r.status_code == 200, r.text
+        g = c.head_object("lockbkt", "hdr")
+        assert g.headers.get("x-amz-object-lock-mode") == "GOVERNANCE"
+        r = c.request("GET", "/lockbkt/hdr", query=[("retention", "")])
+        assert "<Mode>GOVERNANCE</Mode>" in r.text
+
+    def test_default_retention_applied(self, http_stack):
+        c = http_stack["client"]
+        cfg = (
+            "<ObjectLockConfiguration><ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+            "<Rule><DefaultRetention><Mode>GOVERNANCE</Mode><Days>1</Days>"
+            "</DefaultRetention></Rule></ObjectLockConfiguration>"
+        ).encode()
+        r = c.request("PUT", "/lockbkt", query=[("object-lock", "")], body=cfg)
+        assert r.status_code == 200
+        r = c.put_object("lockbkt", "defret", b"x")
+        assert r.status_code == 200
+        g = c.head_object("lockbkt", "defret")
+        assert g.headers.get("x-amz-object-lock-mode") == "GOVERNANCE"
+        assert g.headers.get("x-amz-object-lock-retain-until-date", "")
+
+
+class TestLockHardening:
+    """Regressions: bulk-delete WORM bypass, versioning-suspend invariant,
+    PUT-header date validation, governance-to-compliance tighten."""
+
+    def test_bulk_delete_respects_lock(self, http_stack):
+        c = http_stack["client"]
+        r = c.request("PUT", "/bulklock", headers={"x-amz-bucket-object-lock-enabled": "true"})
+        assert r.status_code == 200
+        r = c.put_object("bulklock", "locked", b"keep")
+        vid = r.headers["x-amz-version-id"]
+        body = (
+            f"<Retention><Mode>COMPLIANCE</Mode><RetainUntilDate>{_future()}"
+            "</RetainUntilDate></Retention>"
+        ).encode()
+        assert c.request("PUT", "/bulklock/locked", query=[("retention", "")], body=body).status_code == 200
+        # bulk delete names the locked version explicitly
+        del_xml = (
+            f"<Delete><Object><Key>locked</Key><VersionId>{vid}</VersionId></Object></Delete>"
+        ).encode()
+        r = c.request("POST", "/bulklock", query=[("delete", "")], body=del_xml)
+        assert r.status_code == 200
+        assert "<Error>" in r.text and "AccessDenied" in r.text
+        # version still present
+        g = c.get_object("bulklock", "locked", query=[("versionId", vid)])
+        assert g.status_code == 200 and g.content == b"keep"
+
+    def test_versioning_suspend_rejected_on_lock_bucket(self, http_stack):
+        c = http_stack["client"]
+        r = c.request(
+            "PUT", "/bulklock",
+            query=[("versioning", "")],
+            body=b"<VersioningConfiguration><Status>Suspended</Status></VersioningConfiguration>",
+        )
+        assert r.status_code == 409
+        assert "InvalidBucketState" in r.text
+
+    def test_object_lock_config_requires_versioning(self, http_stack):
+        c = http_stack["client"]
+        c.make_bucket("unvers")
+        cfg = (
+            "<ObjectLockConfiguration><ObjectLockEnabled>Enabled</ObjectLockEnabled>"
+            "</ObjectLockConfiguration>"
+        ).encode()
+        r = c.request("PUT", "/unvers", query=[("object-lock", "")], body=cfg)
+        assert r.status_code == 409
+
+    def test_put_header_bad_date_rejected(self, http_stack):
+        c = http_stack["client"]
+        r = c.put_object(
+            "bulklock", "bad", b"x",
+            headers={
+                "x-amz-object-lock-mode": "GOVERNANCE",
+                "x-amz-object-lock-retain-until-date": "garbage",
+            },
+        )
+        assert r.status_code == 400
+        r = c.put_object(
+            "bulklock", "bad", b"x",
+            headers={
+                "x-amz-object-lock-mode": "GOVERNANCE",
+                "x-amz-object-lock-retain-until-date": "2001-01-01T00:00:00Z",
+            },
+        )
+        assert r.status_code == 400
+
+    def test_governance_to_compliance_tighten_allowed(self, http_stack):
+        c = http_stack["client"]
+        r = c.put_object("bulklock", "tighten", b"x")
+        body = (
+            f"<Retention><Mode>GOVERNANCE</Mode><RetainUntilDate>{_future(1)}"
+            "</RetainUntilDate></Retention>"
+        ).encode()
+        assert c.request("PUT", "/bulklock/tighten", query=[("retention", "")], body=body).status_code == 200
+        body = (
+            f"<Retention><Mode>COMPLIANCE</Mode><RetainUntilDate>{_future(2)}"
+            "</RetainUntilDate></Retention>"
+        ).encode()
+        r = c.request("PUT", "/bulklock/tighten", query=[("retention", "")], body=body)
+        assert r.status_code == 200, r.text
