@@ -37,6 +37,7 @@ class AdminContext:
     trace: object | None = None
     locker: object | None = None
     notification: object | None = None  # peer fan-out
+    replication: object | None = None  # ReplicationSys (bucket-replication.go)
 
 
 def make_admin_app(ctx: AdminContext) -> web.Application:
@@ -288,6 +289,63 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         pstats.Stats(p, stream=buf).sort_stats("cumulative").print_stats(50)
         return web.Response(text=buf.getvalue(), content_type="text/plain")
 
+    # -- replication remote targets (bucket-targets.go admin surface) --------
+
+    def h_set_target(request, body):
+        repl = ctx.replication
+        if repl is None:
+            raise S3Error("NotImplemented")
+        doc = json.loads(body)
+        arn = repl.targets.set_target(
+            doc["bucket"],
+            doc["endpoint"],
+            doc["targetBucket"],
+            doc["accessKey"],
+            doc["secretKey"],
+            doc.get("region", "us-east-1"),
+        )
+        return {"arn": arn}
+
+    def h_list_targets(request, body):
+        repl = ctx.replication
+        if repl is None:
+            raise S3Error("NotImplemented")
+        bucket = request.rel_url.query.get("bucket", "")
+        out = []
+        for t in repl.targets.list_targets(bucket):
+            d = t.to_dict()
+            d.pop("secret_key", None)
+            out.append(d)
+        return out
+
+    def h_remove_target(request, body):
+        repl = ctx.replication
+        if repl is None:
+            raise S3Error("NotImplemented")
+        doc = json.loads(body)
+        repl.targets.remove_target(doc["bucket"], doc["arn"])
+        return {}
+
+    def h_repl_status(request, body):
+        repl = ctx.replication
+        if repl is None:
+            raise S3Error("NotImplemented")
+        s = repl.stats
+        return {
+            "pending": repl.pending,
+            "completed": s.completed,
+            "failed": s.failed,
+            "replicatedBytes": s.replicated_bytes,
+        }
+
+    def h_repl_resync(request, body):
+        repl = ctx.replication
+        if repl is None:
+            raise S3Error("NotImplemented")
+        doc = json.loads(body)
+        n = repl.resync(doc["bucket"])
+        return {"queued": n}
+
     # -- trace streaming (admin-handlers.go:1103 role) -----------------------
 
     async def h_trace(request: web.Request, body):
@@ -335,4 +393,9 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_post("/profile/start", handler(h_profile_start))
     app.router.add_post("/profile/stop", handler(h_profile_stop))
     app.router.add_get("/trace", handler(h_trace, stream=True))
+    app.router.add_post("/replication/target", handler(h_set_target))
+    app.router.add_get("/replication/target", handler(h_list_targets))
+    app.router.add_delete("/replication/target", handler(h_remove_target))
+    app.router.add_get("/replication/status", handler(h_repl_status))
+    app.router.add_post("/replication/resync", handler(h_repl_resync))
     return app

@@ -103,6 +103,7 @@ class S3Server:
         self.trace = None
         self.notifier = None
         self.logger = None
+        self.replication = None  # ReplicationSys (bucket-replication.go role)
 
     # -- plumbing -------------------------------------------------------------
 
@@ -402,6 +403,8 @@ class S3Server:
             content_type=form.get("Content-Type", b"application/octet-stream").decode(),
             etag=hashlib.md5(data).hexdigest(),
         )
+        if self.replication is not None:
+            self.replication.mark_pending(bucket, key, user_defined)
 
         # Route through the same SSE/compression transforms as PUT, exposing
         # form fields as pseudo request headers (x-amz-server-side-encryption
@@ -714,6 +717,11 @@ class S3Server:
         ]
         parts = []
         for (name, vid), (oi, err) in zip(objects, results):
+            # Replication + notification see every successful bulk delete,
+            # same as the single-object path (the reference fans out events
+            # from DeleteMultipleObjectsHandler too).
+            if err is None and oi is not None:
+                self._emit("s3:ObjectRemoved:Delete", bucket, oi)
             if isinstance(err, S3Error):
                 parts.append(
                     f"<Error><Key>{escape(name)}</Key><Code>{err.code}</Code>"
@@ -789,7 +797,7 @@ class S3Server:
     # -- multipart ------------------------------------------------------------
 
     def _initiate_multipart(self, bucket: str, key: str, request: web.Request) -> web.Response:
-        opts = self._put_opts(bucket, request)
+        opts = self._put_opts(bucket, request, key)
         upload_id = self.layer.new_multipart_upload(bucket, key, opts)
         return _xml(
             f'<InitiateMultipartUploadResult xmlns="{XML_NS}">'
@@ -853,7 +861,7 @@ class S3Server:
         self.layer.abort_multipart_upload(bucket, key, upload_id)
         return web.Response(status=204)
 
-    def _put_opts(self, bucket: str, request: web.Request) -> PutObjectOptions:
+    def _put_opts(self, bucket: str, request: web.Request, key: str = "") -> PutObjectOptions:
         meta = self.bucket_meta.get(bucket)
         user_defined = {
             k.lower(): v
@@ -897,11 +905,33 @@ class S3Server:
             if hold not in ("ON", "OFF"):
                 raise S3Error("InvalidArgument", "bad legal hold status")
             user_defined[ol.META_LEGAL_HOLD] = hold
-        return PutObjectOptions(
+        opts = PutObjectOptions(
             user_defined=user_defined,
             versioned=meta.versioning_enabled(),
             content_type=request.headers.get("Content-Type", "application/octet-stream"),
         )
+        # Replica writes from a source cluster: preserve version identity and
+        # mark REPLICA so this object is never re-replicated (the reference's
+        # X-Minio-Source-* handling in object-handlers.go putOpts).
+        from ..control import replication as repl_mod
+
+        if request.headers.get(repl_mod.HDR_SOURCE_REPL, "") == "true":
+            # Only a principal holding s3:ReplicateObject may write replicas
+            # (the reference gates X-Minio-Source-* behind the replication
+            # permission; otherwise any writer could forge REPLICA status or
+            # overwrite an arbitrary version id in place).
+            ak = request.get("access_key", "")
+            if not ak or not self.iam.is_allowed(
+                ak, "s3:ReplicateObject", policy_mod.resource_arn(bucket, key)
+            ):
+                raise S3Error("AccessDenied", "replication permission required")
+            user_defined[repl_mod.META_REPLICA_STATUS] = repl_mod.REPLICA
+            src_vid = request.headers.get(repl_mod.HDR_SOURCE_VID, "")
+            if src_vid and opts.versioned:
+                opts.version_id = src_vid
+        elif self.replication is not None:
+            self.replication.mark_pending(bucket, key, user_defined)
+        return opts
 
     # -- SSE / compression transforms (encryption-v1.go + compression role) --
 
@@ -1016,7 +1046,7 @@ class S3Server:
             want = base64.b64decode(request.headers["Content-Md5"])
             if hashlib.md5(body).digest() != want:
                 raise S3Error("BadDigest")
-        opts = self._put_opts(bucket, request)
+        opts = self._put_opts(bucket, request, key)
         opts.etag = hashlib.md5(body).hexdigest()
         body = self._transform_put(bucket, key, body, request, opts)
         oi = self.layer.put_object(bucket, key, body, opts)
@@ -1040,10 +1070,14 @@ class S3Server:
             raise S3Error("InvalidArgument", "bad copy source")
         src_bucket, src_key = src.split("/", 1)
         src_oi, data = self.layer.get_object(src_bucket, src_key, GetObjectOptions(vid))
-        opts = self._put_opts(bucket, request)
+        opts = self._put_opts(bucket, request, key)
         if request.headers.get("x-amz-metadata-directive", "COPY") == "COPY":
             opts.user_defined = dict(src_oi.user_defined)
             opts.content_type = src_oi.content_type
+            # COPY directive replaced user_defined; re-mark for replication
+            # (src metadata never carries internal replication keys).
+            if self.replication is not None:
+                self.replication.mark_pending(bucket, key, opts.user_defined)
         oi = self.layer.put_object(bucket, key, data, opts)
         self._emit("s3:ObjectCreated:Copy", bucket, oi)
         return _xml(
@@ -1068,6 +1102,13 @@ class S3Server:
             headers["x-amz-tagging-count"] = str(
                 len(urllib.parse.parse_qsl(raw_tags, keep_blank_values=True))
             )
+        from ..control import replication as repl_mod
+
+        repl_status = oi.internal.get(repl_mod.META_REPL_STATUS, "") or oi.internal.get(
+            repl_mod.META_REPLICA_STATUS, ""
+        )
+        if repl_status:
+            headers["x-amz-replication-status"] = repl_status
         return headers
 
     def _get_object(
@@ -1308,10 +1349,35 @@ class S3Server:
             headers["x-amz-delete-marker"] = "true"
         if oi.version_id:
             headers["x-amz-version-id"] = oi.version_id
-        self._emit("s3:ObjectRemoved:Delete", bucket, oi)
+        # Deletes arriving FROM a source cluster's replication worker must not
+        # re-replicate — active-active (bidirectional) targets would ping-pong
+        # delete markers forever otherwise. Same permission gate as replica
+        # PUTs so the header can't be abused to dodge replication.
+        from ..control import replication as repl_mod
+
+        is_replica_op = bool(
+            request is not None
+            and request.headers.get(repl_mod.HDR_SOURCE_REPL, "") == "true"
+            and self.iam.is_allowed(
+                request.get("access_key", ""),
+                "s3:ReplicateObject",
+                policy_mod.resource_arn(bucket, key),
+            )
+        )
+        self._emit("s3:ObjectRemoved:Delete", bucket, oi, replicate=not is_replica_op)
         return web.Response(status=204, headers=headers)
 
-    def _emit(self, event_name: str, bucket: str, oi: ObjectInfo) -> None:
+    def _emit(
+        self, event_name: str, bucket: str, oi: ObjectInfo, replicate: bool = True
+    ) -> None:
+        if self.replication is not None and replicate:
+            try:
+                if event_name.startswith("s3:ObjectCreated:"):
+                    self.replication.on_put(bucket, oi)
+                elif event_name.startswith("s3:ObjectRemoved:"):
+                    self.replication.on_delete(bucket, oi)
+            except Exception:
+                pass
         if self.notifier is not None:
             from ..control.events import Event
 

@@ -33,6 +33,7 @@ class BucketMetadata:
     cors_xml: str = ""
     notification_xml: str = ""
     quota: int = 0
+    targets_json: str = ""  # replication remote targets (bucket-targets.go)
 
     def versioning_enabled(self) -> bool:
         return self.versioning == "Enabled"

@@ -228,6 +228,15 @@ class Node:
         self.s3.trace = self.trace
         self.s3.logger = self.logger
         self.s3.notifier = self.notifier
+        from ..control.replication import BucketTargetSys, ReplicationSys
+
+        self.replication = ReplicationSys(
+            self.pools,
+            self.s3.bucket_meta,
+            BucketTargetSys(self.s3.bucket_meta, kms=self.kms),
+            kms=self.kms,
+        )
+        self.s3.replication = self.replication
         return self
 
     def make_app(self) -> web.Application:
@@ -304,6 +313,10 @@ class _LazyAdminContext:
     @property
     def notification(self):
         return self._node.notification
+
+    @property
+    def replication(self):
+        return getattr(self._node, "replication", None)
 
 
 def _default_set_count(n: int) -> int:

@@ -1,0 +1,365 @@
+"""Bucket replication tests: two single-node clusters, source -> target.
+
+The analogue of the reference's replication integration tests
+(.github/workflows/replication.yaml + bucket-replication tests): a source
+cluster with a replication rule pointing at a second in-process cluster,
+exercising async replication, status transitions, delete-marker replication,
+version preservation, and existing-object resync.
+"""
+
+import json
+from types import SimpleNamespace
+
+import pytest
+
+from minio_tpu.api.server import ThreadedServer
+from minio_tpu.dist.node import Node
+from tests.s3client import S3TestClient
+from tests.test_dist import _free_port
+
+ROOT = "replroot"
+SECRET = "repl-secret-key"
+ADMIN = "/mtpu/admin/v1"
+
+
+def _boot(tmp, name):
+    endpoints = [str(tmp / name / f"d{i}") for i in range(4)]
+    node = Node(endpoints, root_user=ROOT, root_password=SECRET)
+    port = _free_port()
+    ts = ThreadedServer(SimpleNamespace(app=node.make_app()), port=port)
+    ts.start()
+    node.build()
+    url = f"http://127.0.0.1:{port}"
+    return {"node": node, "ts": ts, "url": url, "client": S3TestClient(url, ROOT, SECRET)}
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("repl")
+    src = _boot(tmp, "src")
+    dst = _boot(tmp, "dst")
+    yield src, dst
+    src["ts"].stop()
+    dst["ts"].stop()
+
+
+def _enable_versioning(client, bucket):
+    xml = (
+        '<VersioningConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        "<Status>Enabled</Status></VersioningConfiguration>"
+    )
+    r = client.request("PUT", f"/{bucket}", query=[("versioning", "")], body=xml.encode())
+    assert r.status_code == 200, r.text
+
+
+def _configure(src, dst, bucket, extra_rule_xml=""):
+    """Register dst as a remote target and install a replication rule."""
+    r = src["client"].request(
+        "POST",
+        f"{ADMIN}/replication/target",
+        body=json.dumps(
+            {
+                "bucket": bucket,
+                "endpoint": dst["url"],
+                "targetBucket": bucket,
+                "accessKey": ROOT,
+                "secretKey": SECRET,
+            }
+        ).encode(),
+    )
+    assert r.status_code == 200, r.text
+    arn = r.json()["arn"]
+    xml = (
+        '<ReplicationConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        "<Role></Role><Rule><ID>r1</ID><Status>Enabled</Status><Priority>1</Priority>"
+        "<DeleteMarkerReplication><Status>Enabled</Status></DeleteMarkerReplication>"
+        f"{extra_rule_xml}"
+        "<Filter><Prefix></Prefix></Filter>"
+        f"<Destination><Bucket>{arn}</Bucket></Destination></Rule>"
+        "</ReplicationConfiguration>"
+    )
+    r = src["client"].request(
+        "PUT", f"/{bucket}", query=[("replication", "")], body=xml.encode()
+    )
+    assert r.status_code == 200, r.text
+    return arn
+
+
+class TestReplication:
+    def test_put_replicates(self, pair):
+        src, dst = pair
+        assert src["client"].make_bucket("rbkt").status_code == 200
+        assert dst["client"].make_bucket("rbkt").status_code == 200
+        _enable_versioning(src["client"], "rbkt")
+        _enable_versioning(dst["client"], "rbkt")
+        _configure(src, dst, "rbkt")
+
+        r = src["client"].put_object(
+            "rbkt",
+            "hello.txt",
+            b"replicate me",
+            headers={"x-amz-meta-color": "green", "Content-Type": "text/plain"},
+        )
+        assert r.status_code == 200
+        src_vid = r.headers["x-amz-version-id"]
+        assert src["node"].replication.drain(15)
+
+        # Target copy: same bytes, metadata, and version id; REPLICA status.
+        r = dst["client"].request("GET", "/rbkt/hello.txt")
+        assert r.status_code == 200
+        assert r.content == b"replicate me"
+        assert r.headers["x-amz-meta-color"] == "green"
+        assert r.headers["x-amz-replication-status"] == "REPLICA"
+        assert r.headers["x-amz-version-id"] == src_vid
+
+        # Source shows COMPLETED after the async write-back.
+        r = src["client"].request("HEAD", "/rbkt/hello.txt")
+        assert r.headers["x-amz-replication-status"] == "COMPLETED"
+
+    def test_delete_marker_replicates(self, pair):
+        src, dst = pair
+        src["client"].put_object("rbkt", "doomed.txt", b"bye")
+        assert src["node"].replication.drain(15)
+        assert dst["client"].request("HEAD", "/rbkt/doomed.txt").status_code == 200
+
+        r = src["client"].request("DELETE", "/rbkt/doomed.txt")
+        assert r.status_code == 204
+        assert r.headers.get("x-amz-delete-marker") == "true"
+        assert src["node"].replication.drain(15)
+        assert dst["client"].request("HEAD", "/rbkt/doomed.txt").status_code == 404
+
+    def test_status_endpoint(self, pair):
+        src, _ = pair
+        r = src["client"].request("GET", f"{ADMIN}/replication/status")
+        assert r.status_code == 200
+        stats = r.json()
+        assert stats["completed"] >= 2
+        assert stats["replicatedBytes"] > 0
+
+    def test_resync_existing_objects(self, pair):
+        src, dst = pair
+        assert src["client"].make_bucket("oldbkt").status_code == 200
+        assert dst["client"].make_bucket("oldbkt").status_code == 200
+        _enable_versioning(src["client"], "oldbkt")
+        _enable_versioning(dst["client"], "oldbkt")
+        # Objects written BEFORE any replication config exists.
+        for i in range(3):
+            src["client"].put_object("oldbkt", f"pre-{i}", f"old {i}".encode())
+        _configure(
+            src,
+            dst,
+            "oldbkt",
+            extra_rule_xml="<ExistingObjectReplication><Status>Enabled</Status>"
+            "</ExistingObjectReplication>",
+        )
+        r = src["client"].request(
+            "POST",
+            f"{ADMIN}/replication/resync",
+            body=json.dumps({"bucket": "oldbkt"}).encode(),
+        )
+        assert r.status_code == 200, r.text
+        assert r.json()["queued"] == 3
+        assert src["node"].replication.drain(15)
+        for i in range(3):
+            r = dst["client"].request("GET", f"/oldbkt/pre-{i}")
+            assert r.status_code == 200
+            assert r.content == f"old {i}".encode()
+
+    def test_replica_not_re_replicated(self, pair):
+        """A REPLICA object on the target must not loop back even if the
+        target itself had a rule (loop prevention via replica status)."""
+        src, dst = pair
+        # Target object carries REPLICA status; on_put must skip it.
+        r = dst["client"].request("HEAD", "/rbkt/hello.txt")
+        assert r.headers["x-amz-replication-status"] == "REPLICA"
+
+    def test_rule_prefix_filter(self, pair):
+        src, dst = pair
+        assert src["client"].make_bucket("pfx").status_code == 200
+        assert dst["client"].make_bucket("pfx").status_code == 200
+        r = src["client"].request(
+            "POST",
+            f"{ADMIN}/replication/target",
+            body=json.dumps(
+                {
+                    "bucket": "pfx",
+                    "endpoint": dst["url"],
+                    "targetBucket": "pfx",
+                    "accessKey": ROOT,
+                    "secretKey": SECRET,
+                }
+            ).encode(),
+        )
+        arn = r.json()["arn"]
+        xml = (
+            '<ReplicationConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            "<Rule><ID>p</ID><Status>Enabled</Status><Priority>1</Priority>"
+            "<Filter><Prefix>logs/</Prefix></Filter>"
+            f"<Destination><Bucket>{arn}</Bucket></Destination></Rule>"
+            "</ReplicationConfiguration>"
+        )
+        assert (
+            src["client"]
+            .request("PUT", "/pfx", query=[("replication", "")], body=xml.encode())
+            .status_code
+            == 200
+        )
+        src["client"].put_object("pfx", "logs/a", b"in scope")
+        src["client"].put_object("pfx", "data/b", b"out of scope")
+        assert src["node"].replication.drain(15)
+        assert dst["client"].request("HEAD", "/pfx/logs/a").status_code == 200
+        assert dst["client"].request("HEAD", "/pfx/data/b").status_code == 404
+
+    def test_forged_replica_header_denied(self, pair):
+        """A plain user may not forge x-minio-source-replication-request to
+        overwrite versions in place or mark objects REPLICA."""
+        src, _ = pair
+        # Narrow policy: object read/write but NOT s3:ReplicateObject.
+        doc = {
+            "Version": "2012-10-17",
+            "Statement": [
+                {
+                    "Effect": "Allow",
+                    "Action": ["s3:PutObject", "s3:GetObject", "s3:ListBucket"],
+                    "Resource": ["arn:aws:s3:::*"],
+                }
+            ],
+        }
+        r = src["client"].request(
+            "PUT", f"{ADMIN}/policies/putonly", body=json.dumps(doc).encode()
+        )
+        assert r.status_code == 200, r.text
+        r = src["client"].request(
+            "POST",
+            f"{ADMIN}/users",
+            body=json.dumps(
+                {"accessKey": "mallory", "secretKey": "mallory-secret1", "policies": ["putonly"]}
+            ).encode(),
+        )
+        assert r.status_code == 200, r.text
+        mallory = S3TestClient(src["url"], "mallory", "mallory-secret1")
+        r = mallory.put_object(
+            "rbkt",
+            "forged.txt",
+            b"evil",
+            headers={
+                "x-minio-source-replication-request": "true",
+                "x-minio-source-version-id": "00000000-0000-0000-0000-000000000001",
+            },
+        )
+        assert r.status_code == 403
+
+    def test_version_delete_replicates_versioned(self, pair):
+        """Permanent version deletes only replicate under DeleteReplication,
+        and remove exactly that version on the target."""
+        src, dst = pair
+        assert src["client"].make_bucket("vdel").status_code == 200
+        assert dst["client"].make_bucket("vdel").status_code == 200
+        _enable_versioning(src["client"], "vdel")
+        _enable_versioning(dst["client"], "vdel")
+        _configure(
+            src,
+            dst,
+            "vdel",
+            extra_rule_xml="<DeleteReplication><Status>Enabled</Status></DeleteReplication>",
+        )
+        v1 = src["client"].put_object("vdel", "k", b"one").headers["x-amz-version-id"]
+        v2 = src["client"].put_object("vdel", "k", b"two").headers["x-amz-version-id"]
+        assert src["node"].replication.drain(15)
+        # Delete the OLD version on the source; target's latest must survive.
+        r = src["client"].request("DELETE", "/vdel/k", query=[("versionId", v1)])
+        assert r.status_code == 204
+        assert src["node"].replication.drain(15)
+        r = dst["client"].request("GET", "/vdel/k")
+        assert r.status_code == 200 and r.content == b"two"
+        assert r.headers["x-amz-version-id"] == v2
+        r = dst["client"].request("GET", "/vdel/k", query=[("versionId", v1)])
+        assert r.status_code == 404
+
+    def test_tags_replicate(self, pair):
+        src, dst = pair
+        r = src["client"].put_object(
+            "rbkt", "tagged.txt", b"tagged", headers={"x-amz-tagging": "env=prod&team=ml"}
+        )
+        assert r.status_code == 200
+        assert src["node"].replication.drain(15)
+        r = dst["client"].request("GET", "/rbkt/tagged.txt", query=[("tagging", "")])
+        assert r.status_code == 200
+        assert "env" in r.text and "prod" in r.text
+
+    def test_bulk_delete_replicates(self, pair):
+        src, dst = pair
+        for i in range(3):
+            src["client"].put_object("rbkt", f"bulk-{i}", b"x")
+        assert src["node"].replication.drain(15)
+        for i in range(3):
+            assert dst["client"].request("HEAD", f"/rbkt/bulk-{i}").status_code == 200
+        xml = "<Delete>" + "".join(
+            f"<Object><Key>bulk-{i}</Key></Object>" for i in range(3)
+        ) + "</Delete>"
+        import hashlib, base64
+
+        r = src["client"].request(
+            "POST",
+            "/rbkt",
+            query=[("delete", "")],
+            body=xml.encode(),
+            headers={"Content-Md5": base64.b64encode(hashlib.md5(xml.encode()).digest()).decode()},
+        )
+        assert r.status_code == 200, r.text
+        assert src["node"].replication.drain(15)
+        for i in range(3):
+            assert dst["client"].request("HEAD", f"/rbkt/bulk-{i}").status_code == 404
+
+    def test_active_active_no_ping_pong(self, pair):
+        """Bidirectional rules must not loop: replica PUTs are skipped via
+        REPLICA status, replica DELETEs via the source-replication header."""
+        src, dst = pair
+        for c in (src["client"], dst["client"]):
+            assert c.make_bucket("bidir").status_code == 200
+            _enable_versioning(c, "bidir")
+        _configure(src, dst, "bidir")
+        _configure(dst, src, "bidir")
+
+        src["client"].put_object("bidir", "ping", b"pong")
+        assert src["node"].replication.drain(15)
+        assert dst["node"].replication.drain(15)
+        assert src["node"].replication.drain(5)  # nothing bounced back
+        r = dst["client"].request("HEAD", "/bidir/ping")
+        assert r.headers["x-amz-replication-status"] == "REPLICA"
+
+        src["client"].request("DELETE", "/bidir/ping")
+        assert src["node"].replication.drain(15)
+        assert dst["node"].replication.drain(15)
+        assert src["node"].replication.drain(5)
+        # Exactly one marker version on each side (no ping-pong growth).
+        for c in (src["client"], dst["client"]):
+            r = c.request("GET", "/bidir", query=[("versions", "")])
+            assert r.text.count("<DeleteMarker>") == 1, r.text
+
+    def test_target_secret_sealed_at_rest(self, pair):
+        """The stored bucket metadata must not contain the target's secret
+        key in cleartext (sealed with the cluster KMS)."""
+        src, _ = pair
+        raw = src["node"].s3.bucket_meta.get("rbkt").targets_json
+        assert SECRET not in raw
+        assert "sealed:" in raw
+        # Round-trip still yields a working client (covered implicitly by the
+        # other tests, but assert the unsealed value directly).
+        ts = src["node"].replication.targets.list_targets("rbkt")
+        assert ts and ts[0].secret_key == SECRET
+
+    def test_target_listing_and_removal(self, pair):
+        src, _ = pair
+        r = src["client"].request("GET", f"{ADMIN}/replication/target", query=[("bucket", "pfx")])
+        targets = r.json()
+        assert len(targets) == 1
+        assert "secret_key" not in targets[0]
+        r = src["client"].request(
+            "DELETE",
+            f"{ADMIN}/replication/target",
+            body=json.dumps({"bucket": "pfx", "arn": targets[0]["arn"]}).encode(),
+        )
+        assert r.status_code == 200
+        r = src["client"].request("GET", f"{ADMIN}/replication/target", query=[("bucket", "pfx")])
+        assert r.json() == []
