@@ -38,6 +38,7 @@ class AdminContext:
     locker: object | None = None
     notification: object | None = None  # peer fan-out
     replication: object | None = None  # ReplicationSys (bucket-replication.go)
+    tiering: object | None = None  # TierConfigMgr (tier.go)
 
 
 def make_admin_app(ctx: AdminContext) -> web.Application:
@@ -346,6 +347,50 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         n = repl.resync(doc["bucket"])
         return {"queued": n}
 
+    # -- remote tiers (mc admin tier add/ls/rm; cmd/tier.go surface) ---------
+
+    def h_tier_add(request, body):
+        if ctx.tiering is None:
+            raise S3Error("NotImplemented")
+        from ..control.tiering import TierConfig
+
+        ctx.tiering.add(TierConfig.from_dict(json.loads(body)))
+        return {}
+
+    def h_tier_list(request, body):
+        if ctx.tiering is None:
+            raise S3Error("NotImplemented")
+        out = []
+        for t in ctx.tiering.list():
+            d = t.to_dict()
+            d.pop("secret_key", None)
+            out.append(d)
+        return out
+
+    def h_tier_remove(request, body):
+        if ctx.tiering is None:
+            raise S3Error("NotImplemented")
+        ctx.tiering.remove(request.match_info["name"])
+        return {}
+
+    def h_tier_edit(request, body):
+        if ctx.tiering is None:
+            raise S3Error("NotImplemented")
+        doc = json.loads(body)
+        ctx.tiering.edit_creds(
+            request.match_info["name"], doc["accessKey"], doc["secretKey"]
+        )
+        return {}
+
+    def h_tier_stats(request, body):
+        if ctx.tiering is None:
+            raise S3Error("NotImplemented")
+        return {
+            "transitionedObjects": ctx.tiering.transitioned_objects,
+            "transitionedBytes": ctx.tiering.transitioned_bytes,
+            "journalBacklog": ctx.tiering.journal_backlog(),
+        }
+
     # -- trace streaming (admin-handlers.go:1103 role) -----------------------
 
     async def h_trace(request: web.Request, body):
@@ -398,4 +443,9 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_delete("/replication/target", handler(h_remove_target))
     app.router.add_get("/replication/status", handler(h_repl_status))
     app.router.add_post("/replication/resync", handler(h_repl_resync))
+    app.router.add_post("/tiers", handler(h_tier_add))
+    app.router.add_get("/tiers", handler(h_tier_list))
+    app.router.add_delete("/tiers/{name}", handler(h_tier_remove))
+    app.router.add_put("/tiers/{name}/creds", handler(h_tier_edit))
+    app.router.add_get("/tiers/stats", handler(h_tier_stats))
     return app

@@ -27,6 +27,7 @@ from aiohttp import web
 
 from ..control.bucket_meta import BucketMetadataSys
 from ..control import objectlock as ol
+from ..control import tiering as tiering_mod
 from ..control.iam import IAMSys
 from ..control import policy as policy_mod
 from ..object.pools import ServerPools
@@ -104,6 +105,7 @@ class S3Server:
         self.notifier = None
         self.logger = None
         self.replication = None  # ReplicationSys (bucket-replication.go role)
+        self.tiering = None  # TierConfigMgr (tier.go / bucket-lifecycle.go role)
 
     # -- plumbing -------------------------------------------------------------
 
@@ -705,12 +707,27 @@ class S3Server:
             objects_to_delete = survivors
         else:
             objects_to_delete = objects
+        tier_metas: dict[tuple[str, str], dict] = {}
+        if self.tiering is not None:
+            for name, vid in objects_to_delete:
+                if not vid and versioned:
+                    continue  # marker creation keeps the data
+                try:
+                    probe = self.layer.get_object_info(bucket, name, GetObjectOptions(vid))
+                    if tiering_mod.is_transitioned(probe.internal):
+                        tier_metas[(name, vid)] = probe.internal
+                except oerr.StorageError:
+                    pass
         results_by_obj = dict(
             zip(
                 objects_to_delete,
                 self.layer.delete_objects(bucket, objects_to_delete, versioned=versioned),
             )
         )
+        # Journal tier reclamation only for deletes that actually succeeded.
+        for okey, (oi_res, err_res) in results_by_obj.items():
+            if err_res is None and okey in tier_metas:
+                self.tiering.journal_delete(tier_metas[okey])
         results = [
             results_by_obj.get((name, vid), (None, locked_errors.get((name, vid))))
             for name, vid in objects
@@ -755,6 +772,8 @@ class S3Server:
                 return await asyncio.to_thread(
                     self._complete_multipart, bucket, key, q["uploadId"], body
                 )
+            if "restore" in q:
+                return await asyncio.to_thread(self._restore_object, bucket, key, q, body)
             raise S3Error("MethodNotAllowed")
         if m == "PUT":
             if "tagging" in q:
@@ -1109,6 +1128,12 @@ class S3Server:
         )
         if repl_status:
             headers["x-amz-replication-status"] = repl_status
+        if tiering_mod.is_transitioned(oi.internal):
+            # Listings/HEAD show the tier name as the storage class, like the
+            # reference does for transitioned objects.
+            headers["x-amz-storage-class"] = oi.internal.get(
+                tiering_mod.META_TRANSITION_TIER, "GLACIER"
+            )
         return headers
 
     def _get_object(
@@ -1130,10 +1155,16 @@ class S3Server:
             if rng:
                 offset, length, total_needed = _parse_range(rng)
             probe = self.layer.get_object_info(bucket, key, opts)
-            if self._is_transformed(probe):
-                # Transformed payloads: fetch whole, undo transforms, then
-                # apply the range on logical bytes.
-                oi, data = self.layer.get_object(bucket, key, opts)
+            tiered = self.tiering is not None and tiering_mod.is_transitioned(probe.internal)
+            if tiered or self._is_transformed(probe):
+                # Tiered and/or transformed payloads: fetch whole (from the
+                # remote tier for transitioned versions), undo transforms,
+                # then apply the range on logical bytes.
+                if tiered:
+                    oi = probe
+                    data = self.tiering.read_object(self.layer, bucket, key, probe)
+                else:
+                    oi, data = self.layer.get_object(bucket, key, opts)
                 data = self._transform_get(bucket, key, data, oi, request)
                 logical = len(data)
                 if rng:
@@ -1319,6 +1350,30 @@ class S3Server:
             headers={"Content-Type": "application/octet-stream"},
         )
 
+    def _restore_object(self, bucket: str, key: str, q, body: bytes) -> web.Response:
+        """POST ?restore: materialize a transitioned object locally for N days
+        (PostRestoreObjectHandler, cmd/bucket-lifecycle.go role)."""
+        if self.tiering is None:
+            raise S3Error("NotImplemented")
+        days = 1
+        if body:
+            try:
+                root = ET.fromstring(body)
+                for c in root.iter():
+                    if c.tag.split("}")[-1] == "Days" and c.text:
+                        days = int(c.text)
+            except ET.ParseError:
+                raise S3Error("MalformedXML")
+        vid = self._vid(q)
+        try:
+            oi = self.layer.get_object_info(bucket, key, GetObjectOptions(vid))
+        except oerr.StorageError as e:
+            raise from_object_error(e, bucket, key)
+        already = tiering_mod.restore_expiry(oi.user_defined) > _time.time()
+        self.tiering.restore(self.layer, bucket, key, vid, days)
+        # 200 if refreshing an existing restore, 202 for a new one (S3 wire).
+        return web.Response(status=200 if already else 202)
+
     def _delete_object(self, bucket: str, key: str, q, request=None) -> web.Response:
         vid = self._vid(q)
         meta = self.bucket_meta.get(bucket)
@@ -1342,8 +1397,22 @@ class S3Server:
                         policy_mod.resource_arn(bucket, key),
                     )
                 ol.check_delete_allowed(oi.user_defined, bypass, may_bypass)
+        # Permanent deletes of transitioned versions journal the remote tier
+        # copy for async reclamation (tier-journal.go role) — but only AFTER
+        # the local delete succeeds, or a failed delete would orphan a live
+        # version whose tier bytes get reclaimed underneath it.
+        tier_meta = None
+        if self.tiering is not None and (vid or not meta.versioning_enabled()):
+            try:
+                probe = self.layer.get_object_info(bucket, key, GetObjectOptions(vid))
+                if tiering_mod.is_transitioned(probe.internal):
+                    tier_meta = probe.internal
+            except oerr.StorageError:
+                pass
         opts = DeleteObjectOptions(version_id=vid, versioned=meta.versioning_enabled())
         oi = self.layer.delete_object(bucket, key, opts)
+        if tier_meta is not None:
+            self.tiering.journal_delete(tier_meta)
         headers = {}
         if oi.delete_marker:
             headers["x-amz-delete-marker"] = "true"

@@ -142,3 +142,30 @@ def sse_c_decrypt(blob: bytes, meta: dict[str, str], client_key: bytes, bucket: 
 
 def is_encrypted(meta: dict[str, str]) -> str:
     return meta.get(META_ALGO, "")
+
+
+def seal_secret(kms, context: str, secret: str) -> str:
+    """Seal a small config secret (remote-target / tier credentials) with a
+    KMS data key for at-rest storage. Format: sealed:<keyid>:<b64 dk>:<b64 blob>.
+    The reference KMS-encrypts such config (cmd/config-encrypted.go role)."""
+    if kms is None:
+        return secret
+    import base64
+
+    dk = kms.generate_key(context=context)
+    blob = encrypt_stream(secret.encode(), dk.plaintext)
+    return "sealed:" + ":".join(
+        [dk.key_id, base64.b64encode(dk.ciphertext).decode(), base64.b64encode(blob).decode()]
+    )
+
+
+def unseal_secret(kms, context: str, stored: str) -> str:
+    if not stored.startswith("sealed:"):
+        return stored
+    if kms is None:
+        raise errors.StorageError("sealed secret but no KMS configured")
+    import base64
+
+    key_id, ct, blob = stored[len("sealed:"):].split(":")
+    dk = kms.decrypt_key(key_id, base64.b64decode(ct), context=context)
+    return decrypt_stream(base64.b64decode(blob), dk).decode()

@@ -30,7 +30,8 @@ class LifecycleRule:
     expiration_date: float = 0.0
     expired_delete_marker: bool = False
     noncurrent_days: int = 0
-    transition_days: int = 0
+    transition_days: int = -1  # -1 = no <Days> element (0 is valid: immediate)
+    transition_date: float = 0.0
     transition_tier: str = ""
 
     def applies(self, object_name: str) -> bool:
@@ -76,6 +77,11 @@ class Lifecycle:
                     days = _text(c, "Days")
                     if days:
                         r.transition_days = int(days)
+                    date = _text(c, "Date")
+                    if date:
+                        r.transition_date = time.mktime(
+                            time.strptime(date[:10], "%Y-%m-%d")
+                        )
                     r.transition_tier = _text(c, "StorageClass")
             rules.append(r)
         return cls(rules)
@@ -92,8 +98,13 @@ class Lifecycle:
                 return "expire"
             if r.expiration_date and now > r.expiration_date:
                 return "expire"
-            if r.transition_days and r.transition_tier and now - mod_time > r.transition_days * 86400:
-                return f"transition:{r.transition_tier}"
+            # Days=0 means transition as soon as the scanner sees the object
+            # (valid per S3); a rule with only <Date> waits for that date.
+            if r.transition_tier:
+                if r.transition_days >= 0 and now - mod_time >= r.transition_days * 86400:
+                    return f"transition:{r.transition_tier}"
+                if r.transition_date and now > r.transition_date:
+                    return f"transition:{r.transition_tier}"
         return ""
 
     def eval_noncurrent(self, object_name: str, successor_mod_time: float) -> bool:

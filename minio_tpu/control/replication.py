@@ -135,32 +135,14 @@ class BucketTargetSys:
         self._lock = threading.Lock()
 
     def _seal(self, bucket: str, secret: str) -> str:
-        if self.kms is None:
-            return secret
-        import base64
+        from .crypto import seal_secret
 
-        from . import crypto as crypto_mod
-
-        dk = self.kms.generate_key(context=f"bucket-targets/{bucket}")
-        blob = crypto_mod.encrypt_stream(secret.encode(), dk.plaintext)
-        return "sealed:" + ":".join(
-            [dk.key_id, base64.b64encode(dk.ciphertext).decode(), base64.b64encode(blob).decode()]
-        )
+        return seal_secret(self.kms, f"bucket-targets/{bucket}", secret)
 
     def _unseal(self, bucket: str, stored: str) -> str:
-        if not stored.startswith("sealed:"):
-            return stored
-        if self.kms is None:
-            raise errors.StorageError("sealed bucket-target secret but no KMS")
-        import base64
+        from .crypto import unseal_secret
 
-        from . import crypto as crypto_mod
-
-        key_id, ct, blob = stored[len("sealed:"):].split(":")
-        dk = self.kms.decrypt_key(
-            key_id, base64.b64decode(ct), context=f"bucket-targets/{bucket}"
-        )
-        return crypto_mod.decrypt_stream(base64.b64decode(blob), dk).decode()
+        return unseal_secret(self.kms, f"bucket-targets/{bucket}", stored)
 
     def _load(self, bucket: str) -> list[BucketTarget]:
         raw = getattr(self.bucket_meta.get(bucket), "targets_json", "") or "[]"

@@ -44,6 +44,7 @@ class DataScanner:
         heal_sample: int = HEAL_SAMPLE,
         leader_lock=None,
         store=None,
+        tiering=None,
     ):
         self.layer = layer
         self.bucket_meta = bucket_meta
@@ -52,10 +53,12 @@ class DataScanner:
         self.heal_sample = heal_sample
         self.leader_lock = leader_lock
         self.store = store
+        self.tiering = tiering
         self.usage = DataUsageCache()
         self.cycles_completed = 0
         self.objects_healed = 0
         self.objects_expired = 0
+        self.objects_transitioned = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._sleeper = DynamicSleeper()
@@ -105,12 +108,14 @@ class DataScanner:
                         continue
                     if not fi.deleted:
                         fresh.record(bucket, name, fi.size, len(meta.versions))
-                    # Lifecycle expiry.
+                    # Lifecycle expiry / transition-to-tier.
                     if lc is not None:
                         action = lc.eval(name, fi.mod_time, fi.deleted)
                         if action == "expire":
-                            self._expire(bucket, name)
+                            self._expire(bucket, name, fi)
                             continue
+                        if action.startswith("transition:"):
+                            self._transition(bucket, name, fi, action.split(":", 1)[1])
                     # Heal sampling: deep-verify 1 in heal_sample objects.
                     if self._rng.randrange(self.heal_sample) == 0:
                         self._deep_check(bucket, name)
@@ -118,6 +123,12 @@ class DataScanner:
         fresh.finish()
         self.usage = fresh
         self.cycles_completed += 1
+        if self.tiering is not None:
+            try:
+                self.tiering.drain_journal()
+                self.tiering.expire_restored_copies(self.layer)
+            except Exception:  # noqa: BLE001
+                pass
         if self.store is not None:
             try:
                 self.store.put("scanner/data-usage.json", fresh.to_bytes())
@@ -135,9 +146,28 @@ class DataScanner:
         except Exception:  # noqa: BLE001
             return None
 
-    def _expire(self, bucket: str, name: str) -> None:
+    def _expire(self, bucket: str, name: str, fi=None) -> None:
         try:
-            self.layer.delete_object(bucket, name)
+            # On versioned buckets expiry writes a delete marker (the data
+            # stays as a noncurrent version, like the reference's scanner);
+            # unversioned buckets delete outright.
+            versioned = False
+            if self.bucket_meta is not None:
+                try:
+                    versioned = self.bucket_meta.get(bucket).versioning_enabled()
+                except Exception:  # noqa: BLE001
+                    pass
+            from ..object.types import DeleteObjectOptions
+
+            self.layer.delete_object(bucket, name, DeleteObjectOptions(versioned=versioned))
+            # A permanent expiry of a transitioned version reclaims the
+            # remote copy — journaled only after the local delete succeeded.
+            # Marker creation keeps the data referenced, so no journaling.
+            if not versioned and self.tiering is not None and fi is not None:
+                from .tiering import is_transitioned
+
+                if is_transitioned(fi.metadata):
+                    self.tiering.journal_delete(fi.metadata)
             self.objects_expired += 1
             if self.notifier is not None:
                 from .events import Event
@@ -147,6 +177,19 @@ class DataScanner:
                 )
         except errors.StorageError:
             pass
+
+    def _transition(self, bucket: str, name: str, fi, tier: str) -> None:
+        if self.tiering is None:
+            return
+        from .tiering import is_transitioned
+
+        if is_transitioned(fi.metadata) or fi.deleted:
+            return
+        try:
+            self.tiering.transition(self.layer, bucket, name, fi.version_id, tier)
+            self.objects_transitioned += 1
+        except Exception:  # noqa: BLE001 - unreachable tier (raw requests
+            pass  # errors) must not abort the whole scan cycle
 
     def _deep_check(self, bucket: str, name: str) -> None:
         try:

@@ -215,6 +215,10 @@ class Node:
         self.notifier = EventNotifier()
         self.healmgr = HealManager(self.pools)
         self.mrf = MRFQueue(self.pools)
+        from ..control.tiering import TierConfigMgr
+
+        self.tiering = TierConfigMgr(store, kms=self.kms)
+        self.s3.tiering = self.tiering
         # Scanner leadership via a never-released dsync lock (runDataScanner
         # :99-111); only one node in the cluster scans at a time.
         self.scanner = DataScanner(
@@ -223,6 +227,7 @@ class Node:
             notifier=self.notifier,
             leader_lock=self.ns_lock.new(".minio_tpu.sys", "leader/data-scanner"),
             store=store,
+            tiering=self.tiering,
         )
         self.s3.metrics = self.metrics
         self.s3.trace = self.trace
@@ -317,6 +322,10 @@ class _LazyAdminContext:
     @property
     def replication(self):
         return getattr(self._node, "replication", None)
+
+    @property
+    def tiering(self):
+        return getattr(self._node, "tiering", None)
 
 
 def _default_set_count(n: int) -> int:

@@ -545,6 +545,64 @@ class ErasureObjects:
             raise err
         return ObjectInfo.from_file_info(fi, bucket, object_name)
 
+    def transition_object(
+        self,
+        bucket: str,
+        object_name: str,
+        version_id: str,
+        tier: str,
+        remote_name: str,
+        expected_etag: str = "",
+        expected_mtime: float = 0.0,
+    ) -> ObjectInfo:
+        """Mark a version transitioned to a remote tier and free its local
+        data parts (the reference's DeleteObject w/ transition markers in
+        cmd/bucket-lifecycle.go transitionObject + erasure-object.go: xl.meta
+        keeps TransitionStatus/TransitionedObjName/TransitionTier while the
+        shard files are reclaimed). The caller has already uploaded the bytes
+        to the tier under remote_name; expected_etag/mtime guard against the
+        version having been overwritten since the caller read it (otherwise a
+        concurrent PUT on an unversioned bucket would be stamped as pointing
+        at stale tier bytes and lose the new data). Inline (small) objects
+        are left local — reclaiming xl.meta-inline bytes saves nothing."""
+        from ..control.tiering import (
+            META_TRANSITION_NAME,
+            META_TRANSITION_STATUS,
+            META_TRANSITION_TIER,
+            STATUS_COMPLETE,
+        )
+
+        self.get_bucket_info(bucket)
+        fi, metas, disks = self._read_quorum_fi(bucket, object_name, version_id)
+        if fi.deleted:
+            raise errors.MethodNotAllowed(bucket, object_name)
+        if not fi.data_dir:
+            raise errors.InvalidArgument(bucket, object_name, "inline object not transitionable")
+        if expected_etag and fi.metadata.get("etag", "") != expected_etag:
+            raise errors.PreconditionFailed(msg="object changed since tier upload")
+        if expected_mtime and abs(fi.mod_time - expected_mtime) > 1e-6:
+            raise errors.PreconditionFailed(msg="object changed since tier upload")
+        updates = {
+            META_TRANSITION_STATUS: STATUS_COMPLETE,
+            META_TRANSITION_TIER: tier,
+            META_TRANSITION_NAME: remote_name,
+        }
+        oi = self.put_object_metadata(bucket, object_name, version_id, updates=updates)
+
+        # Metadata is durable first: a crash here leaves orphan part files
+        # (reclaimed by heal/scan) but never a transitioned object whose
+        # local parts are gone without the remote pointer being recorded.
+        def free(d):
+            if d is None:
+                return
+            try:
+                d.delete(bucket, os.path.join(object_name, fi.data_dir), recursive=True)
+            except errors.DiskError:
+                pass
+
+        meta_mod.parallel_map(free, list(disks))
+        return oi
+
     def delete_object(
         self, bucket: str, object_name: str, opts: DeleteObjectOptions | None = None
     ) -> ObjectInfo:
@@ -636,8 +694,11 @@ class ErasureObjects:
                 state.append("ok")
         result.before_drive_state = list(state)
 
-        if fi.deleted:
-            # Heal = copy the delete marker to stale drives.
+        from ..control.tiering import META_TRANSITION_STATUS, STATUS_COMPLETE
+
+        if fi.deleted or fi.metadata.get(META_TRANSITION_STATUS) == STATUS_COMPLETE:
+            # Delete markers and transitioned versions have no local shard
+            # data; heal = copy the metadata record to stale drives.
             to_heal = [i for i, s in enumerate(state) if s == "missing"]
             if not dry_run:
                 for i in to_heal:

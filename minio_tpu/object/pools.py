@@ -161,6 +161,21 @@ class ServerPools:
         pool = self._pool_holding(bucket, object_name, version_id)
         return pool.put_object_metadata(bucket, object_name, version_id, updates, removes)
 
+    def transition_object(
+        self,
+        bucket,
+        object_name,
+        version_id: str,
+        tier: str,
+        remote_name: str,
+        expected_etag: str = "",
+        expected_mtime: float = 0.0,
+    ) -> ObjectInfo:
+        pool = self._pool_holding(bucket, object_name, version_id)
+        return pool.transition_object(
+            bucket, object_name, version_id, tier, remote_name, expected_etag, expected_mtime
+        )
+
     def delete_object(
         self, bucket: str, object_name: str, opts: DeleteObjectOptions | None = None
     ) -> ObjectInfo:

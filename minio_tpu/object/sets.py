@@ -150,6 +150,15 @@ class ErasureSets:
             bucket, object_name, version_id, updates, removes
         )
 
+    def transition_object(
+        self, bucket, object_name, version_id, tier, remote_name,
+        expected_etag="", expected_mtime=0.0,
+    ):
+        return self.get_hashed_set(object_name).transition_object(
+            bucket, object_name, version_id, tier, remote_name,
+            expected_etag, expected_mtime,
+        )
+
     def delete_object(self, bucket, object_name, opts: DeleteObjectOptions | None = None):
         return self.get_hashed_set(object_name).delete_object(bucket, object_name, opts)
 

@@ -28,6 +28,7 @@ class ObjectInfo:
     actual_size: int | None = None
     storage_class: str = "STANDARD"
     internal: dict[str, str] = field(default_factory=dict)
+    inline: bool = False  # data embedded in xl.meta (no part files on disk)
 
     @classmethod
     def from_file_info(cls, fi: FileInfo, bucket: str, name: str) -> "ObjectInfo":
@@ -50,6 +51,7 @@ class ObjectInfo:
             parts=list(fi.parts),
             num_versions=fi.num_versions,
             internal=internal,
+            inline=not fi.data_dir,
         )
 
 

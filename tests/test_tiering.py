@@ -1,0 +1,211 @@
+"""ILM tiering tests: remote tiers, transition, tiered reads, restore,
+deferred remote deletes.
+
+The analogue of the reference's tier + lifecycle-transition coverage
+(cmd/tier.go TierConfigMgr, cmd/bucket-lifecycle.go transition/restore,
+cmd/tier-journal.go): transition frees local shard data, reads stream from
+the tier, RestoreObject materializes a temporary local copy, deletes journal
+the remote object for reclamation.
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from minio_tpu.api.server import ThreadedServer
+from minio_tpu.control import tiering as tiering_mod
+from minio_tpu.dist.node import Node
+from tests.s3client import S3TestClient
+from tests.test_dist import _free_port
+
+ROOT = "tierroot1"
+SECRET = "tier-secret-key1"
+ADMIN = "/mtpu/admin/v1"
+
+BIG = os.urandom(256 * 1024)  # above the 128 KiB inline threshold
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("tiersrv")
+    node = Node([str(tmp / f"d{i}") for i in range(4)], root_user=ROOT, root_password=SECRET)
+    port = _free_port()
+    ts = ThreadedServer(SimpleNamespace(app=node.make_app()), port=port)
+    ts.start()
+    node.build()
+    client = S3TestClient(f"http://127.0.0.1:{port}", ROOT, SECRET)
+    tier_dir = str(tmp / "coldstore")
+    r = client.request(
+        "POST",
+        f"{ADMIN}/tiers",
+        body=json.dumps({"name": "COLD", "type": "fs", "dir": tier_dir, "prefix": "x/"}).encode(),
+    )
+    assert r.status_code == 200, r.text
+    yield {"client": client, "node": node, "tier_dir": tier_dir, "tmp": tmp,
+           "url": f"http://127.0.0.1:{port}"}
+    ts.stop()
+
+
+def _local_part_files(node, bucket, key):
+    out = []
+    for d in node.local_drives.values():
+        obj_dir = os.path.join(d.root, bucket, key)
+        if not os.path.isdir(obj_dir):
+            continue
+        for sub in os.listdir(obj_dir):
+            p = os.path.join(obj_dir, sub)
+            if os.path.isdir(p):
+                out.extend(os.path.join(p, f) for f in os.listdir(p))
+    return out
+
+
+class TestTiering:
+    def test_tier_crud(self, srv):
+        c = srv["client"]
+        tiers = c.request("GET", f"{ADMIN}/tiers").json()
+        assert [t["name"] for t in tiers] == ["COLD"]
+        assert all("secret_key" not in t for t in tiers)
+        # Duplicate add rejected.
+        r = c.request(
+            "POST", f"{ADMIN}/tiers",
+            body=json.dumps({"name": "COLD", "type": "fs", "dir": "/tmp/x"}).encode(),
+        )
+        assert r.status_code == 400
+
+    def test_transition_frees_local_data_and_reads_from_tier(self, srv):
+        c, node = srv["client"], srv["node"]
+        assert c.make_bucket("arch").status_code == 200
+        assert c.put_object("arch", "big.bin", BIG).status_code == 200
+        assert _local_part_files(node, "arch", "big.bin")
+
+        oi = node.tiering.transition(node.pools, "arch", "big.bin", "", "COLD")
+        assert tiering_mod.is_transitioned(oi.internal)
+        # Local shard files reclaimed; remote copy exists under the prefix.
+        assert not _local_part_files(node, "arch", "big.bin")
+        remote = [
+            os.path.join(dp, f)
+            for dp, _, fs in os.walk(srv["tier_dir"])
+            for f in fs
+        ]
+        assert len(remote) == 1 and "/x/" in remote[0]
+
+        # Transparent GET streams from the tier; HEAD shows the tier as the
+        # storage class.
+        r = c.request("GET", "/arch/big.bin")
+        assert r.status_code == 200 and r.content == BIG
+        r = c.request("HEAD", "/arch/big.bin")
+        assert r.headers["x-amz-storage-class"] == "COLD"
+
+    def test_ranged_read_on_transitioned(self, srv):
+        c = srv["client"]
+        r = c.request("GET", "/arch/big.bin", headers={"Range": "bytes=100-199"})
+        assert r.status_code == 206
+        assert r.content == BIG[100:200]
+
+    def test_heal_is_noop_on_transitioned(self, srv):
+        node = srv["node"]
+        res = node.pools.heal_object("arch", "big.bin")
+        assert res.disks_healed == 0
+
+    def test_restore_materializes_local_copy(self, srv):
+        c, node = srv["client"], srv["node"]
+        r = c.request("POST", "/arch/big.bin", query=[("restore", "")],
+                      body=b"<RestoreRequest><Days>2</Days></RestoreRequest>")
+        assert r.status_code == 202, r.text
+        r = c.request("HEAD", "/arch/big.bin")
+        assert 'ongoing-request="false"' in r.headers.get("x-amz-restore", "")
+        # Reads now come from the restored copy even if the tier vanishes.
+        backend = node.tiering.backend("COLD")
+        remote_key = node.pools.get_object_info(
+            "arch", "big.bin"
+        ).internal[tiering_mod.META_TRANSITION_NAME]
+        blob = backend.get(remote_key)
+        backend.delete(remote_key)
+        r = c.request("GET", "/arch/big.bin")
+        assert r.status_code == 200 and r.content == BIG
+        backend.put(remote_key, blob)  # put back for later tests
+        # Second restore refreshes -> 200.
+        r = c.request("POST", "/arch/big.bin", query=[("restore", "")],
+                      body=b"<RestoreRequest><Days>1</Days></RestoreRequest>")
+        assert r.status_code == 200
+
+    def test_delete_journals_remote_reclamation(self, srv):
+        c, node = srv["client"], srv["node"]
+        assert c.put_object("arch", "doomed.bin", BIG).status_code == 200
+        node.tiering.transition(node.pools, "arch", "doomed.bin", "", "COLD")
+        remote_key = node.pools.get_object_info(
+            "arch", "doomed.bin"
+        ).internal[tiering_mod.META_TRANSITION_NAME]
+        backend = node.tiering.backend("COLD")
+        assert backend.get(remote_key)  # exists remotely
+        assert c.request("DELETE", "/arch/doomed.bin").status_code == 204
+        assert node.tiering.drain_journal() == 1
+        with pytest.raises(Exception):
+            backend.get(remote_key)
+
+    def test_lifecycle_transition_via_scanner(self, srv):
+        c, node = srv["client"], srv["node"]
+        assert c.make_bucket("ilmbkt").status_code == 200
+        lc = (
+            '<LifecycleConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            "<Rule><ID>t</ID><Status>Enabled</Status><Filter><Prefix></Prefix></Filter>"
+            "<Transition><Days>0</Days><StorageClass>COLD</StorageClass></Transition>"
+            "</Rule></LifecycleConfiguration>"
+        )
+        assert c.request("PUT", "/ilmbkt", query=[("lifecycle", "")], body=lc.encode()).status_code == 200
+        assert c.put_object("ilmbkt", "aging.bin", BIG).status_code == 200
+        node.scanner.scan_cycle()
+        oi = node.pools.get_object_info("ilmbkt", "aging.bin")
+        assert tiering_mod.is_transitioned(oi.internal)
+        assert node.scanner.objects_transitioned >= 1
+        r = c.request("GET", "/ilmbkt/aging.bin")
+        assert r.status_code == 200 and r.content == BIG
+
+    def test_s3_tier_to_second_cluster(self, srv, tmp_path_factory):
+        """Tier of type "s3": cold data lands in another cluster's bucket."""
+        tmp = tmp_path_factory.mktemp("tierdst")
+        dnode = Node([str(tmp / f"d{i}") for i in range(4)], root_user=ROOT, root_password=SECRET)
+        port = _free_port()
+        dts = ThreadedServer(SimpleNamespace(app=dnode.make_app()), port=port)
+        dts.start()
+        dnode.build()
+        dc = S3TestClient(f"http://127.0.0.1:{port}", ROOT, SECRET)
+        assert dc.make_bucket("coldbkt").status_code == 200
+        try:
+            c, node = srv["client"], srv["node"]
+            r = c.request(
+                "POST",
+                f"{ADMIN}/tiers",
+                body=json.dumps(
+                    {
+                        "name": "REMOTE",
+                        "type": "s3",
+                        "endpoint": f"http://127.0.0.1:{port}",
+                        "bucket": "coldbkt",
+                        "access_key": ROOT,
+                        "secret_key": SECRET,
+                    }
+                ).encode(),
+            )
+            assert r.status_code == 200, r.text
+            assert c.put_object("arch", "tos3.bin", BIG).status_code == 200
+            node.tiering.transition(node.pools, "arch", "tos3.bin", "", "REMOTE")
+            # Bytes are in the second cluster now.
+            listing = dc.request("GET", "/coldbkt")
+            assert listing.status_code == 200
+            r = c.request("GET", "/arch/tos3.bin")
+            assert r.status_code == 200 and r.content == BIG
+        finally:
+            dts.stop()
+
+    def test_sealed_tier_secrets_at_rest(self, srv):
+        node = srv["node"]
+        raw = node.pools and node.tiering.store.get(tiering_mod.CONFIG_PATH)
+        assert raw is not None
+        doc = json.loads(raw)
+        remote = [t for t in doc if t["name"] == "REMOTE"]
+        if remote:
+            assert remote[0]["secret_key"].startswith("sealed:")
+            assert SECRET not in json.dumps(remote)
