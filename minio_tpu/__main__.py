@@ -1,0 +1,5 @@
+"""`python -m minio_tpu` entry point (reference main.go:34 -> cmd.Main)."""
+
+from .cli import main
+
+raise SystemExit(main())

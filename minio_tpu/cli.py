@@ -1,0 +1,276 @@
+"""CLI bootstrap: the `minio server`-shaped entry point.
+
+Role of the reference's main.go / cmd/main.go / server-main.go (:422) +
+endpoint-ellipses.go: parse `server` arguments with `{a...b}` ellipses
+expansion into the ordered endpoint list, pick up the env-var config surface
+(root credentials, set drive count, storage class), hard-fail boot golden
+self-tests for the erasure/bitrot kernels (erasure-coding.go:158
+erasureSelfTest, bitrot.go:214 bitrotSelfTest), assemble the node (format
+consensus + pools + control plane) and serve everything on one port until
+SIGINT/SIGTERM.
+
+Usage:
+    python -m minio_tpu server /data/disk{1...16}
+    python -m minio_tpu server --url http://10.0.0.1:9000 \
+        http://10.0.0.{1...4}:9000/mnt/disk{1...16}
+
+Env (reference names kept where the semantic matches, common-main.go
+serverHandleEnvVars):
+    MINIO_ROOT_USER / MINIO_ROOT_PASSWORD      root credentials
+    MINIO_ERASURE_SET_DRIVE_COUNT              drives per erasure set
+    MINIO_STORAGE_CLASS_STANDARD=EC:4          parity drive count
+    MINIO_REGION                               cluster region
+    MINIO_KMS_SECRET_KEY                       static KMS master key
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import itertools
+import json
+import os
+import re
+import signal
+import sys
+import time
+
+_ELLIPSIS = re.compile(r"\{(\d+)\.\.\.(\d+)\}")
+
+
+def expand_ellipses(pattern: str) -> list[str]:
+    """`{a...b}` range expansion, left-to-right cartesian for multiple ranges
+    (endpoint-ellipses.go:68 ellipses.FindEllipsesPatterns). Numeric only;
+    zero-padding follows the left bound: {01...16} -> 01, 02, ... 16."""
+    matches = list(_ELLIPSIS.finditer(pattern))
+    if not matches:
+        # Unmatched braces are almost always a typo'd ellipsis ({1..4},
+        # {a...d}); booting them as literal paths would silently format a
+        # single mis-named drive.
+        if "{" in pattern or "}" in pattern:
+            raise ValueError(
+                f"unrecognized ellipsis pattern in {pattern!r} (expected {{N...M}})"
+            )
+        return [pattern]
+    ranges = []
+    for m in matches:
+        lo_s, hi_s = m.group(1), m.group(2)
+        lo, hi = int(lo_s), int(hi_s)
+        if hi < lo:
+            raise ValueError(f"bad ellipsis range {m.group(0)}")
+        width = len(lo_s) if lo_s.startswith("0") else 0
+        ranges.append([str(v).zfill(width) for v in range(lo, hi + 1)])
+    out = []
+    for combo in itertools.product(*ranges):
+        s, last = [], 0
+        for m, val in zip(matches, combo):
+            s.append(pattern[last:m.start()])
+            s.append(val)
+            last = m.end()
+        s.append(pattern[last:])
+        out.append("".join(s))
+    return out
+
+
+def expand_endpoints(args: list[str]) -> list[str]:
+    out: list[str] = []
+    for a in args:
+        out.extend(expand_ellipses(a))
+    if len(set(out)) != len(out):
+        raise ValueError("duplicate endpoints after ellipses expansion")
+    return out
+
+
+# Golden values pinned against the reference's algorithms (the kernels
+# themselves are golden-tested against klauspost/reedsolomon and
+# minio/highwayhash vectors in tests/test_rs.py / test_highwayhash.py;
+# these constants re-check them at every boot like erasureSelfTest).
+_HH_GOLDEN = "8c8b584226c40f7286e247d70d013bba9a4b56a4be68efb96b0901a1842c2694"
+_RS_GOLDEN = "5eb38c9b16bee39ec05c816f29fe90b808066f98292dfc0b72f313b2187fa69f"
+
+
+def boot_self_test() -> None:
+    """Hard-fail kernel self-tests (erasure-coding.go:158, bitrot.go:214)."""
+    import numpy as np
+
+    from .ops import rs_ref
+    from .ops.highwayhash import hash256
+
+    if hash256(bytes(range(64))).hex() != _HH_GOLDEN:
+        raise SystemExit("FATAL: HighwayHash-256 self-test failed")
+    data = np.frombuffer(bytes(range(256)), dtype=np.uint8).reshape(4, 64)
+    enc = rs_ref.encode(data.copy(), parity=2)
+    if hashlib.sha256(enc.tobytes()).hexdigest() != _RS_GOLDEN:
+        raise SystemExit("FATAL: Reed-Solomon self-test failed")
+    # Reconstruct round-trip with two shards lost.
+    shards: list = [enc[i].copy() for i in range(6)]
+    shards[1] = None
+    shards[4] = None
+    rec = rs_ref.reconstruct(shards, k=4, parity=2)
+    if not np.array_equal(np.stack(rec), enc):
+        raise SystemExit("FATAL: Reed-Solomon reconstruct self-test failed")
+
+
+def _log(quiet: bool, as_json: bool, **fields) -> None:
+    if quiet:
+        return
+    if as_json:
+        print(json.dumps(fields), flush=True)
+    else:
+        print(" ".join(f"{k}={v}" for k, v in fields.items()), flush=True)
+
+
+def serve(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="minio_tpu server")
+    p.add_argument("endpoints", nargs="+", help="drive paths/URLs, {a...b} ellipses supported")
+    p.add_argument("--address", default=":9000", help="listen address [HOST]:PORT")
+    p.add_argument("--url", default="", help="this node's advertised URL (multi-node)")
+    p.add_argument("--set-drive-count", type=int, default=0)
+    p.add_argument("--parity", type=int, default=-1)
+    p.add_argument("--region", default="")
+    p.add_argument("--quiet", action="store_true")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--no-selftest", action="store_true", help=argparse.SUPPRESS)
+    a = p.parse_args(argv)
+
+    root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
+    root_password = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
+    set_count = a.set_drive_count or int(os.environ.get("MINIO_ERASURE_SET_DRIVE_COUNT", "0"))
+    region = a.region or os.environ.get("MINIO_REGION", "us-east-1")
+    parity = a.parity if a.parity >= 0 else None
+    if parity is None:
+        sc = os.environ.get("MINIO_STORAGE_CLASS_STANDARD", "")
+        if sc.startswith("EC:"):
+            parity = int(sc[3:])
+
+    if not a.no_selftest:
+        t0 = time.perf_counter()
+        boot_self_test()
+        _log(a.quiet, a.json, msg="self-tests passed", seconds=round(time.perf_counter() - t0, 3))
+
+    try:
+        endpoints = expand_endpoints(a.endpoints)
+    except ValueError as e:
+        p.error(str(e))
+    _log(a.quiet, a.json, msg="endpoints", count=len(endpoints))
+
+    host, _, port_s = a.address.rpartition(":")
+    host = host or "0.0.0.0"
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]  # bracketed IPv6 -> bare address for bind()
+    try:
+        port = int(port_s)
+    except ValueError:
+        p.error(f"--address must be [HOST]:PORT, got {a.address!r}")
+
+    from aiohttp import web
+
+    from .dist.node import Node
+
+    node = Node(
+        endpoints,
+        url=a.url,
+        root_user=root_user,
+        root_password=root_password,
+        set_drive_count=set_count or None,
+        parity=parity,
+        region=region,
+    )
+    app = node.make_app()
+
+    # Serve BEFORE build: peers need this node's storage REST up to reach
+    # format quorum (server-main.go:495-521 starts dist routers first).
+    import threading
+
+    runner_ready = threading.Event()
+    stop_evt = threading.Event()
+    thread_error: list[BaseException] = []
+
+    def _run_app():
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        try:
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, host, port)
+            loop.run_until_complete(site.start())
+        except BaseException as e:  # noqa: BLE001 - surfaced to the main thread
+            thread_error.append(e)
+            runner_ready.set()
+            loop.close()
+            return
+        runner_ready.set()
+
+        async def _wait():
+            while not stop_evt.is_set():
+                await asyncio.sleep(0.2)
+
+        loop.run_until_complete(_wait())
+        loop.run_until_complete(runner.cleanup())
+        loop.close()
+
+    t = threading.Thread(target=_run_app, daemon=True, name="http-server")
+    t.start()
+    if not runner_ready.wait(10) or thread_error:
+        cause = f": {thread_error[0]}" if thread_error else ""
+        print(f"FATAL: HTTP server failed to start{cause}", file=sys.stderr)
+        return 1
+    _log(a.quiet, a.json, msg="listening", address=f"{host}:{port}")
+
+    # Signal handlers BEFORE the (possibly long) format-quorum wait, so
+    # Ctrl-C / SIGTERM during a multi-node bootstrap still shuts down
+    # cleanly instead of killing the HTTP thread mid-handshake.
+    def _shutdown(signum, frame):
+        _log(a.quiet, a.json, msg="shutting down", signal=signum)
+        stop_evt.set()
+
+    signal.signal(signal.SIGINT, _shutdown)
+    signal.signal(signal.SIGTERM, _shutdown)
+
+    try:
+        node.build()
+    except Exception as e:  # noqa: BLE001
+        print(f"FATAL: node bootstrap failed: {e}", file=sys.stderr)
+        stop_evt.set()
+        t.join(5)
+        return 1
+    if stop_evt.is_set():  # signalled during bootstrap
+        t.join(5)
+        return 0
+    n_sets = len(node.pools.pools[0].sets)
+    _log(
+        a.quiet,
+        a.json,
+        msg="online",
+        drives=len(node.drives),
+        sets=n_sets,
+        set_drive_count=node.set_drive_count,
+        s3=f"http://{host}:{port}",
+        admin=f"http://{host}:{port}/mtpu/admin/v1",
+    )
+    node.scanner.start()
+    while not stop_evt.is_set():
+        time.sleep(0.2)
+    node.scanner.stop()
+    if getattr(node, "replication", None) is not None:
+        node.replication.close()
+    t.join(5)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    cmd, rest = argv[0], argv[1:]
+    if cmd == "server":
+        return serve(rest)
+    print(f"unknown command {cmd!r}; supported: server", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

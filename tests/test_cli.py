@@ -1,0 +1,117 @@
+"""CLI bootstrap tests: ellipses expansion, boot self-tests, and a real
+`python -m minio_tpu server` subprocess serving S3.
+
+The analogue of the reference's endpoint-ellipses_test.go set math tests and
+buildscripts/verify-build.sh (boot a real server process and run functional
+requests against it).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from minio_tpu.cli import boot_self_test, expand_ellipses, expand_endpoints
+from tests.s3client import S3TestClient
+from tests.test_dist import _free_port
+
+
+class TestEllipses:
+    def test_no_pattern(self):
+        assert expand_ellipses("/data/disk1") == ["/data/disk1"]
+
+    def test_simple_range(self):
+        assert expand_ellipses("/data/disk{1...4}") == [
+            "/data/disk1",
+            "/data/disk2",
+            "/data/disk3",
+            "/data/disk4",
+        ]
+
+    def test_zero_padded(self):
+        out = expand_ellipses("/d{01...12}")
+        assert out[0] == "/d01" and out[-1] == "/d12" and len(out) == 12
+
+    def test_cartesian_host_times_disk(self):
+        out = expand_ellipses("http://node{1...2}:9000/disk{1...3}")
+        assert len(out) == 6
+        assert out[0] == "http://node1:9000/disk1"
+        assert out[-1] == "http://node2:9000/disk3"
+        # Host-major order, like the reference's argument expansion.
+        assert out[3] == "http://node2:9000/disk1"
+
+    def test_bad_range(self):
+        with pytest.raises(ValueError):
+            expand_ellipses("/d{4...1}")
+
+    def test_duplicate_rejected(self):
+        with pytest.raises(ValueError):
+            expand_endpoints(["/d{1...2}", "/d1"])
+
+    def test_typoed_ellipsis_rejected(self):
+        with pytest.raises(ValueError):
+            expand_ellipses("/data/disk{1..4}")  # two dots
+        with pytest.raises(ValueError):
+            expand_ellipses("/data/disk{a...d}")  # non-numeric
+
+
+def test_boot_self_test_passes():
+    boot_self_test()  # raises SystemExit on kernel regression
+
+
+def test_server_subprocess(tmp_path):
+    """Full black-box boot: subprocess serves S3 until SIGTERM."""
+    port = _free_port()
+    env = dict(
+        os.environ,
+        MINIO_ROOT_USER="cliroot01",
+        MINIO_ROOT_PASSWORD="cli-secret-key1",
+        MINIO_STORAGE_CLASS_STANDARD="EC:1",
+    )
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-m",
+            "minio_tpu",
+            "server",
+            "--address",
+            f"127.0.0.1:{port}",
+            "--json",
+            str(tmp_path) + "/disk{1...4}",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    try:
+        client = S3TestClient(f"http://127.0.0.1:{port}", "cliroot01", "cli-secret-key1")
+        deadline = time.monotonic() + 60
+        up = False
+        while time.monotonic() < deadline:
+            try:
+                if client.request("GET", "/").status_code == 200:
+                    up = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert up, "server did not come up"
+        assert client.make_bucket("clibkt").status_code == 200
+        assert client.put_object("clibkt", "hello", b"from the CLI").status_code == 200
+        r = client.request("GET", "/clibkt/hello")
+        assert r.status_code == 200 and r.content == b"from the CLI"
+        # Four drives formatted on disk.
+        assert all(
+            os.path.isfile(tmp_path / f"disk{i}" / ".minio_tpu.sys" / "format.json")
+            for i in range(1, 5)
+        )
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
