@@ -254,6 +254,10 @@ def serve(argv: list[str]) -> int:
     while not stop_evt.is_set():
         time.sleep(0.2)
     node.scanner.stop()
+    if getattr(node, "disk_heal", None) is not None:
+        node.disk_heal.stop()
+    if getattr(node, "mrf", None) is not None:
+        node.mrf.stop()
     if getattr(node, "replication", None) is not None:
         node.replication.close()
     t.join(5)

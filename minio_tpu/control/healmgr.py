@@ -12,13 +12,17 @@ Role of the reference's heal trio (SURVEY.md section 2.7 Healing):
 
 from __future__ import annotations
 
+import json
 import queue
 import threading
 import time
 import uuid
-from dataclasses import dataclass, field
+from dataclasses import asdict, dataclass, field
 
+from ..storage.format import SYS_DIR
 from ..utils import errors
+
+HEALING_FILE = "healing.bin"
 
 
 @dataclass
@@ -141,3 +145,226 @@ class HealManager:
                     elif not d.is_online() or not d.disk_id():
                         bad.append(d.endpoint())
         return bad
+
+
+@dataclass
+class HealingTracker:
+    """Per-drive heal progress persisted on the drive itself, so a heal of a
+    fresh/replaced drive resumes after a restart (the reference's
+    healingTracker written to `.healing.bin`,
+    cmd/background-newdisks-heal-ops.go:48)."""
+
+    disk_id: str = ""
+    endpoint: str = ""
+    started: float = 0.0
+    last_update: float = 0.0
+    finished: bool = False
+    objects_scanned: int = 0
+    objects_healed: int = 0
+    objects_failed: int = 0
+    # Resume cursor: the heal walks buckets and objects in sorted order and
+    # skips everything <= (resume_bucket, resume_object) on restart.
+    resume_bucket: str = ""
+    resume_object: str = ""
+
+    def save(self, disk) -> None:
+        self.last_update = time.time()
+        disk.write_all(SYS_DIR, HEALING_FILE, json.dumps(asdict(self)).encode())
+
+    @staticmethod
+    def load(disk) -> "HealingTracker | None":
+        try:
+            raw = disk.read_all(SYS_DIR, HEALING_FILE)
+        except errors.StorageError:
+            return None
+        try:
+            return HealingTracker(**json.loads(raw.decode()))
+        except (ValueError, TypeError):
+            # Unparseable tracker (e.g. written by another build): the file's
+            # presence means a heal is owed — restart it from scratch rather
+            # than silently abandoning the drive.
+            return HealingTracker(endpoint=disk.endpoint(), started=time.time())
+
+    @staticmethod
+    def remove(disk) -> None:
+        try:
+            disk.delete(SYS_DIR, HEALING_FILE)
+        except errors.StorageError:
+            pass
+
+
+def mark_drive_for_healing(disk, disk_id: str = "") -> HealingTracker:
+    """Drop a fresh healing tracker on a drive that was just (re)formatted;
+    the DiskHealMonitor picks it up (initHealingTracker equivalent)."""
+    tr = HealingTracker(
+        disk_id=disk_id or disk.disk_id(),
+        endpoint=disk.endpoint(),
+        started=time.time(),
+    )
+    tr.save(disk)
+    return tr
+
+
+class DiskHealMonitor:
+    """Background loop that heals freshly-replaced drives marked with a
+    HealingTracker (monitorLocalDisksAndHeal,
+    cmd/background-newdisks-heal-ops.go:314).
+
+    Walks the drive's erasure set in sorted (bucket, object) order, healing
+    every version onto the new drive, checkpointing the cursor into the
+    tracker every `checkpoint_every` objects."""
+
+    def __init__(self, layer, interval: float = 10.0, checkpoint_every: int = 64,
+                 start: bool = True):
+        self.layer = layer
+        self.interval = interval
+        self.checkpoint_every = checkpoint_every
+        self.completed: list[HealingTracker] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="disk-heal-monitor"
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 - monitor must survive anything
+                pass
+            self._stop.wait(self.interval)
+
+    def tick(self) -> int:
+        """One monitor pass; returns number of drives healed to completion."""
+        done = 0
+        for pool in self.layer.pools:
+            for s in pool.sets:
+                for d in s.disks:
+                    # Local drives only: every node runs a monitor, and each
+                    # must sweep only drives it owns or N nodes would race on
+                    # the same tracker (monitorLocalDisksAndHeal is local-only
+                    # in the reference too).
+                    if d is None or not d.is_online() or not d.is_local():
+                        continue
+                    tr = HealingTracker.load(d)
+                    if tr is None:
+                        continue
+                    if tr.finished:
+                        # Completed sweep whose remove() failed earlier.
+                        HealingTracker.remove(d)
+                        continue
+                    self._heal_drive(s, d, tr)
+                    if tr.finished:
+                        done += 1
+        return done
+
+    # -- the per-drive heal sweep -------------------------------------------
+
+    def _iter_set_versions(self, eo, disk, bucket: str):
+        """Stream (name, union-of-version-ids) in sorted name order by k-way
+        merging the per-drive sorted walks of every online peer — O(drives)
+        memory, not O(namespace). The union across peers matters: a
+        stale-but-online peer may be missing exactly the versions the fresh
+        drive needs healed."""
+        import heapq
+
+        from ..storage.xlmeta import XLMeta
+
+        def drive_walk(d):
+            try:
+                yield from d.walk_dir(bucket)
+            except errors.StorageError:
+                return
+
+        walks = [
+            drive_walk(d)
+            for d in eo.disks
+            if d is not None and d.is_online() and d is not disk
+        ]
+        current: str | None = None
+        vids: set[str] = set()
+        for name, raw in heapq.merge(*walks, key=lambda t: t[0]):
+            if name != current:
+                if current is not None:
+                    yield current, vids
+                current, vids = name, set()
+            try:
+                vids.update(v.version_id for v in XLMeta.from_bytes(raw).versions)
+            except (errors.StorageError, ValueError):
+                vids.add("")
+        if current is not None:
+            yield current, vids
+
+    def _heal_drive(self, eo, disk, tracker: HealingTracker) -> None:
+        try:
+            buckets = sorted(v.name for v in disk_buckets(eo))
+        except errors.StorageError:
+            return
+        # System bucket first: config/IAM/bucket-metadata shards must be
+        # re-protected before anything else (the reference heals .minio.sys
+        # first, cmd/background-newdisks-heal-ops.go).
+        from ..object.erasure import META_BUCKET
+
+        buckets = [META_BUCKET] + buckets
+        since_checkpoint = 0
+        for bucket in buckets:
+            if tracker.resume_bucket and bucket < tracker.resume_bucket and bucket != META_BUCKET:
+                continue
+            try:
+                disk.make_vol(bucket)
+            except errors.StorageError:
+                pass
+            for name, version_ids in self._iter_set_versions(eo, disk, bucket):
+                if (
+                    bucket == tracker.resume_bucket
+                    and tracker.resume_object
+                    and name <= tracker.resume_object
+                ):
+                    continue
+                tracker.objects_scanned += 1
+                healed_any = failed_any = False
+                for vid in sorted(version_ids) or [""]:
+                    try:
+                        res = eo.heal_object(bucket, name, vid)
+                        healed_any = healed_any or res.disks_healed > 0
+                    except errors.StorageError:
+                        failed_any = True
+                if healed_any:
+                    tracker.objects_healed += 1
+                if failed_any:
+                    tracker.objects_failed += 1
+                tracker.resume_bucket, tracker.resume_object = bucket, name
+                since_checkpoint += 1
+                if since_checkpoint >= self.checkpoint_every:
+                    since_checkpoint = 0
+                    try:
+                        tracker.save(disk)
+                    except errors.StorageError:
+                        return  # drive vanished mid-heal; resume next tick
+        tracker.finished = True
+        try:
+            tracker.save(disk)  # persist completion even if remove() fails
+        except errors.StorageError:
+            pass
+        self.completed.append(tracker)
+        HealingTracker.remove(disk)
+
+
+def disk_buckets(eo) -> list:
+    """Bucket volumes visible in an erasure set (excluding the sys volume)."""
+    vols: dict[str, object] = {}
+    for d in eo.disks:
+        if d is None or not d.is_online():
+            continue
+        try:
+            for v in d.list_vols():
+                if not v.name.startswith("."):
+                    vols.setdefault(v.name, v)
+        except errors.StorageError:
+            continue
+    return list(vols.values())

