@@ -39,6 +39,7 @@ class AdminContext:
     notification: object | None = None  # peer fan-out
     replication: object | None = None  # ReplicationSys (bucket-replication.go)
     tiering: object | None = None  # TierConfigMgr (tier.go)
+    site_repl: object | None = None  # SiteReplicationSys (site-replication.go)
 
 
 def make_admin_app(ctx: AdminContext) -> web.Application:
@@ -146,25 +147,35 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             for ak, u in ctx.iam.list_users().items()
         }
 
+    def _site_iam(kind, payload):
+        if ctx.site_repl is not None and getattr(ctx.site_repl, "enabled", False):
+            ctx.site_repl.on_iam(kind, payload)
+
     def h_add_user(request, body):
         doc = json.loads(body)
         ctx.iam.add_user(doc["accessKey"], doc["secretKey"], doc.get("policies", []))
         if ctx.notification is not None:
             ctx.notification.reload_iam_all()
+        _site_iam("user", ctx.iam.users[doc["accessKey"]].to_dict())
         return {"ok": True}
 
     def h_remove_user(request, body):
         ctx.iam.remove_user(request.match_info["ak"])
+        _site_iam("user-delete", {"access_key": request.match_info["ak"]})
         return {"ok": True}
 
     def h_user_status(request, body):
         doc = json.loads(body)
-        ctx.iam.set_user_status(request.match_info["ak"], doc["status"])
+        ak = request.match_info["ak"]
+        ctx.iam.set_user_status(ak, doc["status"])
+        if ak in ctx.iam.users:
+            _site_iam("user", ctx.iam.users[ak].to_dict())
         return {"ok": True}
 
     def h_user_policy(request, body):
         doc = json.loads(body)
         ctx.iam.attach_policy(request.match_info["ak"], doc["policies"])
+        _site_iam("policy-mapping", {"access_key": request.match_info["ak"], "policies": doc["policies"]})
         return {"ok": True}
 
     def h_list_policies(request, body):
@@ -176,17 +187,22 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         return out
 
     def h_put_policy(request, body):
-        ctx.iam.set_policy(request.match_info["name"], json.loads(body))
+        doc = json.loads(body)
+        ctx.iam.set_policy(request.match_info["name"], doc)
+        _site_iam("policy", {"name": request.match_info["name"], "doc": doc})
         return {"ok": True}
 
     def h_delete_policy(request, body):
         ctx.iam.delete_policy(request.match_info["name"])
+        _site_iam("policy-delete", {"name": request.match_info["name"]})
         return {"ok": True}
 
     def h_service_account(request, body):
         doc = json.loads(body) if body else {}
         parent = doc.get("parent") or ctx.iam.root.access_key
         creds = ctx.iam.new_service_account(parent, doc.get("policy"))
+        if creds.access_key in ctx.iam.users:
+            _site_iam("user", ctx.iam.users[creds.access_key].to_dict())
         return {"accessKey": creds.access_key, "secretKey": creds.secret_key}
 
     # -- heal ----------------------------------------------------------------
@@ -391,6 +407,45 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             "journalBacklog": ctx.tiering.journal_backlog(),
         }
 
+    # -- site replication (site-replication.go SRPeer* + operator APIs) ------
+
+    def _sr(ensure: bool = True):
+        if ctx.site_repl is None:
+            raise S3Error("NotImplemented")
+        return ctx.site_repl
+
+    def h_sr_add(request, body):
+        doc = json.loads(body)
+        return _sr().add_peer_clusters(doc["sites"])
+
+    def h_sr_info(request, body):
+        return _sr().info()
+
+    def h_sr_peer_join(request, body):
+        doc = json.loads(body)
+        _sr().apply_join(doc["self_name"], doc["sites"])
+        return {"ok": True}
+
+    def h_sr_peer_bucket(request, body):
+        doc = json.loads(body)
+        _sr().apply_bucket(doc["op"], doc["bucket"])
+        return {"ok": True}
+
+    def h_sr_peer_meta(request, body):
+        doc = json.loads(body)
+        _sr().apply_meta(doc["bucket"], doc["meta"])
+        return {"ok": True}
+
+    def h_sr_peer_iam(request, body):
+        doc = json.loads(body)
+        _sr().apply_iam(doc["kind"], doc["payload"])
+        return {"ok": True}
+
+    def h_sr_peer_install_repl(request, body):
+        doc = json.loads(body)
+        _sr().apply_install_replication(doc["bucket"])
+        return {"ok": True}
+
     # -- trace streaming (admin-handlers.go:1103 role) -----------------------
 
     async def h_trace(request: web.Request, body):
@@ -415,6 +470,13 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             ctx.trace.unsubscribe(sub)
         return resp
 
+    app.router.add_post("/site-replication/add", handler(h_sr_add))
+    app.router.add_get("/site-replication/info", handler(h_sr_info))
+    app.router.add_post("/site-replication/peer/join", handler(h_sr_peer_join))
+    app.router.add_post("/site-replication/peer/bucket", handler(h_sr_peer_bucket))
+    app.router.add_post("/site-replication/peer/meta", handler(h_sr_peer_meta))
+    app.router.add_post("/site-replication/peer/iam", handler(h_sr_peer_iam))
+    app.router.add_post("/site-replication/peer/install-replication", handler(h_sr_peer_install_repl))
     app.router.add_get("/info", handler(h_info))
     app.router.add_get("/datausage", handler(h_datausage))
     app.router.add_get("/config", handler(h_get_config))

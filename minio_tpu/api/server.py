@@ -105,6 +105,7 @@ class S3Server:
         self.notifier = None
         self.logger = None
         self.replication = None  # ReplicationSys (bucket-replication.go role)
+        self.site_repl = None  # SiteReplicationSys (site-replication.go role)
         self.tiering = None  # TierConfigMgr (tier.go / bucket-lifecycle.go role)
 
     # -- plumbing -------------------------------------------------------------
@@ -449,12 +450,22 @@ class S3Server:
                 "</ObjectLockConfiguration>"
             )
         self.bucket_meta.save(meta)
+        if self.site_repl is not None and self.site_repl.enabled:
+            self.site_repl.on_bucket_make(bucket)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
     def _delete_bucket(self, bucket: str) -> web.Response:
         self.layer.delete_bucket(bucket)
         self.bucket_meta.delete(bucket)
+        if self.site_repl is not None and self.site_repl.enabled:
+            self.site_repl.on_bucket_delete(bucket)
         return web.Response(status=204)
+
+    def _site_meta_sync(self, bucket: str) -> None:
+        """Fan a bucket-metadata change out to peer sites (the reference
+        calls the SRPeer meta RPC from every bucket-meta mutation)."""
+        if self.site_repl is not None and self.site_repl.enabled:
+            self.site_repl.on_bucket_meta(bucket)
 
     def _put_versioning(self, bucket: str, body: bytes) -> web.Response:
         self.layer.get_bucket_info(bucket)
@@ -470,7 +481,19 @@ class S3Server:
                 "InvalidBucketState",
                 "versioning cannot be suspended on an object-lock enabled bucket",
             )
+        if (
+            status == "Suspended"
+            and self.site_repl is not None
+            and self.site_repl.enabled
+        ):
+            # Site replication requires versioned buckets everywhere (the
+            # reference rejects suspension on site-replicated buckets too).
+            raise S3Error(
+                "InvalidBucketState",
+                "versioning cannot be suspended on a site-replicated bucket",
+            )
         self.bucket_meta.update(bucket, versioning=status)
+        self._site_meta_sync(bucket)
         return web.Response(status=200)
 
     def _get_versioning(self, bucket: str) -> web.Response:
@@ -486,6 +509,7 @@ class S3Server:
         except Exception:
             raise S3Error("MalformedXML", "Policy is not valid JSON")
         self.bucket_meta.update(bucket, policy_json=body.decode())
+        self._site_meta_sync(bucket)
         return web.Response(status=204)
 
     def _get_policy(self, bucket: str) -> web.Response:
@@ -498,6 +522,7 @@ class S3Server:
     def _delete_policy(self, bucket: str) -> web.Response:
         self.layer.get_bucket_info(bucket)
         self.bucket_meta.update(bucket, policy_json="")
+        self._site_meta_sync(bucket)
         return web.Response(status=204)
 
     def _put_bucket_tagging(self, bucket: str, body: bytes) -> web.Response:
@@ -514,6 +539,7 @@ class S3Server:
             except ET.ParseError:
                 raise S3Error("MalformedXML")
         self.bucket_meta.update(bucket, tagging=tags)
+        self._site_meta_sync(bucket)
         return web.Response(status=200 if body else 204)
 
     def _get_bucket_tagging(self, bucket: str) -> web.Response:
@@ -537,6 +563,10 @@ class S3Server:
         self.bucket_meta.update(bucket, **{field: body.decode() if body else ""})
         if field == "notification_xml" and self.notifier is not None:
             self.notifier.set_bucket_rules_from_xml(bucket, body)
+        if field != "replication_xml":
+            # replication config is per-site (it points at this site's
+            # peers); everything else mirrors across sites.
+            self._site_meta_sync(bucket)
         return web.Response(status=200 if body else 204)
 
     def _get_bucket_config(self, bucket: str, field: str, missing_code: str) -> web.Response:
@@ -1214,6 +1244,7 @@ class S3Server:
                 "object lock requires bucket versioning to be enabled",
             )
         self.bucket_meta.update(bucket, object_lock_xml=body.decode("utf-8", "replace"))
+        self._site_meta_sync(bucket)
         return web.Response(status=200)
 
     @staticmethod

@@ -260,6 +260,8 @@ def serve(argv: list[str]) -> int:
         node.mrf.stop()
     if getattr(node, "replication", None) is not None:
         node.replication.close()
+    if getattr(node, "site_repl", None) is not None:
+        node.site_repl.close()
     t.join(5)
     return 0
 

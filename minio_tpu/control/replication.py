@@ -283,6 +283,10 @@ class ReplTask:
     op: str = "put"  # put | delete
     delete_marker: bool = False
     attempts: int = 0
+    # True for resync-enqueued tasks: only destinations whose rule enables
+    # ExistingObjectReplication receive them (per-target gating, matching
+    # the reference's existing-object semantics).
+    existing: bool = False
 
 
 class ReplStats:
@@ -350,6 +354,18 @@ class ReplicationSys:
                 return r
         return None
 
+    def match_all(self, bucket: str, object_name: str) -> list[ReplicationRule]:
+        """All matching rules, one per destination ARN (multi-destination
+        replication — the reference fans one object out to every configured
+        target; site replication relies on this for >2 sites)."""
+        out: list[ReplicationRule] = []
+        seen: set[str] = set()
+        for r in self.rules(bucket):
+            if r.matches(object_name) and r.dest_arn not in seen:
+                seen.add(r.dest_arn)
+                out.append(r)
+        return out
+
     # -- write-path hooks ------------------------------------------------------
 
     def mark_pending(self, bucket: str, object_name: str, user_defined: dict) -> bool:
@@ -368,18 +384,16 @@ class ReplicationSys:
         self._enqueue(ReplTask(bucket, oi.name, oi.version_id, "put"))
 
     def on_delete(self, bucket: str, oi) -> None:
-        rule = self.match(bucket, oi.name)
-        if rule is None:
-            return
+        rules = self.match_all(bucket, oi.name)
         if oi.delete_marker:
             # Marker creation on the source -> marker creation on the target.
-            if not rule.delete_marker_replication:
+            if not any(r.delete_marker_replication for r in rules):
                 return
         else:
             # Permanent delete of a specific version: only DeleteReplication
             # authorizes it, and the target delete must be versioned too —
             # an unversioned DELETE would hide the target's live object.
-            if not rule.delete_replication:
+            if not any(r.delete_replication for r in rules):
                 return
         self._enqueue(
             ReplTask(bucket, oi.name, oi.version_id, "delete", delete_marker=oi.delete_marker)
@@ -403,9 +417,9 @@ class ReplicationSys:
         while True:
             listing = self.layer.list_objects(bucket, marker=marker, max_keys=1000)
             for o in listing.objects:
-                rule = self.match(bucket, o.name)
-                if rule is not None and rule.existing_object_replication:
-                    self._enqueue(ReplTask(bucket, o.name, o.version_id, "put"))
+                rules = self.match_all(bucket, o.name)
+                if any(r.existing_object_replication for r in rules):
+                    self._enqueue(ReplTask(bucket, o.name, o.version_id, "put", existing=True))
                     n += 1
             if not listing.is_truncated:
                 return n
@@ -494,14 +508,37 @@ class ReplicationSys:
         return oi, data
 
     def _replicate(self, task: ReplTask) -> bool:
-        rule = self.match(task.bucket, task.object_name)
-        if rule is None:
+        rules = self.match_all(task.bucket, task.object_name)
+        if not rules:
             return True  # config removed; nothing to do
+        ok_all = True
+        attempted_put = False
+        for rule in rules:
+            if task.existing and not rule.existing_object_replication:
+                continue  # resync task; this destination excluded existing objects
+            if task.op == "put":
+                attempted_put = True
+            if not self._replicate_to(task, rule):
+                ok_all = False
+        if attempted_put:
+            # One status per object version (the reference keeps per-ARN
+            # statuses; here FAILED wins so monitoring never reports a
+            # replica that a destination is still missing).
+            self._set_status(task, COMPLETED if ok_all else FAILED)
+        return ok_all
+
+    def _replicate_to(self, task: ReplTask, rule: ReplicationRule) -> bool:
         client = self.targets.client(task.bucket, rule.dest_arn)
         if client is None:
             return False
 
         if task.op == "delete":
+            # Per-target gating: each rule independently authorizes marker /
+            # version-delete replication to its destination.
+            if task.delete_marker and not rule.delete_marker_replication:
+                return True
+            if not task.delete_marker and not rule.delete_replication:
+                return True
             # Marker creation -> unversioned DELETE on the target (creates its
             # own marker); version delete -> versioned DELETE of the replica
             # version (version ids are preserved across clusters).
@@ -519,8 +556,7 @@ class ReplicationSys:
         if oi.delete_marker:
             return True
         if data is None:  # SSE-C: not replicable
-            self._set_status(task, FAILED)
-            return True
+            return False
         headers = {
             "content-type": oi.content_type or "application/octet-stream",
             HDR_SOURCE_REPL: "true",
@@ -546,7 +582,6 @@ class ReplicationSys:
             headers["x-amz-tagging"] = raw_tags
         r = client.put_object(task.object_name, data, headers)
         ok = r.status_code == 200
-        self._set_status(task, COMPLETED if ok else FAILED)
         if ok:
             self.stats.add(replicated_bytes=len(data))
         return ok

@@ -252,6 +252,19 @@ class Node:
             kms=self.kms,
         )
         self.s3.replication = self.replication
+        from ..control.site_replication import SiteReplicationSys
+
+        self.site_repl = SiteReplicationSys(
+            self.pools,
+            self.s3.bucket_meta,
+            self.iam,
+            self.replication.targets,
+            self.replication,
+            store,
+            self_endpoint=self.url,
+            notifier=self.notifier,
+        )
+        self.s3.site_repl = self.site_repl
         return self
 
     def make_app(self) -> web.Application:
@@ -336,6 +349,10 @@ class _LazyAdminContext:
     @property
     def tiering(self):
         return getattr(self._node, "tiering", None)
+
+    @property
+    def site_repl(self):
+        return getattr(self._node, "site_repl", None)
 
 
 def _default_set_count(n: int) -> int:

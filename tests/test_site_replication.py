@@ -1,0 +1,287 @@
+"""Site replication: two in-process clusters joined into one federation.
+
+The analogue of the reference's site-replication flow (cmd/site-replication.go
+AddPeerClusters :256 + SRPeer* admin RPCs): after the join, bucket
+create/delete, bucket metadata, IAM items, and object data all mirror across
+sites, with data riding the bucket-replication engine in both directions.
+"""
+
+import json
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from minio_tpu.api.server import ThreadedServer
+from minio_tpu.dist.node import Node
+from tests.s3client import S3TestClient
+from tests.test_dist import _free_port
+
+ROOT = "siteroot"
+SECRET = "site-secret-key"
+ADMIN = "/mtpu/admin/v1"
+
+
+def _boot(tmp, name):
+    port = _free_port()
+    url = f"http://127.0.0.1:{port}"
+    endpoints = [str(tmp / name / f"d{i}") for i in range(4)]
+    node = Node(endpoints, url=url, root_user=ROOT, root_password=SECRET)
+    ts = ThreadedServer(SimpleNamespace(app=node.make_app()), port=port)
+    ts.start()
+    node.build()
+    return {"node": node, "ts": ts, "url": url, "client": S3TestClient(url, ROOT, SECRET)}
+
+
+@pytest.fixture(scope="module")
+def sites(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("siterepl")
+    a = _boot(tmp, "a")
+    b = _boot(tmp, "b")
+    # Pre-existing state on A that the join must seed to B.
+    a["client"].make_bucket("preexisting")
+    a["client"].put_object("preexisting", "seed.txt", b"seeded before join")
+    r = a["client"].request(
+        "POST",
+        f"{ADMIN}/site-replication/add",
+        body=json.dumps(
+            {
+                "sites": [
+                    {"name": "site-a", "endpoint": a["url"], "access_key": ROOT, "secret_key": SECRET},
+                    {"name": "site-b", "endpoint": b["url"], "access_key": ROOT, "secret_key": SECRET},
+                ]
+            }
+        ).encode(),
+    )
+    assert r.status_code == 200, r.text
+    yield a, b
+    a["ts"].stop()
+    b["ts"].stop()
+
+
+def _drain(site):
+    assert site["node"].replication.drain(timeout=15.0)
+
+
+def test_join_status(sites):
+    a, b = sites
+    for side, me in ((a, "site-a"), (b, "site-b")):
+        r = side["client"].request("GET", f"{ADMIN}/site-replication/info")
+        assert r.status_code == 200
+        info = r.json()
+        assert info["enabled"] is True
+        assert info["name"] == me
+        assert {s["name"] for s in info["sites"]} == {"site-a", "site-b"}
+        peers = [s for s in info["sites"] if not s["self"]]
+        assert all(p["online"] for p in peers)
+
+
+def test_preexisting_bucket_seeded(sites):
+    a, b = sites
+    assert b["client"].request("HEAD", "/preexisting").status_code == 200
+    _drain(a)
+    r = b["client"].get_object("preexisting", "seed.txt")
+    assert r.status_code == 200 and r.content == b"seeded before join"
+
+
+def test_new_bucket_mirrors(sites):
+    a, b = sites
+    a["client"].make_bucket("made-on-a")
+    assert b["client"].request("HEAD", "/made-on-a").status_code == 200
+    # Versioning auto-enabled on both sides (site replication invariant).
+    for side in (a, b):
+        r = side["client"].request("GET", "/made-on-a", query=[("versioning", "")])
+        assert "<Status>Enabled</Status>" in r.text
+
+
+def test_object_data_replicates_both_ways(sites):
+    a, b = sites
+    a["client"].make_bucket("data-sync")
+    a["client"].put_object("data-sync", "from-a.bin", b"A" * 50_000)
+    _drain(a)
+    r = b["client"].get_object("data-sync", "from-a.bin")
+    assert r.status_code == 200 and r.content == b"A" * 50_000
+
+    b["client"].put_object("data-sync", "from-b.bin", b"B" * 30_000)
+    _drain(b)
+    r = a["client"].get_object("data-sync", "from-b.bin")
+    assert r.status_code == 200 and r.content == b"B" * 30_000
+
+
+def test_bucket_policy_mirrors(sites):
+    a, b = sites
+    a["client"].make_bucket("polbkt")
+    policy = {
+        "Version": "2012-10-17",
+        "Statement": [
+            {
+                "Effect": "Allow",
+                "Principal": {"AWS": ["*"]},
+                "Action": ["s3:GetObject"],
+                "Resource": ["arn:aws:s3:::polbkt/*"],
+            }
+        ],
+    }
+    r = a["client"].request(
+        "PUT", "/polbkt", query=[("policy", "")], body=json.dumps(policy).encode()
+    )
+    assert r.status_code == 204, r.text
+    r = b["client"].request("GET", "/polbkt", query=[("policy", "")])
+    assert r.status_code == 200
+    assert json.loads(r.text)["Statement"][0]["Action"] == ["s3:GetObject"]
+
+
+def test_bucket_tagging_and_lifecycle_mirror(sites):
+    a, b = sites
+    a["client"].make_bucket("metabkt")
+    tag_xml = (
+        '<Tagging xmlns="http://s3.amazonaws.com/doc/2006-03-01/"><TagSet>'
+        "<Tag><Key>team</Key><Value>storage</Value></Tag></TagSet></Tagging>"
+    )
+    assert (
+        a["client"].request("PUT", "/metabkt", query=[("tagging", "")], body=tag_xml.encode()).status_code
+        == 200
+    )
+    r = b["client"].request("GET", "/metabkt", query=[("tagging", "")])
+    assert r.status_code == 200 and "<Key>team</Key>" in r.text
+
+    lc_xml = (
+        '<LifecycleConfiguration><Rule><ID>exp</ID><Status>Enabled</Status>'
+        "<Filter><Prefix>tmp/</Prefix></Filter><Expiration><Days>7</Days></Expiration>"
+        "</Rule></LifecycleConfiguration>"
+    )
+    assert (
+        a["client"].request("PUT", "/metabkt", query=[("lifecycle", "")], body=lc_xml.encode()).status_code
+        == 200
+    )
+    r = b["client"].request("GET", "/metabkt", query=[("lifecycle", "")])
+    assert r.status_code == 200 and "<ID>exp</ID>" in r.text
+
+
+def test_iam_mirrors(sites):
+    a, b = sites
+    # Custom policy.
+    doc = {
+        "Version": "2012-10-17",
+        "Statement": [{"Effect": "Allow", "Action": ["s3:GetObject"], "Resource": ["arn:aws:s3:::*"]}],
+    }
+    r = a["client"].request(
+        "PUT", f"{ADMIN}/policies/site-shared", body=json.dumps(doc).encode()
+    )
+    assert r.status_code == 200, r.text
+    r = b["client"].request("GET", f"{ADMIN}/policies")
+    assert "site-shared" in r.json()
+
+    # User with the policy attached.
+    r = a["client"].request(
+        "POST",
+        f"{ADMIN}/users",
+        body=json.dumps(
+            {"accessKey": "siteuser", "secretKey": "siteuser-secret", "policies": ["site-shared"]}
+        ).encode(),
+    )
+    assert r.status_code == 200, r.text
+    users = b["client"].request("GET", f"{ADMIN}/users").json()
+    assert "siteuser" in users and users["siteuser"]["policies"] == ["site-shared"]
+
+    # The mirrored user can sign requests on site B (same secret).
+    ub = S3TestClient(b["url"], "siteuser", "siteuser-secret")
+    r = ub.request("GET", "/data-sync", query=[("location", "")])
+    assert r.status_code in (200, 403)  # signature accepted (403 only if policy denies)
+
+    # Removal mirrors too.
+    assert a["client"].request("DELETE", f"{ADMIN}/users/siteuser").status_code == 200
+    assert "siteuser" not in b["client"].request("GET", f"{ADMIN}/users").json()
+
+
+def test_delete_marker_replicates(sites):
+    a, b = sites
+    a["client"].make_bucket("delbkt")
+    a["client"].put_object("delbkt", "gone.txt", b"bye")
+    _drain(a)
+    assert b["client"].get_object("delbkt", "gone.txt").status_code == 200
+    r = a["client"].request("DELETE", "/delbkt/gone.txt")
+    assert r.status_code == 204
+    _drain(a)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if b["client"].get_object("delbkt", "gone.txt").status_code == 404:
+            break
+        time.sleep(0.1)
+    assert b["client"].get_object("delbkt", "gone.txt").status_code == 404
+
+
+def test_bucket_delete_mirrors(sites):
+    a, b = sites
+    a["client"].make_bucket("shortlived")
+    assert b["client"].request("HEAD", "/shortlived").status_code == 200
+    r = a["client"].request("DELETE", "/shortlived")
+    assert r.status_code == 204
+    assert b["client"].request("HEAD", "/shortlived").status_code == 404
+
+
+def test_join_rejects_nonempty_peer(tmp_path):
+    a = _boot(tmp_path, "na")
+    b = _boot(tmp_path, "nb")
+    try:
+        b["client"].make_bucket("already-there")
+        r = a["client"].request(
+            "POST",
+            f"{ADMIN}/site-replication/add",
+            body=json.dumps(
+                {
+                    "sites": [
+                        {"name": "na", "endpoint": a["url"], "access_key": ROOT, "secret_key": SECRET},
+                        {"name": "nb", "endpoint": b["url"], "access_key": ROOT, "secret_key": SECRET},
+                    ]
+                }
+            ).encode(),
+        )
+        assert r.status_code == 400
+        assert "not empty" in r.text
+        # Nothing was committed on either side.
+        for side in (a, b):
+            info = side["client"].request("GET", f"{ADMIN}/site-replication/info").json()
+            assert info["enabled"] is False
+    finally:
+        a["ts"].stop()
+        b["ts"].stop()
+
+
+def test_down_peer_does_not_fail_local_writes(tmp_path):
+    a = _boot(tmp_path, "da")
+    b = _boot(tmp_path, "db")
+    try:
+        r = a["client"].request(
+            "POST",
+            f"{ADMIN}/site-replication/add",
+            body=json.dumps(
+                {
+                    "sites": [
+                        {"name": "da", "endpoint": a["url"], "access_key": ROOT, "secret_key": SECRET},
+                        {"name": "db", "endpoint": b["url"], "access_key": ROOT, "secret_key": SECRET},
+                    ]
+                }
+            ).encode(),
+        )
+        assert r.status_code == 200, r.text
+        a["client"].make_bucket("survivor")
+        b["ts"].stop()  # peer outage
+
+        # Local mutations still succeed; the fan-out parks in the retry queue.
+        tag_xml = (
+            '<Tagging><TagSet><Tag><Key>k</Key><Value>v</Value></Tag></TagSet></Tagging>'
+        )
+        r = a["client"].request(
+            "PUT", "/survivor", query=[("tagging", "")], body=tag_xml.encode()
+        )
+        assert r.status_code == 200, r.text
+        sr = a["node"].site_repl
+        assert sr.pending_fanout() >= 1
+        assert "db" in sr.last_errors
+    finally:
+        a["ts"].stop()
+        try:
+            b["ts"].stop()
+        except Exception:
+            pass
