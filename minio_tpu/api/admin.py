@@ -409,7 +409,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
 
     # -- site replication (site-replication.go SRPeer* + operator APIs) ------
 
-    def _sr(ensure: bool = True):
+    def _sr():
         if ctx.site_repl is None:
             raise S3Error("NotImplemented")
         return ctx.site_repl

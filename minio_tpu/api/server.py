@@ -560,6 +560,17 @@ class S3Server:
                 ET.fromstring(body)
             except ET.ParseError:
                 raise S3Error("MalformedXML")
+        if (
+            field == "replication_xml"
+            and self.site_repl is not None
+            and self.site_repl.enabled
+        ):
+            # Site replication owns this bucket's replication config (the
+            # reference rejects edits on site-replicated buckets too).
+            raise S3Error(
+                "InvalidBucketState",
+                "replication config is managed by site replication",
+            )
         self.bucket_meta.update(bucket, **{field: body.decode() if body else ""})
         if field == "notification_xml" and self.notifier is not None:
             self.notifier.set_bucket_rules_from_xml(bucket, body)

@@ -287,6 +287,9 @@ class ReplTask:
     # ExistingObjectReplication receive them (per-target gating, matching
     # the reference's existing-object semantics).
     existing: bool = False
+    # Earliest monotonic time the retry loop may re-dispatch this task
+    # (exponential backoff so a peer outage doesn't burn the attempt budget).
+    next_at: float = 0.0
 
 
 class ReplStats:
@@ -447,7 +450,14 @@ class ReplicationSys:
                 else:
                     self.stats.add(failed=1)
                     task.attempts += 1
-                    if task.attempts < 5:
+                    # Backoff doubles to a 30s ceiling; ~200 attempts rides
+                    # out multi-hour peer outages before giving up (the
+                    # reference parks failures in a persistent MRF queue;
+                    # the scanner's resync pass is the backstop after this).
+                    if task.attempts < 200:
+                        task.next_at = time.monotonic() + min(
+                            30.0, 2.0 ** min(task.attempts, 5)
+                        )
                         with self._retry_lock:
                             self._retry.append(task)
                 # task_done AFTER retry-list insertion: unfinished_tasks +
@@ -458,9 +468,11 @@ class ReplicationSys:
     def _retry_loop(self) -> None:
         while not self._stop.is_set():
             time.sleep(1.0)
+            now = time.monotonic()
             with self._retry_lock:
-                batch, self._retry = self._retry, []
-            for t in batch:
+                due = [t for t in self._retry if t.next_at <= now]
+                self._retry = [t for t in self._retry if t.next_at > now]
+            for t in due:
                 self._enqueue(t)
 
     def close(self) -> None:
@@ -508,26 +520,42 @@ class ReplicationSys:
         return oi, data
 
     def _replicate(self, task: ReplTask) -> bool:
-        rules = self.match_all(task.bucket, task.object_name)
+        rules = [
+            r
+            for r in self.match_all(task.bucket, task.object_name)
+            # Resync tasks go only to destinations opted into existing objects.
+            if not (task.existing and not r.existing_object_replication)
+        ]
         if not rules:
             return True  # config removed; nothing to do
+        payload = None
+        if task.op == "put":
+            # One logical read (erasure decode + decrypt + decompress) per
+            # task, shared across every destination.
+            try:
+                payload = self._logical_read(
+                    task.bucket, task.object_name, task.version_id
+                )
+            except (errors.ObjectNotFound, errors.VersionNotFound):
+                return True  # gone before we got to it
+            oi, data = payload
+            if oi.delete_marker:
+                return True
+            if data is None:  # SSE-C: not replicable, ever — mark and stop
+                self._set_status(task, FAILED)
+                return True
         ok_all = True
-        attempted_put = False
         for rule in rules:
-            if task.existing and not rule.existing_object_replication:
-                continue  # resync task; this destination excluded existing objects
-            if task.op == "put":
-                attempted_put = True
-            if not self._replicate_to(task, rule):
+            if not self._replicate_to(task, rule, payload):
                 ok_all = False
-        if attempted_put:
+        if task.op == "put":
             # One status per object version (the reference keeps per-ARN
             # statuses; here FAILED wins so monitoring never reports a
             # replica that a destination is still missing).
             self._set_status(task, COMPLETED if ok_all else FAILED)
         return ok_all
 
-    def _replicate_to(self, task: ReplTask, rule: ReplicationRule) -> bool:
+    def _replicate_to(self, task: ReplTask, rule: ReplicationRule, payload) -> bool:
         client = self.targets.client(task.bucket, rule.dest_arn)
         if client is None:
             return False
@@ -549,14 +577,7 @@ class ReplicationSys:
             )
             return r.status_code in (200, 204, 404)
 
-        try:
-            oi, data = self._logical_read(task.bucket, task.object_name, task.version_id)
-        except (errors.ObjectNotFound, errors.VersionNotFound):
-            return True  # gone before we got to it
-        if oi.delete_marker:
-            return True
-        if data is None:  # SSE-C: not replicable
-            return False
+        oi, data = payload
         headers = {
             "content-type": oi.content_type or "application/octet-stream",
             HDR_SOURCE_REPL: "true",

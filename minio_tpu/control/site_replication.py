@@ -24,6 +24,7 @@ import json
 import threading
 import urllib.parse
 from collections import deque
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import asdict, dataclass
 
 from ..utils import errors
@@ -478,7 +479,10 @@ class SiteReplicationSys:
             self.iam.users[ident.credentials.access_key] = ident
             self.iam._persist()
         elif kind == "user-delete":
-            self.iam.remove_user(payload["access_key"])
+            try:
+                self.iam.remove_user(payload["access_key"])
+            except errors.StorageError:
+                pass  # already gone: at-least-once replay must be idempotent
         elif kind == "policy-mapping":
             self.iam.attach_policy(payload["access_key"], payload["policies"])
         else:
@@ -496,10 +500,24 @@ class SiteReplicationSys:
             "sites": [],
             "last_errors": dict(self.last_errors),
         }
+        def probe(site):
+            try:
+                return (
+                    self._client(site).request(
+                        "GET", f"{ADMIN_PREFIX}/info", timeout=2
+                    ).status_code
+                    == 200
+                )
+            except Exception:  # noqa: BLE001
+                return False
+
+        peers = [s for s in self.sites if s.name != self.self_name]
+        with ThreadPoolExecutor(max_workers=max(1, len(peers) or 1)) as pool:
+            alive = dict(zip([p.name for p in peers], pool.map(probe, peers)))
         for s in self.sites:
             entry = {"name": s.name, "endpoint": s.endpoint, "self": s.name == self.self_name}
             if s.name != self.self_name:
-                entry["online"] = self._client(s).online()
+                entry["online"] = alive.get(s.name, False)
             out["sites"].append(entry)
         return out
 

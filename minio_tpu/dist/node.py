@@ -207,8 +207,16 @@ class Node:
             self.config.load()
         except errors.StorageError:
             pass
+        # Optional SSD read-cache in front of the object layer for the S3
+        # serving path only — background subsystems keep the raw layer
+        # (the reference interposes CacheObjectLayer at the handler level,
+        # object-handlers.go:1722-1724).
+        from ..object.cache import CacheConfig, CacheObjectLayer
+
+        cache_cfg = CacheConfig.from_env()
+        self.cache = CacheObjectLayer(self.pools, cache_cfg) if cache_cfg else None
         self.s3 = S3Server(
-            self.pools,
+            self.cache if cache_cfg else self.pools,
             self.iam,
             region=self.region,
             check_skew=False,

@@ -1,0 +1,196 @@
+"""Disk cache layer: hits, validation, invalidation, LRU GC, offline serving.
+
+Mirrors the reference's disk-cache test surface (cmd/disk-cache_test.go):
+cache fill on GET, ETag validation against the backend, stale-entry
+invalidation on overwrite, serving from cache when the backend is down,
+`after` hit-count threshold, and watermark-driven LRU eviction.
+"""
+
+import os
+
+import pytest
+
+from minio_tpu.object.cache import CacheConfig, CacheObjectLayer
+from minio_tpu.object.pools import ServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.utils import errors
+from tests.harness import ErasureHarness
+
+BUCKET = "cachebkt"
+
+
+class CountingBackend:
+    """Delegating wrapper that counts data reads (to prove cache hits)."""
+
+    def __init__(self, layer):
+        self._layer = layer
+        self.get_calls = 0
+        self.offline = False
+
+    def __getattr__(self, name):
+        return getattr(self._layer, name)
+
+    def get_object(self, *a, **kw):
+        if self.offline:
+            raise errors.StorageError("backend down")
+        self.get_calls += 1
+        return self._layer.get_object(*a, **kw)
+
+    def get_object_info(self, *a, **kw):
+        if self.offline:
+            raise errors.StorageError("backend down")
+        return self._layer.get_object_info(*a, **kw)
+
+
+@pytest.fixture()
+def cached(tmp_path):
+    hz = ErasureHarness(tmp_path / "disks", n_disks=4)
+    layer = ServerPools([ErasureSets(list(hz.drives), 4)])
+    layer.make_bucket(BUCKET)
+    backend = CountingBackend(layer)
+    cfg = CacheConfig(drives=[str(tmp_path / "cache0"), str(tmp_path / "cache1")])
+    return backend, CacheObjectLayer(backend, cfg)
+
+
+def test_cache_fill_and_hit(cached):
+    backend, cache = cached
+    data = os.urandom(10_000)
+    cache.put_object(BUCKET, "hot.bin", data)
+    _, got = cache.get_object(BUCKET, "hot.bin")  # miss -> fill
+    assert got == data
+    calls_after_fill = backend.get_calls
+    for _ in range(3):
+        _, got = cache.get_object(BUCKET, "hot.bin")
+        assert got == data
+    assert backend.get_calls == calls_after_fill  # served from cache
+    st = cache.stats()
+    assert st["hits"] == 3 and st["misses"] == 1
+
+
+def test_overwrite_invalidates(cached):
+    backend, cache = cached
+    cache.put_object(BUCKET, "obj", b"v1" * 100)
+    cache.get_object(BUCKET, "obj")
+    cache.put_object(BUCKET, "obj", b"v2" * 100)
+    _, got = cache.get_object(BUCKET, "obj")
+    assert got == b"v2" * 100
+
+
+def test_stale_etag_revalidates(cached):
+    backend, cache = cached
+    cache.put_object(BUCKET, "obj", b"old" * 50)
+    cache.get_object(BUCKET, "obj")
+    # Write through the RAW layer (bypassing cache invalidation) to create a
+    # stale cache entry; the ETag check must catch it.
+    backend._layer.put_object(BUCKET, "obj", b"new" * 50)
+    _, got = cache.get_object(BUCKET, "obj")
+    assert got == b"new" * 50
+
+
+def test_backend_down_serves_cached(cached):
+    backend, cache = cached
+    data = b"survive" * 1000
+    cache.put_object(BUCKET, "offline.bin", data)
+    cache.get_object(BUCKET, "offline.bin")  # fill
+    backend.offline = True
+    oi, got = cache.get_object(BUCKET, "offline.bin")
+    assert got == data
+    # Uncached objects fail as usual while the backend is down.
+    with pytest.raises(errors.StorageError):
+        cache.get_object(BUCKET, "never-cached.bin")
+
+
+def test_delete_invalidates(cached):
+    backend, cache = cached
+    cache.put_object(BUCKET, "gone", b"x" * 100)
+    cache.get_object(BUCKET, "gone")
+    cache.delete_object(BUCKET, "gone")
+    with pytest.raises(errors.ObjectNotFound):
+        cache.get_object(BUCKET, "gone")
+
+
+def test_after_threshold(tmp_path):
+    hz = ErasureHarness(tmp_path / "disks", n_disks=4)
+    layer = ServerPools([ErasureSets(list(hz.drives), 4)])
+    layer.make_bucket(BUCKET)
+    backend = CountingBackend(layer)
+    cache = CacheObjectLayer(backend, CacheConfig(drives=[str(tmp_path / "c")], after=3))
+    cache.put_object(BUCKET, "warm", b"w" * 500)
+    for _ in range(2):  # below threshold: every read hits the backend
+        cache.get_object(BUCKET, "warm")
+    calls = backend.get_calls
+    cache.get_object(BUCKET, "warm")  # 3rd read caches
+    assert backend.get_calls == calls + 1
+    cache.get_object(BUCKET, "warm")  # now served from cache
+    assert backend.get_calls == calls + 1
+
+
+def test_range_reads(cached):
+    backend, cache = cached
+    data = bytes(range(256)) * 100
+    cache.put_object(BUCKET, "ranged", data)
+    cache.get_object(BUCKET, "ranged")  # whole-object fill
+    calls = backend.get_calls
+    _, part = cache.get_object(BUCKET, "ranged", offset=100, length=50)
+    assert part == data[100:150]
+    assert backend.get_calls == calls  # range served from whole-object entry
+
+
+def test_lru_gc_watermarks(tmp_path):
+    hz = ErasureHarness(tmp_path / "disks", n_disks=4)
+    layer = ServerPools([ErasureSets(list(hz.drives), 4)])
+    layer.make_bucket(BUCKET)
+    cfg = CacheConfig(drives=[str(tmp_path / "c")], quota_bytes=100_000)
+    cache = CacheObjectLayer(layer, cfg)
+    for i in range(12):  # 12 x 10 KB > 80 KB high watermark
+        cache.put_object(BUCKET, f"o{i}", bytes([i]) * 10_000)
+        cache.get_object(BUCKET, f"o{i}")
+    usage = cache.drives[0].usage()
+    assert usage <= cfg.quota_bytes * cfg.watermark_high + 11_000
+    # Newest entries survive (LRU evicts the oldest atimes first).
+    st = cache.stats()
+    assert st["drives"][0]["usage"] == usage
+
+
+def test_versioned_reads_bypass_cache(cached):
+    from minio_tpu.object.types import GetObjectOptions, PutObjectOptions
+
+    backend, cache = cached
+    v1 = cache.put_object(BUCKET, "ver", b"one", PutObjectOptions(versioned=True)).version_id
+    cache.put_object(BUCKET, "ver", b"two", PutObjectOptions(versioned=True))
+    cache.get_object(BUCKET, "ver")
+    calls = backend.get_calls
+    _, got = cache.get_object(BUCKET, "ver", GetObjectOptions(version_id=v1))
+    assert got == b"one"
+    assert backend.get_calls == calls + 1  # versioned read went to the backend
+
+
+def test_exclude_patterns(tmp_path):
+    hz = ErasureHarness(tmp_path / "disks", n_disks=4)
+    layer = ServerPools([ErasureSets(list(hz.drives), 4)])
+    layer.make_bucket(BUCKET)
+    backend = CountingBackend(layer)
+    cache = CacheObjectLayer(
+        backend, CacheConfig(drives=[str(tmp_path / "c")], exclude=[f"{BUCKET}/tmp"])
+    )
+    cache.put_object(BUCKET, "tmp/skip.bin", b"s" * 100)
+    cache.put_object(BUCKET, "keep.bin", b"k" * 100)
+    for _ in range(2):
+        cache.get_object(BUCKET, "tmp/skip.bin")
+        cache.get_object(BUCKET, "keep.bin")
+    # excluded: 2 backend reads; cached: 1 backend read.
+    assert backend.get_calls == 3
+
+
+def test_internal_metadata_survives_cache_hit(cached):
+    """SSE/compression markers live in ObjectInfo.internal; the handler's
+    decrypt/decompress path keys off them, so a cache hit must return them."""
+    from minio_tpu.object.types import PutObjectOptions
+
+    backend, cache = cached
+    opts = PutObjectOptions(user_defined={"x-internal-compression": "s2"})
+    cache.put_object(BUCKET, "marked", b"m" * 200, opts)
+    oi1, _ = cache.get_object(BUCKET, "marked")  # fill
+    oi2, _ = cache.get_object(BUCKET, "marked")  # hit
+    assert oi2.internal == oi1.internal
+    assert oi2.internal.get("x-internal-compression") == "s2"
