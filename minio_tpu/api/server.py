@@ -38,6 +38,7 @@ from ..object.types import (
     PutObjectOptions,
 )
 from ..utils import errors as oerr
+from . import zipext
 from .auth import SigV4Verifier, UNSIGNED_PAYLOAD
 from .errors import S3Error, from_object_error
 
@@ -361,7 +362,7 @@ class S3Server:
                 return await asyncio.to_thread(self._list_multipart_uploads, bucket, q)
             if "versions" in q:
                 return await asyncio.to_thread(self._list_versions, bucket, q)
-            return await asyncio.to_thread(self._list_objects, bucket, q)
+            return await asyncio.to_thread(self._list_objects, bucket, q, request)
         if m == "DELETE":
             if "policy" in q:
                 return await asyncio.to_thread(self._delete_policy, bucket)
@@ -619,7 +620,13 @@ class S3Server:
             f"{items}</ListMultipartUploadsResult>"
         )
 
-    def _list_objects(self, bucket: str, q) -> web.Response:
+    def _list_objects(self, bucket: str, q, request: web.Request | None = None) -> web.Response:
+        if (
+            request is not None
+            and zipext.wants_extract(request.headers)
+            and zipext.ZIP_SEP in q.get("prefix", "")
+        ):
+            return self._list_objects_in_zip(bucket, q, request)
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
         max_keys = int(q.get("max-keys", "1000"))
@@ -845,6 +852,10 @@ class S3Server:
         if m == "GET" and "legal-hold" in q:
             return await asyncio.to_thread(self._get_object_legal_hold, bucket, key, q)
         if m in ("GET", "HEAD"):
+            if zipext.wants_extract(request.headers) and zipext.split_zip_path(key):
+                return await asyncio.to_thread(
+                    self._get_object_in_zip, bucket, key, request, m == "HEAD"
+                )
             return await asyncio.to_thread(self._get_object, bucket, key, request, m == "HEAD")
         if m == "DELETE":
             if "tagging" in q:
@@ -1176,6 +1187,141 @@ class S3Server:
                 tiering_mod.META_TRANSITION_TIER, "GLACIER"
             )
         return headers
+
+    # -- zip extension (s3-zip-handlers.go role) ------------------------------
+
+    def _read_zip_archive(self, bucket: str, zip_key: str, request: web.Request) -> bytes:
+        """Whole archive in logical bytes (transforms undone, tiered versions
+        fetched back)."""
+        opts = GetObjectOptions()
+        probe = self.layer.get_object_info(bucket, zip_key, opts)
+        if self.tiering is not None and tiering_mod.is_transitioned(probe.internal):
+            data = self.tiering.read_object(self.layer, bucket, zip_key, probe)
+            oi = probe
+        else:
+            oi, data = self.layer.get_object(bucket, zip_key, opts)
+        return self._transform_get(bucket, zip_key, data, oi, request)
+
+    def _get_object_in_zip(
+        self, bucket: str, key: str, request: web.Request, head: bool
+    ) -> web.Response:
+        zip_key, inner = zipext.split_zip_path(key)
+        if not inner:
+            raise S3Error("NoSuchKey", resource=f"/{bucket}/{key}")
+        data = self._read_zip_archive(bucket, zip_key, request)
+        try:
+            # HEAD reads only central-directory metadata — no decompression.
+            entry = zipext.stat_entry(data, inner)
+            payload = None
+            if entry is not None and not head:
+                entry, payload = zipext.read_entry(data, inner)
+        except Exception:
+            raise S3Error("InvalidRequest", "object is not a valid zip archive")
+        if entry is None:
+            raise S3Error("NoSuchKey", resource=f"/{bucket}/{key}")
+        headers = {
+            "ETag": f'"{entry.etag}"',
+            "Last-Modified": _http_date(entry.mod_time),
+            "Content-Type": zipext.content_type(entry.name),
+            "Accept-Ranges": "bytes",
+        }
+        if head:
+            headers["Content-Length"] = str(entry.size)
+            return web.Response(status=200, headers=headers)
+        rng = request.headers.get("Range", "")
+        if rng:
+            offset, length, _ = _parse_range(rng)
+            if offset >= len(payload) or not payload:
+                raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
+            end = len(payload) if length < 0 else min(offset + length, len(payload))
+            part = payload[offset:end]
+            headers["Content-Range"] = f"bytes {offset}-{end - 1}/{len(payload)}"
+            return web.Response(status=206, body=part, headers=headers)
+        return web.Response(status=200, body=payload, headers=headers)
+
+    def _list_objects_in_zip(self, bucket: str, q, request: web.Request) -> web.Response:
+        prefix = q.get("prefix", "")
+        zip_key, inner_prefix = zipext.split_zip_path(prefix)
+        delimiter = q.get("delimiter", "")
+        max_keys = int(q.get("max-keys", "1000"))
+        v2 = q.get("list-type") == "2"
+        if v2:
+            token = q.get("continuation-token", "")
+            marker = base64.b64decode(token).decode() if token else q.get("start-after", "")
+        else:
+            marker = q.get("marker", "")
+
+        # Real request headers flow through so SSE-C keys reach the decrypt
+        # path for encrypted archives.
+        data = self._read_zip_archive(bucket, zip_key, request)
+        try:
+            entries = zipext.list_entries(data)
+        except Exception:
+            raise S3Error("InvalidRequest", "object is not a valid zip archive")
+
+        # One merged, name-ordered stream of keys and rolled-up common
+        # prefixes; marker/truncation apply uniformly to both so pagination
+        # never duplicates or drops a prefix group.
+        items: list[tuple[str, zipext.ZipEntry | None]] = []
+        seen_prefix: set[str] = set()
+        for e in sorted(entries, key=lambda x: x.name):
+            if not e.name.startswith(inner_prefix):
+                continue
+            if delimiter:
+                rest = e.name[len(inner_prefix):]
+                cut = rest.find(delimiter)
+                if cut >= 0:
+                    p = f"{zip_key}/{inner_prefix}{rest[: cut + len(delimiter)]}"
+                    if p not in seen_prefix:
+                        seen_prefix.add(p)
+                        if not (marker and p <= marker):
+                            items.append((p, None))
+                    continue
+            full = f"{zip_key}/{e.name}"
+            if marker and full <= marker:
+                continue
+            items.append((full, e))
+        truncated = len(items) > max_keys
+        items = items[:max_keys]
+        contents = "".join(
+            f"<Contents><Key>{escape(name)}</Key>"
+            f"<LastModified>{_iso(e.mod_time)}</LastModified>"
+            f'<ETag>"{e.etag}"</ETag><Size>{e.size}</Size>'
+            "<StorageClass>STANDARD</StorageClass></Contents>"
+            for name, e in items
+            if e is not None
+        )
+        cps = "".join(
+            f"<CommonPrefixes><Prefix>{escape(name)}</Prefix></CommonPrefixes>"
+            for name, e in items
+            if e is None
+        )
+        last = items[-1][0] if items else ""
+        common = (
+            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+            f"<MaxKeys>{max_keys}</MaxKeys><Delimiter>{escape(delimiter)}</Delimiter>"
+            f"<IsTruncated>{'true' if truncated else 'false'}</IsTruncated>"
+        )
+        if v2:
+            next_token = (
+                f"<NextContinuationToken>{base64.b64encode(last.encode()).decode()}"
+                "</NextContinuationToken>"
+                if truncated
+                else ""
+            )
+            return _xml(
+                f'<ListBucketResult xmlns="{XML_NS}">{common}'
+                f"<KeyCount>{len(items)}</KeyCount>{next_token}{contents}{cps}"
+                "</ListBucketResult>"
+            )
+        next_marker = (
+            f"<NextMarker>{escape(last)}</NextMarker>" if truncated else ""
+        )
+        return _xml(
+            f'<ListBucketResult xmlns="{XML_NS}">{common}'
+            f"<Marker>{escape(marker)}</Marker>{next_marker}{contents}{cps}"
+            "</ListBucketResult>"
+        )
 
     def _get_object(
         self, bucket: str, key: str, request: web.Request, head: bool

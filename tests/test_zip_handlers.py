@@ -1,0 +1,183 @@
+"""Zip extension tests: list/get inside stored zip archives
+(reference cmd/s3-zip-handlers.go — x-minio-extract)."""
+
+import io
+import xml.etree.ElementTree as ET
+import zipfile
+
+import pytest
+
+from tests.test_s3_api import stack  # noqa: F401 (fixture reuse)
+
+BUCKET = "zipbkt"
+NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+
+
+def _make_zip() -> bytes:
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("readme.txt", b"hello from zip")
+        zf.writestr("docs/a.md", b"# doc a")
+        zf.writestr("docs/b.md", b"# doc b")
+        zf.writestr("docs/sub/deep.bin", bytes(range(200)))
+        zf.writestr("empty-dir/", b"")
+    return buf.getvalue()
+
+
+@pytest.fixture()
+def zipped(stack):  # noqa: F811
+    client = stack["client"]
+    if client.request("HEAD", f"/{BUCKET}").status_code != 200:
+        client.make_bucket(BUCKET)
+    client.put_object(BUCKET, "archive.zip", _make_zip())
+    return client
+
+
+def test_get_inner_file(zipped):
+    r = zipped.request(
+        "GET", f"/{BUCKET}/archive.zip/readme.txt", headers={"x-minio-extract": "true"}
+    )
+    assert r.status_code == 200, r.text
+    assert r.content == b"hello from zip"
+    assert r.headers["Content-Type"].startswith("text/plain")
+
+    r = zipped.request(
+        "GET", f"/{BUCKET}/archive.zip/docs/sub/deep.bin", headers={"x-minio-extract": "true"}
+    )
+    assert r.status_code == 200 and r.content == bytes(range(200))
+
+
+def test_head_inner_file(zipped):
+    r = zipped.request(
+        "HEAD", f"/{BUCKET}/archive.zip/docs/a.md", headers={"x-minio-extract": "true"}
+    )
+    assert r.status_code == 200
+    assert r.headers["Content-Length"] == "7"
+
+
+def test_missing_inner_file_404(zipped):
+    r = zipped.request(
+        "GET", f"/{BUCKET}/archive.zip/nope.txt", headers={"x-minio-extract": "true"}
+    )
+    assert r.status_code == 404
+
+
+def test_without_header_is_plain_key_lookup(zipped):
+    # No x-minio-extract: the full path is treated as a literal key.
+    r = zipped.request("GET", f"/{BUCKET}/archive.zip/readme.txt")
+    assert r.status_code == 404
+
+
+def test_range_read_inside_zip(zipped):
+    r = zipped.request(
+        "GET",
+        f"/{BUCKET}/archive.zip/docs/sub/deep.bin",
+        headers={"x-minio-extract": "true", "Range": "bytes=10-19"},
+    )
+    assert r.status_code == 206
+    assert r.content == bytes(range(10, 20))
+
+
+def test_list_zip_contents(zipped):
+    r = zipped.request(
+        "GET",
+        f"/{BUCKET}",
+        query=[("list-type", "2"), ("prefix", "archive.zip/")],
+        headers={"x-minio-extract": "true"},
+    )
+    assert r.status_code == 200, r.text
+    root = ET.fromstring(r.text)
+    keys = [c.find(f"{NS}Key").text for c in root.findall(f"{NS}Contents")]
+    assert f"archive.zip/readme.txt" in keys
+    assert f"archive.zip/docs/a.md" in keys
+    assert all(not k.endswith("/") for k in keys)  # dirs excluded
+
+
+def test_list_zip_with_delimiter(zipped):
+    r = zipped.request(
+        "GET",
+        f"/{BUCKET}",
+        query=[("list-type", "2"), ("prefix", "archive.zip/"), ("delimiter", "/")],
+        headers={"x-minio-extract": "true"},
+    )
+    root = ET.fromstring(r.text)
+    keys = [c.find(f"{NS}Key").text for c in root.findall(f"{NS}Contents")]
+    cps = [p.find(f"{NS}Prefix").text for p in root.findall(f"{NS}CommonPrefixes")]
+    assert keys == ["archive.zip/readme.txt"]
+    assert "archive.zip/docs/" in cps
+
+
+def test_list_zip_inner_prefix(zipped):
+    r = zipped.request(
+        "GET",
+        f"/{BUCKET}",
+        query=[("list-type", "2"), ("prefix", "archive.zip/docs/")],
+        headers={"x-minio-extract": "true"},
+    )
+    root = ET.fromstring(r.text)
+    keys = [c.find(f"{NS}Key").text for c in root.findall(f"{NS}Contents")]
+    assert keys == [
+        "archive.zip/docs/a.md",
+        "archive.zip/docs/b.md",
+        "archive.zip/docs/sub/deep.bin",
+    ]
+
+
+def test_not_a_zip_errors(zipped):
+    zipped.put_object(BUCKET, "fake.zip", b"this is not a zip archive")
+    r = zipped.request(
+        "GET", f"/{BUCKET}/fake.zip/anything", headers={"x-minio-extract": "true"}
+    )
+    assert r.status_code == 400
+
+
+def test_zip_list_pagination(zipped):
+    # Page through with max-keys=2; every entry appears exactly once.
+    seen, token = [], ""
+    for _ in range(10):
+        q = [("list-type", "2"), ("prefix", "archive.zip/"), ("max-keys", "2")]
+        if token:
+            q.append(("continuation-token", token))
+        r = zipped.request("GET", f"/{BUCKET}", query=q, headers={"x-minio-extract": "true"})
+        root = ET.fromstring(r.text)
+        seen += [c.find(f"{NS}Key").text for c in root.findall(f"{NS}Contents")]
+        t = root.find(f"{NS}NextContinuationToken")
+        if t is None:
+            break
+        token = t.text
+    assert sorted(seen) == [
+        "archive.zip/docs/a.md",
+        "archive.zip/docs/b.md",
+        "archive.zip/docs/sub/deep.bin",
+        "archive.zip/readme.txt",
+    ]
+    assert len(seen) == len(set(seen))  # no duplicates across pages
+
+
+def test_zip_list_v1_marker(zipped):
+    r = zipped.request(
+        "GET",
+        f"/{BUCKET}",
+        query=[("prefix", "archive.zip/"), ("marker", "archive.zip/docs/b.md")],
+        headers={"x-minio-extract": "true"},
+    )
+    root = ET.fromstring(r.text)
+    keys = [c.find(f"{NS}Key").text for c in root.findall(f"{NS}Contents")]
+    assert keys == ["archive.zip/docs/sub/deep.bin", "archive.zip/readme.txt"]
+    assert root.find(f"{NS}Marker") is not None  # V1 response shape
+
+
+def test_range_on_empty_inner_file(zipped):
+    buf = io.BytesIO()
+    with zipfile.ZipFile(buf, "w") as zf:
+        zf.writestr("void.txt", b"")
+    zipped.put_object(BUCKET, "empty.zip", buf.getvalue())
+    r = zipped.request(
+        "GET",
+        f"/{BUCKET}/empty.zip/void.txt",
+        headers={"x-minio-extract": "true", "Range": "bytes=0-9"},
+    )
+    assert r.status_code == 416
+    # And a plain GET of the empty entry succeeds.
+    r = zipped.request("GET", f"/{BUCKET}/empty.zip/void.txt", headers={"x-minio-extract": "true"})
+    assert r.status_code == 200 and r.content == b""
