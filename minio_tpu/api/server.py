@@ -249,7 +249,7 @@ class S3Server:
             form = sts_mod.parse_form(body)
             if "Action" in form:
                 return await asyncio.to_thread(
-                    sts_mod.handle_sts, self.iam, access_key, form
+                    sts_mod.handle_sts, self.iam, access_key, form, self.config, request
                 )
 
         action = policy_mod.s3_action(request.method, bucket, key, q)
@@ -1210,11 +1210,13 @@ class S3Server:
             raise S3Error("NoSuchKey", resource=f"/{bucket}/{key}")
         data = self._read_zip_archive(bucket, zip_key, request)
         try:
-            # HEAD reads only central-directory metadata — no decompression.
-            entry = zipext.stat_entry(data, inner)
-            payload = None
-            if entry is not None and not head:
-                entry, payload = zipext.read_entry(data, inner)
+            if head:
+                # HEAD reads only central-directory metadata — no payload
+                # decompression.
+                entry, payload = zipext.stat_entry(data, inner), None
+            else:
+                found = zipext.read_entry(data, inner)
+                entry, payload = found if found is not None else (None, None)
         except Exception:
             raise S3Error("InvalidRequest", "object is not a valid zip archive")
         if entry is None:

@@ -56,19 +56,19 @@ def _entry_mtime(info: zipfile.ZipInfo) -> float:
         return 0.0
 
 
+def _entry_of(info: zipfile.ZipInfo) -> ZipEntry:
+    return ZipEntry(
+        name=info.filename,
+        size=info.file_size,
+        mod_time=_entry_mtime(info),
+        crc=info.CRC,
+    )
+
+
 def list_entries(zip_bytes: bytes) -> list[ZipEntry]:
     """All file entries of the archive in central-directory order."""
     with zipfile.ZipFile(io.BytesIO(zip_bytes)) as zf:
-        return [
-            ZipEntry(
-                name=info.filename,
-                size=info.file_size,
-                mod_time=_entry_mtime(info),
-                crc=info.CRC,
-            )
-            for info in zf.infolist()
-            if not info.is_dir()
-        ]
+        return [_entry_of(info) for info in zf.infolist() if not info.is_dir()]
 
 
 def stat_entry(zip_bytes: bytes, inner: str) -> ZipEntry | None:
@@ -80,12 +80,7 @@ def stat_entry(zip_bytes: bytes, inner: str) -> ZipEntry | None:
             return None
         if info.is_dir():
             return None
-        return ZipEntry(
-            name=info.filename,
-            size=info.file_size,
-            mod_time=_entry_mtime(info),
-            crc=info.CRC,
-        )
+        return _entry_of(info)
 
 
 def read_entry(zip_bytes: bytes, inner: str) -> tuple[ZipEntry, bytes] | None:
@@ -96,15 +91,7 @@ def read_entry(zip_bytes: bytes, inner: str) -> tuple[ZipEntry, bytes] | None:
             return None
         if info.is_dir():
             return None
-        return (
-            ZipEntry(
-                name=info.filename,
-                size=info.file_size,
-                mod_time=_entry_mtime(info),
-                crc=info.CRC,
-            ),
-            zf.read(info),
-        )
+        return _entry_of(info), zf.read(info)
 
 
 def content_type(name: str) -> str:

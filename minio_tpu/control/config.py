@@ -31,6 +31,9 @@ SUBSYS_AUDIT = "audit_webhook"
 SUBSYS_NOTIFY_WEBHOOK = "notify_webhook"
 SUBSYS_REGION = "region"
 SUBSYS_ENCODER = "encoder"  # TPU batching runtime knobs (this framework's own)
+SUBSYS_IDENTITY_OPENID = "identity_openid"
+SUBSYS_IDENTITY_LDAP = "identity_ldap"
+SUBSYS_IDENTITY_TLS = "identity_tls"
 
 
 @dataclass
@@ -66,6 +69,25 @@ class ConfigSys:
                 KV("cors_allow_origin", "*", dynamic=True),
                 KV("delete_cleanup_interval", "5m", dynamic=True),
             ],
+        )
+        self.register(
+            SUBSYS_IDENTITY_OPENID,
+            [
+                # Static JWKS document / shared HMAC secret (zero-egress: no
+                # issuer discovery; internal/config/identity/openid role).
+                KV("jwks", "", dynamic=True),
+                KV("hmac_secret", "", dynamic=True),
+                KV("claim_name", "policy", dynamic=True),
+                KV("client_id", "", dynamic=True),
+            ],
+        )
+        self.register(
+            SUBSYS_IDENTITY_LDAP,
+            [KV("server_addr", "", dynamic=False)],
+        )
+        self.register(
+            SUBSYS_IDENTITY_TLS,
+            [KV("enable", "off", dynamic=True)],
         )
         self.register(
             SUBSYS_STORAGE_CLASS,

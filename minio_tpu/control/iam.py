@@ -161,6 +161,27 @@ class IAMSys:
         self._persist()
         return Credentials(ak, sk)
 
+    def new_sts_credentials_for_policies(
+        self,
+        policies: list[str],
+        duration_seconds: int,
+        session_policy: dict | None = None,
+    ) -> tuple[Credentials, float]:
+        """Temporary credentials for a federated identity (OIDC/LDAP/cert):
+        no parent user — the mapped policies ARE the permission set
+        (sts-handlers.go WithSSO/Certificate issuance)."""
+        ak = "STS" + secrets.token_hex(8).upper()
+        sk = secrets.token_urlsafe(30)
+        exp = time.time() + duration_seconds
+        with self._lock:
+            self.users[ak] = UserIdentity(
+                Credentials(ak, sk),
+                policies=list(policies),
+                session_policy=session_policy,
+                expiration=exp,
+            )
+        return Credentials(ak, sk), exp
+
     def new_sts_credentials(
         self, parent: str, duration_seconds: int, session_policy: dict | None = None
     ) -> tuple[Credentials, float]:
@@ -209,7 +230,13 @@ class IAMSys:
                 sp = policy_mod.Policy.from_dict(ident.session_policy)
                 return parent_allowed and sp.is_allowed(action, resource)
             return parent_allowed
-        return self._eval(names, action, resource)
+        allowed = self._eval(names, action, resource)
+        # Federated STS identities (no parent user) carry mapped policies; a
+        # session policy can only NARROW them, never broaden.
+        if allowed and ident.session_policy is not None:
+            sp = policy_mod.Policy.from_dict(ident.session_policy)
+            return sp.is_allowed(action, resource)
+        return allowed
 
     def _eval(self, names: list[str], action: str, resource: str) -> bool:
         for name in names:
