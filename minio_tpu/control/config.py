@@ -132,6 +132,50 @@ class ConfigSys:
                 KV("queue_limit", "100000", dynamic=True),
             ],
         )
+        # Broker notification targets (internal/event/target zoo). Native
+        # protocol targets; kafka/amqp/mysql/postgresql additionally need
+        # their optional client libraries at enable time.
+        self.register(
+            "notify_redis",
+            [
+                KV("enable", "off"),
+                KV("address", "127.0.0.1:6379"),
+                KV("key", "minio_events"),
+                KV("format", "access"),
+                KV("password", ""),
+            ],
+        )
+        self.register(
+            "notify_nats",
+            [KV("enable", "off"), KV("address", "127.0.0.1:4222"), KV("subject", "minio_events")],
+        )
+        self.register(
+            "notify_mqtt",
+            [KV("enable", "off"), KV("broker", "127.0.0.1:1883"), KV("topic", "minio_events")],
+        )
+        self.register(
+            "notify_nsq",
+            [KV("enable", "off"), KV("nsqd_address", "127.0.0.1:4151"), KV("topic", "minio_events")],
+        )
+        self.register(
+            "notify_elasticsearch",
+            [
+                KV("enable", "off"),
+                KV("url", "http://127.0.0.1:9200"),
+                KV("index", "minio_events"),
+                KV("format", "namespace"),
+            ],
+        )
+        self.register(
+            "notify_kafka",
+            [KV("enable", "off"), KV("brokers", "127.0.0.1:9092"), KV("topic", "minio_events")],
+        )
+        self.register(
+            "notify_amqp",
+            [KV("enable", "off"), KV("url", ""), KV("exchange", ""), KV("routing_key", "")],
+        )
+        self.register("notify_mysql", [KV("enable", "off"), KV("dsn_string", ""), KV("table", "minio_events")])
+        self.register("notify_postgres", [KV("enable", "off"), KV("connection_string", ""), KV("table", "minio_events")])
         self.register(
             SUBSYS_ENCODER,
             [

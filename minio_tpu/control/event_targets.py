@@ -1,0 +1,356 @@
+"""Event broker targets: the reference's target zoo behind one interface.
+
+Role of internal/event/target/{webhook,amqp,elasticsearch,kafka,mqtt,mysql,
+nats,nsq,postgresql,redis}.go: every target shares the durable TargetQueue
+spool (queuestore.go) so broker outages never lose events, and differs only
+in the send function.
+
+Zero-dependency stance: brokers with simple wire protocols are implemented
+natively over sockets/HTTP (redis RESP, NATS text protocol, MQTT 3.1.1
+QoS0, NSQ HTTP pub, Elasticsearch doc POST) — no client libraries needed.
+Brokers with heavyweight protocols (kafka, amqp, mysql, postgresql) are
+gated: the target registers and spools durably, and sends require the
+optional client library (kafka-python / pika / pymysql / psycopg2); without
+it the constructor raises a clear configuration error.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import socket
+import struct
+import urllib.parse
+
+from ..utils import errors
+from .events import TargetQueue
+
+
+class _SocketTarget:
+    """Shared shape: durable queue + per-send connection (the reference
+    reconnects per batch too; these are control-plane rates, not data)."""
+
+    def __init__(self, target_id: str, queue_dir: str = "", queue_limit: int = 100_000):
+        self.id = target_id
+        self.queue = TargetQueue(self._send, queue_dir, queue_limit)
+
+    def send(self, record: dict) -> None:
+        self.queue.put(record)
+
+    def close(self) -> None:
+        self.queue.close()
+
+    def _send(self, record: dict) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+def _recv_line(sock: socket.socket) -> bytes:
+    out = b""
+    while not out.endswith(b"\r\n"):
+        c = sock.recv(1)
+        if not c:
+            raise ConnectionError("connection closed")
+        out += c
+    return out[:-2]
+
+
+class RedisEventTarget(_SocketTarget):
+    """redis.go role: `access` format RPUSHes event JSON onto a list;
+    `namespace` format HSETs key -> latest event. Speaks RESP natively."""
+
+    def __init__(self, target_id, addr: str, key: str, fmt: str = "access",
+                 password: str = "", queue_dir: str = "", queue_limit: int = 100_000):
+        host, _, port = addr.partition(":")
+        self.host, self.port = host, int(port or 6379)
+        self.key = key
+        self.fmt = fmt
+        self.password = password
+        super().__init__(target_id, queue_dir, queue_limit)
+
+    @staticmethod
+    def _resp(*args: bytes) -> bytes:
+        out = b"*%d\r\n" % len(args)
+        for a in args:
+            out += b"$%d\r\n%s\r\n" % (len(a), a)
+        return out
+
+    def _cmd(self, sock: socket.socket, *args: bytes) -> bytes:
+        sock.sendall(self._resp(*args))
+        line = _recv_line(sock)
+        if line.startswith(b"-"):
+            raise ConnectionError(f"redis error: {line[1:].decode()}")
+        if line.startswith(b"$"):
+            n = int(line[1:])
+            if n >= 0:
+                sock.recv(n + 2)
+        return line
+
+    def _send(self, record: dict) -> None:
+        payload = json.dumps(record).encode()
+        with socket.create_connection((self.host, self.port), timeout=5.0) as sock:
+            if self.password:
+                self._cmd(sock, b"AUTH", self.password.encode())
+            if self.fmt == "namespace":
+                field = record.get("Key", "").encode()
+                self._cmd(sock, b"HSET", self.key.encode(), field, payload)
+            else:
+                self._cmd(sock, b"RPUSH", self.key.encode(), payload)
+
+
+class NATSEventTarget(_SocketTarget):
+    """nats.go role: PUB <subject> over the NATS text protocol."""
+
+    def __init__(self, target_id, addr: str, subject: str,
+                 queue_dir: str = "", queue_limit: int = 100_000):
+        host, _, port = addr.partition(":")
+        self.host, self.port = host, int(port or 4222)
+        self.subject = subject
+        super().__init__(target_id, queue_dir, queue_limit)
+
+    def _send(self, record: dict) -> None:
+        payload = json.dumps(record).encode()
+        with socket.create_connection((self.host, self.port), timeout=5.0) as sock:
+            info = _recv_line(sock)  # INFO {...}
+            if not info.startswith(b"INFO"):
+                raise ConnectionError("not a NATS server")
+            sock.sendall(b'CONNECT {"verbose":false,"pedantic":false}\r\n')
+            sock.sendall(
+                b"PUB %s %d\r\n%s\r\n" % (self.subject.encode(), len(payload), payload)
+            )
+            sock.sendall(b"PING\r\n")
+            # Wait for PONG so the publish is known flushed (+OK may arrive
+            # first in verbose servers).
+            for _ in range(3):
+                line = _recv_line(sock)
+                if line == b"PONG":
+                    return
+                if line.startswith(b"-ERR"):
+                    raise ConnectionError(line.decode())
+            raise ConnectionError("no PONG from NATS server")
+
+
+class MQTTEventTarget(_SocketTarget):
+    """mqtt.go role: MQTT 3.1.1 CONNECT + PUBLISH (QoS 0), hand-rolled."""
+
+    def __init__(self, target_id, addr: str, topic: str,
+                 queue_dir: str = "", queue_limit: int = 100_000):
+        host, _, port = addr.partition(":")
+        self.host, self.port = host, int(port or 1883)
+        self.topic = topic
+        super().__init__(target_id, queue_dir, queue_limit)
+
+    @staticmethod
+    def _remaining_len(n: int) -> bytes:
+        out = b""
+        while True:
+            byte = n % 128
+            n //= 128
+            out += bytes([byte | (0x80 if n else 0)])
+            if not n:
+                return out
+
+    def _send(self, record: dict) -> None:
+        payload = json.dumps(record).encode()
+        client_id = b"mtpu-notify"
+        var = (
+            struct.pack(">H", 4) + b"MQTT" + bytes([4])  # protocol level 3.1.1
+            + bytes([0x02])  # clean session
+            + struct.pack(">H", 30)  # keepalive
+            + struct.pack(">H", len(client_id)) + client_id
+        )
+        connect = bytes([0x10]) + self._remaining_len(len(var)) + var
+        topic = self.topic.encode()
+        pub_var = struct.pack(">H", len(topic)) + topic + payload
+        publish = bytes([0x30]) + self._remaining_len(len(pub_var)) + pub_var
+        with socket.create_connection((self.host, self.port), timeout=5.0) as sock:
+            sock.sendall(connect)
+            connack = sock.recv(4)
+            if len(connack) < 4 or connack[0] != 0x20 or connack[3] != 0:
+                raise ConnectionError(f"MQTT CONNACK refused: {connack!r}")
+            sock.sendall(publish)
+
+
+class NSQEventTarget(_SocketTarget):
+    """nsq.go role: HTTP POST to nsqd's /pub endpoint."""
+
+    def __init__(self, target_id, addr: str, topic: str,
+                 queue_dir: str = "", queue_limit: int = 100_000):
+        import requests
+
+        self.url = f"http://{addr}/pub?topic={urllib.parse.quote(topic)}"
+        self.session = requests.Session()
+        super().__init__(target_id, queue_dir, queue_limit)
+
+    def _send(self, record: dict) -> None:
+        r = self.session.post(self.url, json=record, timeout=5.0)
+        r.raise_for_status()
+
+
+class ElasticsearchEventTarget(_SocketTarget):
+    """elasticsearch.go role: index one document per event; doc id = object
+    key in `namespace` format (last state wins), auto id in `access`."""
+
+    def __init__(self, target_id, url: str, index: str, fmt: str = "namespace",
+                 queue_dir: str = "", queue_limit: int = 100_000):
+        import requests
+
+        self.base = url.rstrip("/")
+        self.index = index
+        self.fmt = fmt
+        self.session = requests.Session()
+        super().__init__(target_id, queue_dir, queue_limit)
+
+    def _send(self, record: dict) -> None:
+        if self.fmt == "namespace":
+            doc_id = urllib.parse.quote(record.get("Key", ""), safe="")
+            r = self.session.put(
+                f"{self.base}/{self.index}/_doc/{doc_id}", json=record, timeout=5.0
+            )
+        else:
+            r = self.session.post(f"{self.base}/{self.index}/_doc", json=record, timeout=5.0)
+        r.raise_for_status()
+
+
+class _GatedLibTarget(_SocketTarget):
+    """Targets whose protocol needs an optional client library."""
+
+    lib = ""
+    broker = ""
+
+    def __init__(self, target_id, queue_dir: str = "", queue_limit: int = 100_000, **kw):
+        if importlib.util.find_spec(self.lib) is None:
+            raise errors.InvalidArgument(
+                msg=f"{self.broker} target requires the {self.lib!r} client library, "
+                "which is not installed in this build"
+            )
+        self.kw = kw
+        super().__init__(target_id, queue_dir, queue_limit)
+
+
+class KafkaEventTarget(_GatedLibTarget):
+    lib, broker = "kafka", "kafka"
+
+    def _send(self, record: dict) -> None:  # pragma: no cover - needs lib+broker
+        from kafka import KafkaProducer
+
+        producer = KafkaProducer(bootstrap_servers=self.kw["brokers"])
+        producer.send(self.kw["topic"], json.dumps(record).encode())
+        producer.flush(timeout=5)
+        producer.close()
+
+
+class AMQPEventTarget(_GatedLibTarget):
+    lib, broker = "pika", "amqp"
+
+    def _send(self, record: dict) -> None:  # pragma: no cover - needs lib+broker
+        import pika
+
+        conn = pika.BlockingConnection(pika.URLParameters(self.kw["url"]))
+        ch = conn.channel()
+        ch.basic_publish(
+            exchange=self.kw.get("exchange", ""),
+            routing_key=self.kw.get("routing_key", ""),
+            body=json.dumps(record).encode(),
+        )
+        conn.close()
+
+
+class MySQLEventTarget(_GatedLibTarget):
+    lib, broker = "pymysql", "mysql"
+
+    def _send(self, record: dict) -> None:  # pragma: no cover - needs lib+broker
+        import pymysql
+
+        conn = pymysql.connect(**self.kw["dsn"])
+        with conn.cursor() as cur:
+            cur.execute(
+                f"INSERT INTO {self.kw['table']} (event_time, event_data) VALUES (NOW(), %s)",
+                (json.dumps(record),),
+            )
+        conn.commit()
+        conn.close()
+
+
+class PostgresEventTarget(_GatedLibTarget):
+    lib, broker = "psycopg2", "postgresql"
+
+    def _send(self, record: dict) -> None:  # pragma: no cover - needs lib+broker
+        import psycopg2
+
+        conn = psycopg2.connect(self.kw["dsn"])
+        with conn.cursor() as cur:
+            cur.execute(
+                f"INSERT INTO {self.kw['table']} (event_time, event_data) VALUES (NOW(), %s)",
+                (json.dumps(record),),
+            )
+        conn.commit()
+        conn.close()
+
+
+# -- config-driven construction ----------------------------------------------
+
+# subsys -> (constructor, [(config_key, ctor_kwarg)...]); "enable" gates.
+TARGET_SUBSYS = {
+    "notify_redis": (RedisEventTarget, [("address", "addr"), ("key", "key"), ("format", "fmt"), ("password", "password")]),
+    "notify_nats": (NATSEventTarget, [("address", "addr"), ("subject", "subject")]),
+    "notify_mqtt": (MQTTEventTarget, [("broker", "addr"), ("topic", "topic")]),
+    "notify_nsq": (NSQEventTarget, [("nsqd_address", "addr"), ("topic", "topic")]),
+    "notify_elasticsearch": (ElasticsearchEventTarget, [("url", "url"), ("index", "index"), ("format", "fmt")]),
+    # Gated targets: constructing them raises a clear error when the client
+    # library is absent — surfaced at enable time, not at first event.
+    "notify_kafka": (KafkaEventTarget, [("brokers", "brokers"), ("topic", "topic")]),
+    "notify_amqp": (AMQPEventTarget, [("url", "url"), ("exchange", "exchange"), ("routing_key", "routing_key")]),
+    "notify_mysql": (MySQLEventTarget, [("dsn_string", "dsn"), ("table", "table")]),
+    "notify_postgres": (PostgresEventTarget, [("connection_string", "dsn"), ("table", "table")]),
+}
+
+
+def configure_targets(
+    notifier, config, queue_root: str = "", on_error=None
+) -> list[str]:
+    """Register every enabled notify_* target from config (the reference
+    builds its TargetList from config the same way). Returns target ids.
+
+    Each target is constructed in isolation: one misconfigured broker (bad
+    address, missing client library) must neither crash bootstrap nor
+    disable the targets configured after it. Failures go to `on_error`
+    (target_id, exception)."""
+    import os
+
+    from .events import WebhookEventTarget
+
+    ids = []
+
+    def attempt(tid, build):
+        try:
+            notifier.register_target(build())
+            ids.append(tid)
+        except Exception as e:  # noqa: BLE001 - bad config isolated per target
+            if on_error is not None:
+                on_error(tid, e)
+
+    if config.get("notify_webhook", "enable") == "on":
+        attempt(
+            "webhook",
+            lambda: WebhookEventTarget(
+                "webhook",
+                config.get("notify_webhook", "endpoint"),
+                queue_dir=os.path.join(queue_root, "webhook") if queue_root else "",
+                queue_limit=int(config.get("notify_webhook", "queue_limit") or 100_000),
+            ),
+        )
+    for subsys, (ctor, keys) in TARGET_SUBSYS.items():
+        if config.get(subsys, "enable") != "on":
+            continue
+        tid = subsys.removeprefix("notify_")
+        kwargs = {kwarg: config.get(subsys, ckey) for ckey, kwarg in keys}
+        kwargs = {k: v for k, v in kwargs.items() if v}
+        attempt(
+            tid,
+            lambda ctor=ctor, tid=tid, kwargs=kwargs: ctor(
+                tid,
+                queue_dir=os.path.join(queue_root, tid) if queue_root else "",
+                **kwargs,
+            ),
+        )
+    return ids

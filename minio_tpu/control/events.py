@@ -133,13 +133,24 @@ class TargetQueue:
 
     def _reload_spool(self) -> None:
         for name in sorted(os.listdir(self.queue_dir)):
+            path = os.path.join(self.queue_dir, name)
             try:
-                with open(os.path.join(self.queue_dir, name)) as f:
-                    self._mem.append(json.load(f))
+                with open(path) as f:
+                    record = json.load(f)
             except (OSError, ValueError):
                 continue
+            if not isinstance(record, dict):
+                continue  # stray/corrupt file; leave for operator inspection
+            # Re-attach the spool path so the file is removed once sent
+            # (without this, restart-recovered events leave their spool
+            # files behind forever).
+            record["__spool__"] = path
+            self._mem.append(record)
 
     def put(self, record: dict) -> None:
+        # Private copy: emit() hands the SAME dict to every target, and each
+        # queue annotates its own spool path on it.
+        record = dict(record)
         with self._lock:
             if len(self._mem) >= self.queue_limit:
                 return  # drop oldest-tolerant: refuse new when full

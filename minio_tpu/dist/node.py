@@ -11,6 +11,7 @@ pools and serves S3 + storage/lock/peer REST on one port.
 
 from __future__ import annotations
 
+import os
 import time
 import urllib.parse
 from dataclasses import dataclass
@@ -228,6 +229,22 @@ class Node:
         self.trace = GLOBAL_TRACE
         self.logger = GLOBAL_LOGGER
         self.notifier = EventNotifier()
+        from ..control.event_targets import configure_targets
+        from ..storage.format import SYS_DIR
+
+        # Durable event spool on the first local drive (queuestore.go keeps
+        # its spool under the local config dir too).
+        spool_root = ""
+        if self.local_drives:
+            first = next(iter(self.local_drives))
+            spool_root = os.path.join(first, SYS_DIR, "notify-spool")
+        self.notify_target_errors: dict[str, str] = {}
+
+        def _target_err(tid, e):
+            self.notify_target_errors[tid] = str(e)
+            GLOBAL_LOGGER.error(f"notify target {tid} disabled: {e}", exc=e)
+
+        configure_targets(self.notifier, self.config, spool_root, on_error=_target_err)
         self.healmgr = HealManager(self.pools)
         self.mrf = MRFQueue(self.pools)
         from ..control.healmgr import DiskHealMonitor
