@@ -154,18 +154,23 @@ def serve(argv: list[str]) -> int:
         p.error(str(e))
     _log(a.quiet, a.json, msg="endpoints", count=len(endpoints))
 
-    host, _, port_s = a.address.rpartition(":")
-    host = host or "0.0.0.0"
-    if host.startswith("[") and host.endswith("]"):
-        host = host[1:-1]  # bracketed IPv6 -> bare address for bind()
-    try:
-        port = int(port_s)
-    except ValueError:
-        p.error(f"--address must be [HOST]:PORT, got {a.address!r}")
+    host, port = _parse_address(p, a.address)
 
     from aiohttp import web
 
     from .dist.node import Node
+
+    if len(endpoints) == 1 and not endpoints[0].startswith(("http://", "https://")):
+        # Single path -> FS backend, no erasure (the reference picks FS for
+        # one endpoint, server-main.go:636-643) — UNLESS the path already
+        # holds an erasure format from an earlier deployment; silently
+        # switching backends would hide all existing data.
+        erasure_fmt = os.path.join(endpoints[0], ".minio_tpu.sys", "format.json")
+        if not os.path.exists(erasure_fmt):
+            return _serve_simple_layer(
+                "fs", endpoints[0], host, port, root_user, root_password, region, a
+            )
+        _log(a.quiet, a.json, msg="existing erasure format found; staying on erasure backend")
 
     node = Node(
         endpoints,
@@ -182,40 +187,10 @@ def serve(argv: list[str]) -> int:
     # format quorum (server-main.go:495-521 starts dist routers first).
     import threading
 
-    runner_ready = threading.Event()
     stop_evt = threading.Event()
-    thread_error: list[BaseException] = []
-
-    def _run_app():
-        import asyncio
-
-        loop = asyncio.new_event_loop()
-        asyncio.set_event_loop(loop)
-        runner = web.AppRunner(app)
-        try:
-            loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, host, port)
-            loop.run_until_complete(site.start())
-        except BaseException as e:  # noqa: BLE001 - surfaced to the main thread
-            thread_error.append(e)
-            runner_ready.set()
-            loop.close()
-            return
-        runner_ready.set()
-
-        async def _wait():
-            while not stop_evt.is_set():
-                await asyncio.sleep(0.2)
-
-        loop.run_until_complete(_wait())
-        loop.run_until_complete(runner.cleanup())
-        loop.close()
-
-    t = threading.Thread(target=_run_app, daemon=True, name="http-server")
-    t.start()
-    if not runner_ready.wait(10) or thread_error:
-        cause = f": {thread_error[0]}" if thread_error else ""
-        print(f"FATAL: HTTP server failed to start{cause}", file=sys.stderr)
+    t, startup_errors = _run_app_until(app, host, port, stop_evt)
+    if startup_errors:
+        print(f"FATAL: HTTP server failed to start: {startup_errors[0]}", file=sys.stderr)
         return 1
     _log(a.quiet, a.json, msg="listening", address=f"{host}:{port}")
 
@@ -266,6 +241,140 @@ def serve(argv: list[str]) -> int:
     return 0
 
 
+def _parse_address(p, address: str) -> tuple[str, int]:
+    host, _, port_s = address.rpartition(":")
+    host = host or "0.0.0.0"
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]  # bracketed IPv6 -> bare address for bind()
+    try:
+        return host, int(port_s)
+    except ValueError:
+        p.error(f"--address must be [HOST]:PORT, got {address!r}")
+
+
+def _run_app_until(app, host, port, stop_evt):
+    """Serve an aiohttp app on a background thread until stop_evt; returns
+    (thread, error_list) with the thread started and the socket bound (or an
+    error recorded)."""
+    import threading
+
+    from aiohttp import web
+
+    runner_ready = threading.Event()
+    thread_error: list[BaseException] = []
+
+    def _run_app():
+        import asyncio
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        runner = web.AppRunner(app)
+        try:
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, host, port)
+            loop.run_until_complete(site.start())
+        except BaseException as e:  # noqa: BLE001 - surfaced to the main thread
+            thread_error.append(e)
+            runner_ready.set()
+            loop.close()
+            return
+        runner_ready.set()
+
+        async def _wait():
+            while not stop_evt.is_set():
+                await asyncio.sleep(0.2)
+
+        loop.run_until_complete(_wait())
+        loop.run_until_complete(runner.cleanup())
+        loop.close()
+
+    t = threading.Thread(target=_run_app, daemon=True, name="http-server")
+    t.start()
+    if not runner_ready.wait(10) or thread_error:
+        return t, thread_error or [TimeoutError("startup timeout")]
+    return t, []
+
+
+def _serve_simple_layer(kind, target, host, port, root_user, root_password, region, a) -> int:
+    """Serve an S3 front over a non-erasure layer (FS backend / gateways) —
+    the reference's gateway-main.go + FS server path."""
+    import threading
+
+    from aiohttp import web
+
+    from .api.admin import ADMIN_PREFIX, make_admin_app, AdminContext
+    from .api.server import S3Server
+    from .control.config import ConfigSys
+    from .control.iam import IAMSys
+
+    if kind == "fs":
+        from .object.fs import FSObjectLayer
+
+        layer = FSObjectLayer(target)
+    elif kind == "nas":
+        from .object.gateway import NASGateway
+
+        layer = NASGateway(target)
+    elif kind == "s3":
+        from .object.gateway import S3Gateway
+
+        layer = S3Gateway(
+            target,
+            os.environ.get("MINIO_GATEWAY_ACCESS_KEY", root_user),
+            os.environ.get("MINIO_GATEWAY_SECRET_KEY", root_password),
+            region=os.environ.get("MINIO_GATEWAY_REGION", region),
+        )
+    else:
+        print(f"unknown gateway type {kind!r}; supported: nas, s3", file=sys.stderr)
+        return 2
+
+    config = ConfigSys()
+    iam = IAMSys(root_user, root_password)
+    srv = S3Server(layer, iam, region=region, check_skew=False, config=config)
+    app = web.Application(client_max_size=1 << 31)
+    app.add_subapp(
+        ADMIN_PREFIX,
+        make_admin_app(AdminContext(layer=layer, iam=iam, verifier=srv.verifier, config=config)),
+    )
+    app.router.add_route("*", "/{tail:.*}", srv._entry)
+
+    stop_evt = threading.Event()
+    t, startup_errors = _run_app_until(app, host, port, stop_evt)
+    if startup_errors:
+        print(f"FATAL: HTTP server failed to start: {startup_errors[0]}", file=sys.stderr)
+        return 1
+
+    def _shutdown(signum, frame):
+        _log(a.quiet, a.json, msg="shutting down", signal=signum)
+        stop_evt.set()
+
+    signal.signal(signal.SIGINT, _shutdown)
+    signal.signal(signal.SIGTERM, _shutdown)
+    mode = "fs" if kind == "fs" else f"gateway-{kind}"
+    _log(a.quiet, a.json, msg="online", mode=mode, target=target,
+         s3=f"http://{host}:{port}")
+    while not stop_evt.is_set():
+        time.sleep(0.2)
+    t.join(5)
+    return 0
+
+
+def gateway(argv: list[str]) -> int:
+    p = argparse.ArgumentParser(prog="minio_tpu gateway")
+    p.add_argument("type", choices=["nas", "s3"], help="gateway backend type")
+    p.add_argument("target", help="NAS mount path or backing S3 endpoint URL")
+    p.add_argument("--address", default=":9000")
+    p.add_argument("--region", default="")
+    p.add_argument("--quiet", action="store_true")
+    p.add_argument("--json", action="store_true")
+    a = p.parse_args(argv)
+    root_user = os.environ.get("MINIO_ROOT_USER", "minioadmin")
+    root_password = os.environ.get("MINIO_ROOT_PASSWORD", "minioadmin")
+    region = a.region or os.environ.get("MINIO_REGION", "us-east-1")
+    host, port = _parse_address(p, a.address)
+    return _serve_simple_layer(a.type, a.target, host, port, root_user, root_password, region, a)
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv or argv[0] in ("-h", "--help"):
@@ -274,7 +383,9 @@ def main(argv: list[str] | None = None) -> int:
     cmd, rest = argv[0], argv[1:]
     if cmd == "server":
         return serve(rest)
-    print(f"unknown command {cmd!r}; supported: server", file=sys.stderr)
+    if cmd == "gateway":
+        return gateway(rest)
+    print(f"unknown command {cmd!r}; supported: server, gateway", file=sys.stderr)
     return 2
 
 
