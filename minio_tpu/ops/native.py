@@ -24,12 +24,16 @@ _tried = False
 
 
 def _build() -> bool:
-    src = os.path.join(_NATIVE_DIR, "minio_native.cpp")
-    if not os.path.isfile(src):
-        return False
+    kernel = os.path.join(_NATIVE_DIR, "minio_native.cpp")
+    if not os.path.isfile(kernel):
+        return False  # the RS/HH kernels are mandatory; IO layer is additive
+    srcs = [kernel]
+    io_src = os.path.join(_NATIVE_DIR, "minio_io.cpp")
+    if os.path.isfile(io_src):
+        srcs.append(io_src)
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-fPIC", "-shared", "-o", _LIB_PATH, src],
+            ["g++", "-O3", "-march=native", "-fPIC", "-shared", "-o", _LIB_PATH, *srcs],
             check=True,
             capture_output=True,
             timeout=120,
@@ -59,6 +63,20 @@ def load() -> ctypes.CDLL | None:
             u8p, u8p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_size_t, u8p,
         ]
         lib.hh256_frame.argtypes = lib.hh256_batch.argtypes
+        # IO layer (native/minio_io.cpp); absent in stale prebuilt libraries.
+        try:
+            lib.mt_write_file.argtypes = [
+                ctypes.c_char_p, u8p, ctypes.c_size_t, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.mt_write_file.restype = ctypes.c_longlong
+            lib.mt_read_file.argtypes = [
+                ctypes.c_char_p, u8p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_int,
+            ]
+            lib.mt_read_file.restype = ctypes.c_longlong
+            lib.mt_odirect_supported.argtypes = [ctypes.c_char_p]
+            lib.mt_odirect_supported.restype = ctypes.c_int
+        except AttributeError:
+            pass
         _lib = lib
         return _lib
 
@@ -122,3 +140,44 @@ def hh256_frame(data: np.ndarray, key: bytes) -> bytes:
     out = np.empty(n * (32 + length), dtype=np.uint8)
     lib.hh256_frame(_ptr(keya), _ptr(data), length, length, n, _ptr(out))
     return out.tobytes()
+
+
+# -- native IO (O_DIRECT aligned file path; xl-storage.go CreateFile role) ---
+
+
+def io_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "mt_write_file")
+
+
+def odirect_supported(dirpath: str) -> bool:
+    lib = load()
+    if lib is None or not hasattr(lib, "mt_odirect_supported"):
+        return False
+    return bool(lib.mt_odirect_supported(dirpath.encode()))
+
+
+def write_file(path: str, data: bytes, use_odirect: bool = True, fsync: bool = False) -> None:
+    """Native aligned write; raises OSError on failure."""
+    lib = load()
+    assert lib is not None and hasattr(lib, "mt_write_file")
+    arr = np.frombuffer(data, dtype=np.uint8) if data else np.empty(0, dtype=np.uint8)
+    rc = lib.mt_write_file(
+        path.encode(), _ptr(arr) if len(arr) else None, len(data),
+        1 if use_odirect else 0, 1 if fsync else 0,
+    )
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+
+
+def read_file(path: str, size: int, offset: int = 0, use_odirect: bool = True) -> bytes:
+    """Native read (possibly short at EOF); raises OSError on failure."""
+    lib = load()
+    assert lib is not None and hasattr(lib, "mt_read_file")
+    out = np.empty(max(size, 1), dtype=np.uint8)
+    rc = lib.mt_read_file(
+        path.encode(), _ptr(out), size, offset, 1 if use_odirect else 0
+    )
+    if rc < 0:
+        raise OSError(-rc, os.strerror(-rc), path)
+    return out[: int(rc)].tobytes()

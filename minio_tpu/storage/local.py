@@ -47,6 +47,11 @@ def _check_vol_name(volume: str) -> None:
         raise errors.VolumeNotFound()
 
 
+# Files at or above this size take the native O_DIRECT path (the reference
+# switches off buffered IO above smallFileThreshold, xl-storage.go:59).
+ODIRECT_THRESHOLD = 128 * 1024
+
+
 class LocalDrive(StorageAPI):
     """A single local drive. Thread-safe; xl.meta read-modify-writes are
     serialized per drive (coarse; the object layer's namespace lock is the
@@ -60,6 +65,23 @@ class LocalDrive(StorageAPI):
         self._disk_id: str | None = None
         os.makedirs(os.path.join(self.root, TMP_DIR), exist_ok=True)
         os.makedirs(os.path.join(self.root, BUCKETS_META_DIR), exist_ok=True)
+        # Native O_DIRECT path for large shard files (xl-storage.go:1708
+        # CopyAligned; probed per drive like internal/disk's O_DIRECT check).
+        self._odirect: bool | None = None
+
+    def _use_native_io(self, size: int) -> bool:
+        if size < ODIRECT_THRESHOLD:
+            return False
+        from ..ops import native
+
+        if not native.io_available():
+            return False
+        if self._odirect is None:
+            try:
+                self._odirect = native.odirect_supported(self.root)
+            except OSError:
+                self._odirect = False
+        return True  # native writer handles the no-O_DIRECT fallback itself
 
     # -- identity ----------------------------------------------------------
 
@@ -203,9 +225,21 @@ class LocalDrive(StorageAPI):
 
     def create_file(self, volume: str, path: str, data: bytes) -> None:
         """Write a (bitrot-protected) shard file. Callers stage under tmp
-        volume then rename_data into place."""
+        volume then rename_data into place. Large files take the native
+        O_DIRECT aligned path (xl-storage.go:1708); small ones buffered
+        (<=128 KiB uses O_DSYNC-style buffered writes in the reference)."""
         p = self._file_path(volume, path)
         os.makedirs(os.path.dirname(p), exist_ok=True)
+        if self._use_native_io(len(data)):
+            from ..ops import native
+
+            try:
+                native.write_file(
+                    p, data, use_odirect=bool(self._odirect), fsync=self.fsync
+                )
+                return
+            except OSError:
+                pass  # native path failed; buffered fallback below
         with open(p, "wb") as f:
             f.write(data)
             if self.fsync:
@@ -220,6 +254,19 @@ class LocalDrive(StorageAPI):
 
     def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes:
         p = self._file_path(volume, path)
+        if self._use_native_io(length):
+            from ..ops import native
+
+            try:
+                return native.read_file(
+                    p, length, offset, use_odirect=bool(self._odirect)
+                )
+            except OSError as e:
+                import errno as errno_mod
+
+                if e.errno == errno_mod.ENOENT:
+                    raise errors.FileNotFound()
+                # other native failure: buffered fallback below
         try:
             with open(p, "rb") as f:
                 f.seek(offset)

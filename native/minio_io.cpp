@@ -1,0 +1,178 @@
+// Native IO layer: O_DIRECT aligned writes/reads (xl-storage's hot file path).
+//
+// Role of the reference's ncw/directio + internal/ioutil CopyAligned
+// (cmd/xl-storage.go:1653-1740 CreateFile): large shard files are written
+// through O_DIRECT with pooled aligned buffers so streaming uploads don't
+// churn the page cache; the final unaligned tail drops O_DIRECT via fcntl
+// (ioutil.DisableDirectIO) and writes normally; fdatasync seals the file.
+// Reads mirror it (xl-storage.go ReadFileStream opens O_DIRECT for large
+// files).
+//
+// Filesystems without O_DIRECT (tmpfs, some overlays) fall back to buffered
+// IO transparently — same behavior as the reference's disk.ODirectPlatform
+// probe. Exposed via ctypes from the same libminio_native.so as the
+// RS/HighwayHash kernels.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#ifndef O_DIRECT
+#define O_DIRECT 0
+#endif
+
+namespace {
+
+constexpr size_t kAlign = 4096;        // logical block alignment
+constexpr size_t kChunk = 4 << 20;     // 4 MiB staging buffer (ODirectPoolLarge)
+
+struct AlignedBuf {
+    uint8_t* p = nullptr;
+    AlignedBuf(size_t n) {
+        if (posix_memalign(reinterpret_cast<void**>(&p), kAlign, n) != 0) p = nullptr;
+    }
+    ~AlignedBuf() { free(p); }
+};
+
+bool disable_odirect(int fd) {
+    int flags = fcntl(fd, F_GETFL);
+    if (flags < 0) return false;
+    return fcntl(fd, F_SETFL, flags & ~O_DIRECT) == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Probe whether a directory's filesystem accepts O_DIRECT
+// (internal/disk/directio probe role). Returns 1/0.
+int mt_odirect_supported(const char* dirpath) {
+    if (O_DIRECT == 0) return 0;
+    char tmpl[4096];
+    snprintf(tmpl, sizeof(tmpl), "%s/.odirect-probe-XXXXXX", dirpath);
+    int fd = mkstemp(tmpl);
+    if (fd < 0) return 0;
+    close(fd);
+    int dfd = open(tmpl, O_WRONLY | O_DIRECT);
+    unlink(tmpl);
+    if (dfd < 0) return 0;
+    close(dfd);
+    return 1;
+}
+
+// Write `size` bytes to `path` (O_CREAT|O_TRUNC). Aligned body goes through
+// O_DIRECT when the filesystem supports it; the tail is written buffered
+// after dropping O_DIRECT (CopyAligned semantics). Returns bytes written,
+// or -errno.
+long long mt_write_file(const char* path, const uint8_t* data, size_t size,
+                        int use_odirect, int do_fsync) {
+    int flags = O_WRONLY | O_CREAT | O_TRUNC;
+    bool odirect = use_odirect && O_DIRECT != 0 && size >= kAlign;
+    int fd = -1;
+    if (odirect) {
+        fd = open(path, flags | O_DIRECT, 0644);
+        if (fd < 0 && (errno == EINVAL || errno == EOPNOTSUPP)) odirect = false;
+    }
+    if (fd < 0) fd = open(path, flags, 0644);
+    if (fd < 0) return -static_cast<long long>(errno);
+
+    size_t off = 0;
+    if (odirect) {
+        AlignedBuf buf(kChunk);
+        if (!buf.p) { close(fd); return -static_cast<long long>(ENOMEM); }
+        size_t aligned_end = size - (size % kAlign);
+        while (off < aligned_end) {
+            size_t n = aligned_end - off;
+            if (n > kChunk) n = kChunk;
+            memcpy(buf.p, data + off, n);
+            ssize_t w = write(fd, buf.p, n);
+            if (w < 0) {
+                if (errno == EINVAL && off == 0 && disable_odirect(fd)) {
+                    odirect = false;  // fs lied at open; fall back buffered
+                    break;
+                }
+                int e = errno; close(fd); return -static_cast<long long>(e);
+            }
+            off += static_cast<size_t>(w);
+        }
+        if (odirect && off < size) {
+            // Unaligned tail: drop O_DIRECT (ioutil.DisableDirectIO) and
+            // write the remainder buffered.
+            if (!disable_odirect(fd)) { int e = errno; close(fd); return -static_cast<long long>(e); }
+        }
+    }
+    while (off < size) {
+        ssize_t w = write(fd, data + off, size - off);
+        if (w < 0) { int e = errno; close(fd); return -static_cast<long long>(e); }
+        off += static_cast<size_t>(w);
+    }
+    if (do_fsync && fdatasync(fd) != 0) {
+        int e = errno; close(fd); return -static_cast<long long>(e);
+    }
+    if (close(fd) != 0) return -static_cast<long long>(errno);
+    return static_cast<long long>(off);
+}
+
+// Read `size` bytes at `offset` into `out`. Uses O_DIRECT with an aligned
+// bounce buffer when requested and supported, else plain pread. Returns
+// bytes read (may be short at EOF) or -errno.
+long long mt_read_file(const char* path, uint8_t* out, size_t size,
+                       size_t offset, int use_odirect) {
+    bool odirect = use_odirect && O_DIRECT != 0;
+    int fd = -1;
+    if (odirect) {
+        fd = open(path, O_RDONLY | O_DIRECT);
+        if (fd < 0 && (errno == EINVAL || errno == EOPNOTSUPP)) odirect = false;
+    }
+    if (fd < 0) fd = open(path, O_RDONLY);
+    if (fd < 0) return -static_cast<long long>(errno);
+
+    size_t got = 0;
+    if (odirect) {
+        AlignedBuf buf(kChunk);
+        if (!buf.p) { close(fd); return -static_cast<long long>(ENOMEM); }
+        // Aligned window covering [offset, offset+size).
+        size_t astart = offset - (offset % kAlign);
+        size_t lead = offset - astart;
+        size_t pos = astart;
+        while (got < size) {
+            // Clamp to the align-rounded remainder: a 128 KiB read must not
+            // pull a 4 MiB chunk off the disk.
+            size_t want = lead + (size - got);
+            want = ((want + kAlign - 1) / kAlign) * kAlign;
+            if (want > kChunk) want = kChunk;
+            ssize_t r = pread(fd, buf.p, want, static_cast<off_t>(pos));
+            if (r < 0) {
+                if (errno == EINVAL && pos == astart) { odirect = false; break; }
+                int e = errno; close(fd); return -static_cast<long long>(e);
+            }
+            if (r == 0) break;  // EOF
+            size_t usable = static_cast<size_t>(r) > lead ? static_cast<size_t>(r) - lead : 0;
+            size_t n = usable < size - got ? usable : size - got;
+            memcpy(out + got, buf.p + lead, n);
+            got += n;
+            pos += static_cast<size_t>(r);
+            lead = 0;
+            if (static_cast<size_t>(r) < want) break;  // EOF within chunk
+        }
+    }
+    if (!odirect) {
+        while (got < size) {
+            ssize_t r = pread(fd, out + got, size - got, static_cast<off_t>(offset + got));
+            if (r < 0) { int e = errno; close(fd); return -static_cast<long long>(e); }
+            if (r == 0) break;
+            got += static_cast<size_t>(r);
+        }
+    }
+    close(fd);
+    return static_cast<long long>(got);
+}
+
+}  // extern "C"

@@ -69,3 +69,64 @@ def test_host_codec_native_matches_plain():
     b = HostCodec(use_native=False).encode([block], 12, 4)
     assert a[0][0] == b[0][0]
     assert a[0][1] == b[0][1]
+
+
+# -- native IO layer (native/minio_io.cpp) -----------------------------------
+
+
+class TestNativeIO:
+    def test_roundtrip_various_sizes(self, tmp_path):
+        import os
+
+        from minio_tpu.ops import native
+
+        if not native.io_available():
+            pytest.skip("native lib unavailable")
+        for size in (0, 1, 4095, 4096, 4097, 1 << 20, (4 << 20) + 77):
+            data = os.urandom(size)
+            p = str(tmp_path / f"f{size}")
+            native.write_file(p, data, fsync=True)
+            assert open(p, "rb").read() == data, size
+            assert native.read_file(p, size) == data, size
+
+    def test_offset_reads(self, tmp_path):
+        import os
+
+        from minio_tpu.ops import native
+
+        if not native.io_available():
+            pytest.skip("native lib unavailable")
+        data = os.urandom(2 << 20)
+        p = str(tmp_path / "off")
+        native.write_file(p, data)
+        assert native.read_file(p, 1000, offset=0) == data[:1000]
+        assert native.read_file(p, 1000, offset=4096) == data[4096:5096]
+        assert native.read_file(p, 1000, offset=12345) == data[12345:13345]
+        # Short read past EOF.
+        assert native.read_file(p, 1 << 20, offset=(2 << 20) - 100) == data[-100:]
+
+    def test_error_on_missing(self, tmp_path):
+        from minio_tpu.ops import native
+
+        if not native.io_available():
+            pytest.skip("native lib unavailable")
+        with pytest.raises(OSError):
+            native.read_file(str(tmp_path / "nope"), 100)
+
+    def test_local_drive_large_files_take_native_path(self, tmp_path):
+        import os
+
+        from minio_tpu.ops import native
+        from minio_tpu.storage.local import ODIRECT_THRESHOLD, LocalDrive
+
+        if not native.io_available():
+            pytest.skip("native lib unavailable")
+        d = LocalDrive(str(tmp_path / "drive"))
+        d.make_vol("vol")
+        big = os.urandom(ODIRECT_THRESHOLD + 1234)
+        d.create_file("vol", "big.bin", big)
+        assert d.read_file("vol", "big.bin", 0, len(big)) == big
+        assert d._odirect is not None  # probe ran on the native path
+        small = b"s" * 1000
+        d.create_file("vol", "small.bin", small)
+        assert d.read_file("vol", "small.bin", 0, -1) == small
