@@ -120,6 +120,11 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             info["servers"] = ctx.notification.server_info_all()
         return info
 
+    def h_healthinfo(request, body):
+        from ..control.health import health_info
+
+        return health_info(ctx.layer)
+
     def h_datausage(request, body):
         if ctx.scanner is None:
             return {}
@@ -478,6 +483,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_post("/site-replication/peer/iam", handler(h_sr_peer_iam))
     app.router.add_post("/site-replication/peer/install-replication", handler(h_sr_peer_install_repl))
     app.router.add_get("/info", handler(h_info))
+    app.router.add_get("/healthinfo", handler(h_healthinfo))
     app.router.add_get("/datausage", handler(h_datausage))
     app.router.add_get("/config", handler(h_get_config))
     app.router.add_put("/config", handler(h_set_config))

@@ -25,6 +25,7 @@ from ..object import codec as codec_mod
 from ..object.pools import ServerPools
 from ..object.sets import ErasureSets
 from ..storage import format as fmt_mod
+from ..storage.interface import StorageAPI
 from ..storage.local import LocalDrive
 from ..utils import errors
 from .locks import LOCK_PREFIX, LocalLocker, NamespaceLock, RemoteLocker, make_lock_app
@@ -72,12 +73,17 @@ class Node:
         self.codec = codec
 
         # Drive construction: local paths open directly, remote via REST.
-        self.local_drives: dict[str, LocalDrive] = {}
+        self.local_drives: dict[str, StorageAPI] = {}
         self.drives = []
         peer_urls: set[str] = set()
+        from ..control.pubsub import GLOBAL_TRACE
+        from ..storage.metered import MeteredDrive
+
         for ep in self.endpoints:
             if ep.is_local_path or ep.url == self.url:
-                d = LocalDrive(ep.path)
+                # Local drives are metered (per-API latency EWMAs + storage
+                # traces, xl-storage-disk-id-check.go role).
+                d = MeteredDrive(LocalDrive(ep.path), trace=GLOBAL_TRACE)
                 self.local_drives[ep.path] = d
                 self.drives.append(d)
             else:

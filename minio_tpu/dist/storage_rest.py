@@ -40,7 +40,7 @@ def _fi_unpack(d: dict) -> FileInfo:
 # ---------------------------------------------------------------------------
 
 
-def make_storage_app(drives: dict[str, LocalDrive], token: str) -> web.Application:
+def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Application:
     """drives: url-path -> LocalDrive (e.g. "/data/disk0" -> LocalDrive)."""
     app = web.Application(client_max_size=1 << 31)
 

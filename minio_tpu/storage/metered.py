@@ -1,0 +1,109 @@
+"""Per-drive API metering: latency EWMAs + storage call tracing.
+
+Role of the reference's xlStorageDiskIDCheck (cmd/xl-storage-disk-id-check.go
+:68,:74,:585): every StorageAPI call through a drive is timed into a
+per-API exponentially-weighted moving average, and published to the trace
+hub when someone is watching (`mc admin trace --call storage`), at zero
+cost otherwise (NumSubscribers guard, :580-588).
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+
+# StorageAPI methods that hit the disk (the metered set).
+_METERED = frozenset(
+    (
+        "disk_info make_vol stat_vol list_vols delete_vol write_all read_all "
+        "delete create_file append_file read_file stat_file read_xl "
+        "read_version write_metadata update_metadata delete_version "
+        "rename_data rename_file list_dir walk_dir verify_file"
+    ).split()
+)
+
+_EWMA_ALPHA = 0.3  # same smoothing idea as the reference's diskMaxTimeout ewma
+
+
+class MeteredDrive:
+    """Transparent StorageAPI decorator. Everything delegates to the inner
+    drive; metered methods are timed."""
+
+    def __init__(self, inner, trace=None):
+        # __dict__ assignment avoids recursing through __setattr__/__getattr__.
+        self.__dict__["inner"] = inner
+        self.__dict__["trace"] = trace
+        self.__dict__["_lat"] = {}
+        self.__dict__["_counts"] = {}
+        self.__dict__["_errors"] = {}
+        self.__dict__["_lock"] = threading.Lock()
+
+    def __getattr__(self, name):
+        attr = getattr(self.inner, name)
+        if name not in _METERED or not callable(attr):
+            return attr
+
+        def record(t0: float, failed: bool) -> None:
+            ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                if failed:
+                    self._errors[name] = self._errors.get(name, 0) + 1
+                prev = self._lat.get(name)
+                self._lat[name] = (
+                    ms if prev is None else prev + _EWMA_ALPHA * (ms - prev)
+                )
+                self._counts[name] = self._counts.get(name, 0) + 1
+            trace = self.trace
+            if trace is not None and trace.enabled():
+                trace.publish(
+                    "storage",
+                    call=name,
+                    drive=self.inner.endpoint(),
+                    duration_ms=round(ms, 3),
+                )
+
+        if inspect.isgeneratorfunction(getattr(type(self.inner), name, None)):
+            # Generators (walk_dir): time the FULL iteration and count errors
+            # raised mid-stream — timing creation alone would always read 0.
+            def timed_gen(*args, **kwargs):
+                t0 = time.perf_counter()
+                try:
+                    yield from attr(*args, **kwargs)
+                except Exception:
+                    record(t0, failed=True)
+                    raise
+                record(t0, failed=False)
+
+            return timed_gen
+
+        def timed(*args, **kwargs):
+            t0 = time.perf_counter()
+            try:
+                out = attr(*args, **kwargs)
+            except Exception:
+                record(t0, failed=True)
+                raise
+            record(t0, failed=False)
+            return out
+
+        return timed
+
+    def __setattr__(self, name, value):
+        if name in self.__dict__:
+            self.__dict__[name] = value  # wrapper-owned fields stay here
+        else:
+            setattr(self.inner, name, value)
+
+    # -- metrics surface (healthinfo / admin info read these) ----------------
+
+    def api_latencies(self) -> dict:
+        with self._lock:
+            return {
+                name: {
+                    "ewma_ms": round(self._lat[name], 3),
+                    "count": self._counts.get(name, 0),
+                    "errors": self._errors.get(name, 0),
+                }
+                for name in sorted(self._lat)
+            }

@@ -156,7 +156,10 @@ class TestAdmin:
                 for line in r.iter_lines():
                     if line:
                         results.append(json.loads(line))
-                        break
+                        # Storage traces interleave with HTTP ones now that
+                        # drives are metered; read until an http trace shows.
+                        if results[-1]["type"] == "http" or len(results) > 50:
+                            break
 
         t = threading.Thread(target=consume, daemon=True)
         t.start()
@@ -165,7 +168,7 @@ class TestAdmin:
             c.request("GET", "/")
             time.sleep(0.1)
         t.join(5)
-        assert results and results[0]["type"] == "http"
+        assert any(item["type"] == "http" for item in results), results[:3]
 
 
 class TestSTS:

@@ -1,0 +1,95 @@
+"""Health probes + per-drive metering (reference healthinfo + disk-id-check)."""
+
+import json
+
+import pytest
+
+from minio_tpu.control import health
+from minio_tpu.control.pubsub import TraceSys
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.storage.metered import MeteredDrive
+from minio_tpu.utils import errors
+
+
+def test_probes_return_sane_shapes():
+    cpu = health.cpu_info()
+    assert cpu["cores"] > 0
+    mem = health.mem_info()
+    assert mem.get("memtotal", 0) > 0
+    osn = health.os_info()
+    assert osn["kernel"] and osn["uptime_seconds"] > 0
+    assert isinstance(health.disk_iostats(), list)
+    mounts = health.mount_info()
+    assert any(m["mountpoint"] == "/" for m in mounts)
+    assert isinstance(health.net_info(), list)
+    info = health.health_info()
+    assert set(info) >= {"timestamp", "cpu", "memory", "os", "iostats", "mounts", "network"}
+    json.dumps(info)  # JSON-serializable end to end
+
+
+def test_metered_drive_records_latencies(tmp_path):
+    d = MeteredDrive(LocalDrive(str(tmp_path)))
+    d.make_vol("v")
+    d.write_all("v", "f", b"x" * 1000)
+    assert d.read_all("v", "f") == b"x" * 1000
+    lat = d.api_latencies()
+    assert lat["write_all"]["count"] == 1
+    assert lat["read_all"]["count"] == 1
+    assert lat["make_vol"]["ewma_ms"] >= 0
+    # Errors counted separately.
+    with pytest.raises(errors.FileNotFound):
+        d.read_all("v", "missing")
+    assert d.api_latencies()["read_all"]["errors"] == 1
+    # Non-storage attributes pass through untouched.
+    assert d.endpoint() == d.inner.endpoint()
+    assert d.is_local()
+
+
+def test_metered_drive_traces_when_subscribed(tmp_path):
+    trace = TraceSys()
+    d = MeteredDrive(LocalDrive(str(tmp_path)), trace=trace)
+    d.make_vol("v")
+    sub = trace.hub.subscribe()
+    d.write_all("v", "f", b"data")
+    item = sub.get(timeout=2)
+    assert item["type"] == "storage" and item["call"] == "write_all"
+    trace.hub.unsubscribe(sub)
+    # Zero-cost when nobody watches: publish path not taken (no exception,
+    # nothing queued).
+    d.write_all("v", "f2", b"data")
+    assert sub.empty()
+
+
+def test_healthinfo_includes_drives(tmp_path):
+    from minio_tpu.object.pools import ServerPools
+    from minio_tpu.object.sets import ErasureSets
+    from tests.harness import ErasureHarness
+
+    hz = ErasureHarness(tmp_path, n_disks=4)
+    metered = [MeteredDrive(d) for d in hz.drives]
+    layer = ServerPools([ErasureSets(metered, 4)])
+    layer.make_bucket("healthbkt")
+    layer.put_object("healthbkt", "o", b"x" * 1000)
+    info = health.health_info(layer)
+    assert len(info["drives"]) == 4
+    for entry in info["drives"]:
+        assert entry["state"] == "ok"
+        assert entry["total"] > 0
+        assert "api_latencies_ms" in entry
+        assert entry["api_latencies_ms"]  # put recorded calls
+
+
+def test_metered_walk_dir_times_full_iteration(tmp_path):
+    d = MeteredDrive(LocalDrive(str(tmp_path)))
+    d.make_vol("v")
+    for i in range(5):
+        d.write_all("v", f"o{i}/xl.meta", b"m")
+    names = [n for n, _ in d.walk_dir("v")]
+    assert len(names) == 5
+    lat = d.api_latencies()
+    assert lat["walk_dir"]["count"] == 1
+    assert lat["walk_dir"]["ewma_ms"] > 0  # full-iteration time, not creation
+    # Errors raised mid-iteration are counted.
+    with pytest.raises(errors.StorageError):
+        list(d.walk_dir("missing-vol"))
+    assert d.api_latencies()["walk_dir"]["errors"] == 1
