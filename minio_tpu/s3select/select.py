@@ -9,7 +9,9 @@ rows, and frames them as AWS event-stream messages.
 from __future__ import annotations
 
 import io
+import struct as struct_mod
 import xml.etree.ElementTree as ET
+import zlib
 from dataclasses import dataclass, field
 from typing import Callable, Iterator, List, Optional
 
@@ -193,10 +195,6 @@ def run_select(
         stmt = parse(req.expression)
     except SQLParseError as e:
         raise SelectError("ParseSelectFailure", str(e)) from None
-    if req.input_format == "parquet":
-        # Parquet needs a columnar reader; gated like the reference's
-        # api.select_parquet config flag (off by default).
-        raise SelectError("UnsupportedParquet", "Parquet input is not enabled", 501)
 
     try:
         executor = StatementExecutor(stmt)
@@ -213,7 +211,28 @@ def run_select(
         raise SelectError("InvalidCompressionFormat", f"decompress failed: {e}") from None
     processed = len(data)
 
-    if req.input_format == "csv":
+    if req.input_format == "parquet":
+        from . import parquet as parquet_mod
+        from .records import JSONRecord
+
+        if req.scan_start is not None or req.scan_end is not None:
+            # AWS/the reference reject ScanRange for parquet (it is only
+            # defined for CSV/JSON byte streams).
+            raise SelectError(
+                "UnsupportedScanRangeInput", "ScanRange is not supported for Parquet"
+            )
+        try:
+            _names, rows = parquet_mod.read_rows(data)
+        except parquet_mod.ParquetError as e:
+            raise SelectError("InvalidDataSource", f"parquet: {e}") from None
+        except (IndexError, KeyError, struct_mod.error, zlib.error, ValueError) as e:
+            # Hand-rolled binary parser: any malformed-input failure mode is
+            # the same client error, never a 500.
+            raise SelectError(
+                "InvalidDataSource", f"parquet: corrupt file ({type(e).__name__})"
+            ) from None
+        records = (JSONRecord(row) for row in rows)
+    elif req.input_format == "csv":
         records = csv_records(data, req.csv_args, req.scan_start, req.scan_end)
     else:
         records = json_records(data, req.json_args, req.scan_start, req.scan_end)
