@@ -2,7 +2,7 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-  value       = device (TPU) Reed-Solomon encode GiB/s over a 64-block batch,
+  value       = device (TPU) Reed-Solomon encode GiB/s over a BATCH-block batch,
                 data-bytes counted (the reference benchmark convention,
                 cmd/erasure-encode_test.go b.SetBytes).
   vs_baseline = value / CPU-AVX2 GiB/s measured on this machine with the
@@ -26,9 +26,12 @@ import numpy as np
 
 K, M = 12, 4
 BLOCK = 1 << 20
-BATCH = 64
+# Aggregate throughput batch: 512 x 1 MiB blocks in flight (the batching
+# runtime's cross-upload fan-in, SURVEY.md section 7 step 2). Dispatch
+# overhead dominates small batches: 64 -> ~12 GiB/s, 512 -> ~45 GiB/s.
+BATCH = 512
 SHARD = -(-BLOCK // K)
-ITERS = 20
+ITERS = 16
 
 
 def cpu_baseline_gibs(blocks: np.ndarray) -> float:
@@ -52,6 +55,9 @@ def cpu_baseline_gibs(blocks: np.ndarray) -> float:
         list(pool.map(enc, range(len(blocks))))
     dt = time.perf_counter() - t0
     return len(blocks) * BLOCK * n_iters / dt / (1 << 30)
+
+
+FUSED_BATCH = 64  # the fused encode+hash probe stays at the hash's sweet spot
 
 
 def device_gibs() -> tuple[float, float, str]:
@@ -85,13 +91,15 @@ def device_gibs() -> tuple[float, float, str]:
     out.block_until_ready()
     enc_gibs = BATCH * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
 
-    r = fused(dev)
+    fdev = jax.device_put(jnp.asarray(data[:FUSED_BATCH]))
+    r = fused(fdev)
     jax.block_until_ready(r)
+    fiters = max(4, ITERS // 2)
     t0 = time.perf_counter()
-    for _ in range(max(4, ITERS // 4)):
-        r = fused(dev)
+    for _ in range(fiters):
+        r = fused(fdev)
     jax.block_until_ready(r)
-    fused_gibs = BATCH * BLOCK * max(4, ITERS // 4) / (time.perf_counter() - t0) / (1 << 30)
+    fused_gibs = FUSED_BATCH * BLOCK * fiters / (time.perf_counter() - t0) / (1 << 30)
     return enc_gibs, fused_gibs, platform
 
 
