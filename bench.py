@@ -1,14 +1,23 @@
-"""Benchmark: erasure-encode throughput, 12+4 @ 1 MiB blocks (BASELINE.md #1).
+"""Benchmark: erasure codec throughput, 12+4 @ 1 MiB blocks (BASELINE.md).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-  value       = device (TPU) Reed-Solomon encode GiB/s over a BATCH-block batch,
+  value       = device Reed-Solomon encode GiB/s over a BATCH-block batch,
                 data-bytes counted (the reference benchmark convention,
                 cmd/erasure-encode_test.go b.SetBytes).
   vs_baseline = value / CPU-AVX2 GiB/s measured on this machine with the
                 native C++ kernel (native/minio_native.cpp) across all cores
                 -- the stand-in for klauspost/reedsolomon's AVX2 path, same
                 nibble-table algorithm the Go assembly uses.
+
+Extra fields carry the secondary BASELINE configs: fused encode+hash,
+decode/reconstruct with 4 missing data shards (BASELINE.md #2), and the CPU
+numbers each is measured against.
+
+If device init fails or wedges (tunnel flake), the line reports the CPU
+numbers honestly: "device": false, vs_baseline 0.0 -- a fallback is not
+parity. Device init is probed in a bounded subprocess (retried once) before
+the in-process run, and the run itself sits under a watchdog alarm.
 
 Run directly on the bench machine: python bench.py
 """
@@ -25,30 +34,33 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 K, M = 12, 4
-BLOCK = 1 << 20
+BLOCK = int(os.environ.get("BENCH_BLOCK", str(1 << 20)))
 # Aggregate throughput batch: 512 x 1 MiB blocks in flight (the batching
 # runtime's cross-upload fan-in, SURVEY.md section 7 step 2). Dispatch
-# overhead dominates small batches: 64 -> ~12 GiB/s, 512 -> ~45 GiB/s.
-BATCH = 512
+# overhead dominates small batches.
+BATCH = int(os.environ.get("BENCH_BATCH", "512"))
 SHARD = -(-BLOCK // K)
 ITERS = 16
+PROBE_TIMEOUT_S = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+
+# 4 missing data shards: rows 0..3 lost, rebuilt from shards 4..15.
+MISSING = (0, 1, 2, 3)
+PRESENT = tuple(i not in MISSING for i in range(K + M))
 
 
-def cpu_baseline_gibs(blocks: np.ndarray) -> float:
+def cpu_encode_gibs(blocks: np.ndarray) -> float:
     """Multi-core AVX2 encode throughput (data GiB/s)."""
     from minio_tpu.ops import native, rs_matrix
 
     if not native.available():
         return 0.0
     pm = np.ascontiguousarray(rs_matrix.parity_matrix(K, M))
-    nproc = os.cpu_count() or 1
-    pool = ThreadPoolExecutor(max_workers=nproc)
+    pool = ThreadPoolExecutor(max_workers=os.cpu_count() or 1)
 
     def enc(i):
         native.rs_encode(blocks[i], pm)
 
-    # Warmup.
-    list(pool.map(enc, range(len(blocks))))
+    list(pool.map(enc, range(len(blocks))))  # warmup
     t0 = time.perf_counter()
     n_iters = max(4, ITERS // 2)
     for _ in range(n_iters):
@@ -57,11 +69,38 @@ def cpu_baseline_gibs(blocks: np.ndarray) -> float:
     return len(blocks) * BLOCK * n_iters / dt / (1 << 30)
 
 
+def cpu_decode_gibs(blocks: np.ndarray) -> float:
+    """Multi-core reconstruct-4-missing throughput (data GiB/s)."""
+    from minio_tpu.ops import native, rs_matrix
+
+    if not native.available():
+        return 0.0
+    coeffs = np.ascontiguousarray(rs_matrix.reconstruct_rows(K, M, PRESENT, MISSING))
+    # Survivors: first K present rows of the encoded block.
+    pm = np.ascontiguousarray(rs_matrix.parity_matrix(K, M))
+    surv = []
+    for i in range(len(blocks)):
+        full = np.concatenate([blocks[i], native.rs_encode(blocks[i], pm)], axis=0)
+        surv.append(np.ascontiguousarray(full[[j for j in range(K + M) if PRESENT[j]][:K]]))
+    pool = ThreadPoolExecutor(max_workers=os.cpu_count() or 1)
+
+    def rec(i):
+        native.rs_apply(surv[i], coeffs)
+
+    list(pool.map(rec, range(len(blocks))))  # warmup
+    t0 = time.perf_counter()
+    n_iters = max(4, ITERS // 2)
+    for _ in range(n_iters):
+        list(pool.map(rec, range(len(blocks))))
+    dt = time.perf_counter() - t0
+    return len(blocks) * BLOCK * n_iters / dt / (1 << 30)
+
+
 FUSED_BATCH = 64  # the fused encode+hash probe stays at the hash's sweet spot
 
 
-def device_gibs() -> tuple[float, float, str]:
-    """(encode_gibs, fused_encode_hash_gibs, platform)."""
+def device_metrics() -> dict:
+    """Encode / fused encode+hash / reconstruct GiB/s on the live device."""
     import jax
     import jax.numpy as jnp
 
@@ -91,55 +130,104 @@ def device_gibs() -> tuple[float, float, str]:
     out.block_until_ready()
     enc_gibs = BATCH * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
 
+    # Reconstruct 4 missing data shards from the 12 surviving rows.
+    w = codec.reconstruct_weights(PRESENT, MISSING)
+    full = np.asarray(codec.encode_all(dev))
+    surv = jnp.asarray(full[:, [j for j in range(K + M) if PRESENT[j]][:K], :])
+    recon = jax.jit(lambda s: codec.apply(s, w))
+    recon(surv).block_until_ready()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = recon(surv)
+    out.block_until_ready()
+    dec_gibs = BATCH * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
+
     fdev = jax.device_put(jnp.asarray(data[:FUSED_BATCH]))
-    r = fused(fdev)
-    jax.block_until_ready(r)
+    jax.block_until_ready(fused(fdev))
     fiters = max(4, ITERS // 2)
     t0 = time.perf_counter()
     for _ in range(fiters):
         r = fused(fdev)
     jax.block_until_ready(r)
     fused_gibs = FUSED_BATCH * BLOCK * fiters / (time.perf_counter() - t0) / (1 << 30)
-    return enc_gibs, fused_gibs, platform
+    return {
+        "platform": platform,
+        "encode_gibs": enc_gibs,
+        "decode_recon4_gibs": dec_gibs,
+        "fused_encode_hash_gibs": fused_gibs,
+    }
+
+
+def probe_device(timeout_s: float) -> str | None:
+    """Bounded device-init probe, retried once (tunnel init can flake)."""
+    from minio_tpu.runtime import probe_device as probe_once
+
+    for _ in range(2):
+        platform = probe_once(timeout_s)
+        if platform is not None:
+            return platform
+    return None
+
+
+def emit(payload: dict) -> None:
+    print(json.dumps(payload))
+
+
+def fallback_line(cpu_enc: float, cpu_dec: float, reason: str) -> dict:
+    return {
+        "metric": f"erasure-encode GiB/s (12+4 @ 1MiB, CPU fallback: {reason})",
+        "value": round(cpu_enc, 3),
+        "unit": "GiB/s",
+        "vs_baseline": 0.0,
+        "device": False,
+        "cpu_avx2_gibs": round(cpu_enc, 3),
+        "cpu_decode_recon4_gibs": round(cpu_dec, 3),
+    }
 
 
 def main() -> None:
     rng = np.random.default_rng(1)
     blocks = rng.integers(0, 256, (BATCH, K, SHARD), dtype=np.uint8)
-    cpu = cpu_baseline_gibs(blocks)
+    cpu_enc = cpu_encode_gibs(blocks)
+    cpu_dec = cpu_decode_gibs(blocks[: max(32, BATCH // 8)])
 
-    # Watchdog: if device init wedges (tunnel flake), still print a line.
+    platform = probe_device(PROBE_TIMEOUT_S)
+    if platform is None:
+        emit(fallback_line(cpu_enc, cpu_dec, "device init probe timeout"))
+        return
+
+    # Watchdog: if the in-process run wedges anyway, still print a line.
     def on_timeout(signum, frame):
-        print(
-            json.dumps(
-                {
-                    "metric": "erasure-encode GiB/s (12+4 @ 1MiB, CPU fallback: device init timeout)",
-                    "value": round(cpu, 3),
-                    "unit": "GiB/s",
-                    "vs_baseline": 1.0,
-                }
-            )
-        )
+        emit(fallback_line(cpu_enc, cpu_dec, "device run watchdog timeout"))
         os._exit(0)
 
     signal.signal(signal.SIGALRM, on_timeout)
-    signal.alarm(600)
+    signal.alarm(900)
     try:
-        enc, fused, platform = device_gibs()
+        dm = device_metrics()
+    except Exception as e:  # noqa: BLE001 - report, never crash the driver
+        signal.alarm(0)
+        emit(fallback_line(cpu_enc, cpu_dec, f"device run failed: {type(e).__name__}"))
+        return
     finally:
         signal.alarm(0)
 
-    print(
-        json.dumps(
-            {
-                "metric": f"erasure-encode GiB/s (12+4 @ 1MiB, batch {BATCH}, {platform})",
-                "value": round(enc, 3),
-                "unit": "GiB/s",
-                "vs_baseline": round(enc / cpu, 3) if cpu else 0.0,
-                "cpu_avx2_gibs": round(cpu, 3),
-                "fused_encode_hash_gibs": round(fused, 3),
-            }
-        )
+    enc = dm["encode_gibs"]
+    emit(
+        {
+            "metric": f"erasure-encode GiB/s (12+4 @ 1MiB, batch {BATCH}, {dm['platform']})",
+            "value": round(enc, 3),
+            "unit": "GiB/s",
+            "vs_baseline": round(enc / cpu_enc, 3) if cpu_enc else 0.0,
+            "device": dm["platform"] != "cpu",
+            "cpu_avx2_gibs": round(cpu_enc, 3),
+            "fused_encode_hash_gibs": round(dm["fused_encode_hash_gibs"], 3),
+            "decode_recon4_gibs": round(dm["decode_recon4_gibs"], 3),
+            "cpu_decode_recon4_gibs": round(cpu_dec, 3),
+            "decode_vs_baseline": (
+                round(dm["decode_recon4_gibs"] / cpu_dec, 3) if cpu_dec else 0.0
+            ),
+        }
     )
 
 

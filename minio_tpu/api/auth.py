@@ -199,17 +199,21 @@ class SigV4Verifier:
         path: str,
         query: list[tuple[str, str]],
         headers: dict[str, str],
-        payload: bytes,
+        payload: bytes | None,
     ) -> str:
         """Verify a header-signed request; returns the access key
-        (doesSignatureMatch, cmd/signature-v4.go:334 equivalent)."""
+        (doesSignatureMatch, cmd/signature-v4.go:334 equivalent).
+
+        payload=None means the caller verifies the payload hash itself while
+        streaming the body (the reference's hash.Reader discipline); the
+        signature is still checked against the declared header hash."""
         headers = {k.lower(): v for k, v in headers.items()}
         auth = parse_authorization(headers.get("authorization", ""))
         creds = self._creds(auth.access_key)
         amz_date = headers.get("x-amz-date", headers.get("date", ""))
         self._check_date(amz_date)
         payload_hash = headers.get("x-amz-content-sha256", UNSIGNED_PAYLOAD)
-        if payload_hash not in (UNSIGNED_PAYLOAD, STREAMING_PAYLOAD):
+        if payload is not None and payload_hash not in (UNSIGNED_PAYLOAD, STREAMING_PAYLOAD):
             if hashlib.sha256(payload).hexdigest() != payload_hash:
                 raise S3Error("XAmzContentSHA256Mismatch")
         scope = f"{auth.date}/{auth.region}/s3/aws4_request"

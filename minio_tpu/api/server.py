@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import base64
+import contextlib
 import datetime
 import hashlib
 import secrets
@@ -67,6 +68,81 @@ def _xml(content: str, status: int = 200) -> web.Response:
         body=('<?xml version="1.0" encoding="UTF-8"?>\n' + content).encode(),
         content_type="application/xml",
     )
+
+
+def _read_all(reader, chunk: int = 1 << 20) -> bytes:
+    out = bytearray()
+    while True:
+        b = reader.read(chunk)
+        if not b:
+            return bytes(out)
+        out += b
+
+
+class _RequestBodyReader:
+    """Sync .read(n) over an aiohttp request body.
+
+    The object layer streams from a worker thread; each read hops to the
+    event loop for the next body chunk (readahead pipelining: the socket
+    fills while the previous block encodes)."""
+
+    def __init__(self, request: web.Request, loop: asyncio.AbstractEventLoop):
+        self._content = request.content
+        self._loop = loop
+
+    def read(self, n: int) -> bytes:
+        if n <= 0:
+            return b""
+        fut = asyncio.run_coroutine_threadsafe(self._content.read(n), self._loop)
+        return fut.result(timeout=600)
+
+
+class _HashVerifyReader:
+    """Pass-through reader enforcing size limit + payload digests at EOF.
+
+    The reference's hash.Reader (internal/hash/reader.go): the declared
+    x-amz-content-sha256 / Content-Md5 are verified against the streamed
+    bytes; a mismatch fails the request after staging, never committing."""
+
+    def __init__(self, reader, want_sha256_hex=None, want_md5_b64=None, limit=MAX_OBJECT_SIZE):
+        self._r = reader
+        self._sha = hashlib.sha256() if want_sha256_hex else None
+        self._want_sha = want_sha256_hex
+        self._md5 = hashlib.md5() if want_md5_b64 else None
+        self._want_md5 = want_md5_b64
+        self._limit = limit
+        self._n = 0
+        self._checked = False
+
+    def read(self, n: int) -> bytes:
+        chunk = self._r.read(n)
+        if chunk:
+            self._n += len(chunk)
+            if self._n > self._limit:
+                raise S3Error("EntityTooLarge")
+            if self._sha is not None:
+                self._sha.update(chunk)
+            if self._md5 is not None:
+                self._md5.update(chunk)
+        elif not self._checked:
+            self._checked = True
+            if self._sha is not None and self._sha.hexdigest() != self._want_sha:
+                raise S3Error("XAmzContentSHA256Mismatch")
+            if self._md5 is not None:
+                want = base64.b64decode(self._want_md5)
+                if self._md5.digest() != want:
+                    raise S3Error("BadDigest")
+        return chunk
+
+
+class _StreamPlan:
+    """A prepared streaming GET: headers + a blocking chunk iterator."""
+
+    def __init__(self, status: int, headers: dict, iterator, content_length: int):
+        self.status = status
+        self.headers = headers
+        self.iterator = iterator
+        self.content_length = content_length
 
 
 def _obj_xml(o: ObjectInfo) -> str:
@@ -127,8 +203,9 @@ class S3Server:
             )
             resp = _xml(s3e.to_xml(request_id), s3e.api.http_status)
         duration = _time.perf_counter() - t0
-        resp.headers["x-amz-request-id"] = request_id
-        resp.headers.setdefault("Server", "MinIO-TPU")
+        if not resp.prepared:  # streamed responses already sent their headers
+            resp.headers["x-amz-request-id"] = request_id
+            resp.headers.setdefault("Server", "MinIO-TPU")
         if self.metrics is not None:
             self.metrics.record_http(request.method, resp.status)
             bucket, key = self._split_path(request)
@@ -204,6 +281,72 @@ class S3Server:
             return access_key, body
         return "", body  # anonymous
 
+    def _authenticate_streaming(self, request: web.Request, base_reader):
+        """Header-only authentication for streaming uploads: returns
+        (access_key, verified_reader). Payload digests (declared sha256,
+        Content-Md5, aws-chunked per-chunk signatures) are verified by the
+        reader chain as the object layer consumes the body."""
+        from . import sigv2 as sigv2_mod
+        from . import streaming as streaming_mod
+        from .auth import parse_authorization
+
+        headers = dict(request.headers)
+        h = {k.lower(): v for k, v in headers.items()}
+        query = [(k, v) for k, v in request.rel_url.query.items()]
+        path = urllib.parse.unquote(request.path)
+        want_md5 = h.get("content-md5")
+
+        if "X-Amz-Signature" in request.rel_url.query:
+            ak = self.verifier.verify_presigned(request.method, path, query, headers)
+            return ak, _HashVerifyReader(base_reader, want_md5_b64=want_md5)
+        if sigv2_mod.is_v2_presigned(request.rel_url.query):
+            v2 = sigv2_mod.SigV2Verifier(self.iam.lookup)
+            ak = v2.verify_presigned(request.method, path, query)
+            return ak, _HashVerifyReader(base_reader, want_md5_b64=want_md5)
+        if sigv2_mod.is_v2_signed(headers):
+            v2 = sigv2_mod.SigV2Verifier(self.iam.lookup)
+            ak = v2.verify_signed(request.method, path, query, headers)
+            return ak, _HashVerifyReader(base_reader, want_md5_b64=want_md5)
+        if "Authorization" in request.headers:
+            ak = self.verifier.verify_signed(request.method, path, query, headers, None)
+            if streaming_mod.is_streaming_request(headers):
+                auth = parse_authorization(h.get("authorization", ""))
+                creds = self.iam.lookup(auth.access_key)
+                rdr = streaming_mod.SignedChunkReader(
+                    base_reader,
+                    seed_signature=auth.signature,
+                    secret_key=creds.secret_key,
+                    amz_date=h.get("x-amz-date", ""),
+                    region=auth.region,
+                )
+                return ak, _HashVerifyReader(rdr, want_md5_b64=want_md5)
+            payload_hash = h.get("x-amz-content-sha256", UNSIGNED_PAYLOAD)
+            want_sha = payload_hash if payload_hash != UNSIGNED_PAYLOAD else None
+            return ak, _HashVerifyReader(
+                base_reader, want_sha256_hex=want_sha, want_md5_b64=want_md5
+            )
+        return "", _HashVerifyReader(base_reader, want_md5_b64=want_md5)  # anonymous
+
+    async def _streaming_put_entry(
+        self, request: web.Request, bucket: str, key: str
+    ) -> web.Response:
+        clen = request.content_length
+        if clen is not None and clen > MAX_OBJECT_SIZE + (1 << 20):
+            raise S3Error("EntityTooLarge")
+        base = _RequestBodyReader(request, asyncio.get_running_loop())
+        access_key, reader = await asyncio.to_thread(
+            self._authenticate_streaming, request, base
+        )
+        request["access_key"] = access_key
+        q = request.rel_url.query
+        action = policy_mod.s3_action("PUT", bucket, key, q)
+        await asyncio.to_thread(self._authorize, access_key, action, bucket, key)
+        if "uploadId" in q and "partNumber" in q:
+            return await asyncio.to_thread(
+                self._upload_part, bucket, key, q["uploadId"], int(q["partNumber"]), reader
+            )
+        return await asyncio.to_thread(self._put_object, bucket, key, reader, request)
+
     def _authorize(self, access_key: str, action: str, bucket: str, key: str) -> None:
         resource = policy_mod.resource_arn(bucket, key)
         if access_key:
@@ -225,6 +368,17 @@ class S3Server:
                 raise S3Error("NotImplemented")
             return web.Response(text=self.metrics.render(), content_type="text/plain")
         bucket, key = self._split_path(request)
+        # Object PUTs (plain and upload-part) stream: auth from headers, the
+        # body flows through verified readers into the erasure pipeline
+        # without ever materializing (the reference's PutObjectHandler
+        # hash.Reader -> erasure.Encode chain, object-handlers.go:1638-1712).
+        if (
+            request.method == "PUT"
+            and key
+            and "x-amz-copy-source" not in request.headers
+            and not ({"tagging", "retention", "legal-hold", "acl"} & set(request.rel_url.query))
+        ):
+            return await self._streaming_put_entry(request, bucket, key)
         body = await request.read()
         # POST policy form uploads authenticate via the policy signature in
         # the form, not request headers (PostPolicyBucketHandler equivalent).
@@ -856,7 +1010,10 @@ class S3Server:
                 return await asyncio.to_thread(
                     self._get_object_in_zip, bucket, key, request, m == "HEAD"
                 )
-            return await asyncio.to_thread(self._get_object, bucket, key, request, m == "HEAD")
+            resp = await asyncio.to_thread(self._get_object, bucket, key, request, m == "HEAD")
+            if isinstance(resp, _StreamPlan):
+                return await self._send_stream(request, resp)
+            return resp
         if m == "DELETE":
             if "tagging" in q:
                 return await asyncio.to_thread(self._delete_object_tagging, bucket, key, q)
@@ -1110,17 +1267,55 @@ class S3Server:
             }
         return {}
 
-    def _put_object(self, bucket: str, key: str, body: bytes, request: web.Request) -> web.Response:
-        if len(body) > MAX_OBJECT_SIZE:
-            raise S3Error("EntityTooLarge")
-        if "Content-Md5" in request.headers:
-            want = base64.b64decode(request.headers["Content-Md5"])
-            if hashlib.md5(body).digest() != want:
-                raise S3Error("BadDigest")
+    def _put_needs_transform(
+        self, bucket: str, key: str, request: web.Request, opts: PutObjectOptions
+    ) -> bool:
+        """True when the payload must be buffered for SSE/compression."""
+        from ..control import compress as compress_mod
+
+        if self._parse_ssec_key(request) is not None:
+            return True
+        if (
+            request.headers.get("x-amz-server-side-encryption", "") in ("AES256", "aws:kms")
+            or self._bucket_default_sse(bucket)
+        ):
+            return True
+        compression_on = False
+        if self.config is not None:
+            try:
+                from ..control.config import SUBSYS_COMPRESSION
+
+                compression_on = self.config.get_bool(SUBSYS_COMPRESSION, "enable")
+            except Exception:
+                compression_on = False
+        return compression_on and compress_mod.is_compressible(key, opts.content_type)
+
+    def _put_object(self, bucket: str, key: str, data, request: web.Request) -> web.Response:
+        """data: a verified streaming reader (dispatch) or bytes (legacy).
+
+        Untransformed payloads stream straight into the erasure pipeline;
+        SSE/compression still buffer (streaming transforms are the remaining
+        gap vs the reference's fully piped chain)."""
         opts = self._put_opts(bucket, request, key)
-        opts.etag = hashlib.md5(body).hexdigest()
-        body = self._transform_put(bucket, key, body, request, opts)
-        oi = self.layer.put_object(bucket, key, body, opts)
+        body: bytes | None = None
+        if isinstance(data, (bytes, bytearray)):
+            body = bytes(data)
+            if len(body) > MAX_OBJECT_SIZE:
+                raise S3Error("EntityTooLarge")
+            if "Content-Md5" in request.headers:
+                want = base64.b64decode(request.headers["Content-Md5"])
+                if hashlib.md5(body).digest() != want:
+                    raise S3Error("BadDigest")
+        elif self._put_needs_transform(bucket, key, request, opts) or not getattr(
+            self.layer, "supports_streaming", False
+        ):
+            body = _read_all(data)  # reader enforces limit + digests
+        if body is not None:
+            opts.etag = hashlib.md5(body).hexdigest()
+            payload = self._transform_put(bucket, key, body, request, opts)
+            oi = self.layer.put_object(bucket, key, payload, opts)
+        else:
+            oi = self.layer.put_object(bucket, key, data, opts)
         headers = {"ETag": f'"{oi.etag}"'}
         headers.update(self._sse_response_headers(oi))
         if oi.version_id:
@@ -1363,6 +1558,13 @@ class S3Server:
                     data = data[offset:end]
                 oi.size = logical
             else:
+                stream_fn = getattr(self.layer, "get_object_stream", None)
+                if stream_fn is not None:
+                    if rng and offset >= probe.size and probe.size > 0:
+                        raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
+                    return self._plan_stream(
+                        stream_fn, bucket, key, opts, request, rng, offset, length
+                    )
                 oi, data = self.layer.get_object(bucket, key, opts, offset=offset, length=length)
             if rng and offset >= oi.size and oi.size > 0:
                 raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
@@ -1385,6 +1587,45 @@ class S3Server:
         except oerr.MethodNotAllowed:
             # GET on a delete marker by version id.
             return web.Response(status=405, headers={"x-amz-delete-marker": "true"})
+
+    def _plan_stream(
+        self, stream_fn, bucket, key, opts, request, rng, offset, length
+    ) -> "web.Response | _StreamPlan":
+        """Build the streaming GET plan: decoded blocks flow to the socket
+        without materializing the object (the reference's writeDataBlocks ->
+        ResponseWriter path, erasure-decode.go:206)."""
+        oi, it = stream_fn(bucket, key, opts, offset=offset, length=length)
+        inm = request.headers.get("If-None-Match", "")
+        if inm and inm.strip('"') == oi.etag:
+            return web.Response(status=304, headers={"ETag": f'"{oi.etag}"'})
+        im = request.headers.get("If-Match", "")
+        if im and im.strip('"') != oi.etag:
+            raise S3Error("PreconditionFailed", resource=f"/{bucket}/{key}")
+        headers = self._object_headers(oi)
+        headers.update(self._sse_response_headers(oi))
+        end = oi.size if length < 0 else min(offset + length, oi.size)
+        content_length = max(end - offset, 0)
+        status = 200
+        if rng:
+            headers["Content-Range"] = f"bytes {offset}-{offset + content_length - 1}/{oi.size}"
+            status = 206
+        return _StreamPlan(status, headers, it, content_length)
+
+    async def _send_stream(self, request: web.Request, plan: _StreamPlan) -> web.StreamResponse:
+        resp = web.StreamResponse(status=plan.status, headers=plan.headers)
+        resp.content_length = plan.content_length
+        await resp.prepare(request)
+        it = plan.iterator
+        try:
+            while True:
+                chunk = await asyncio.to_thread(next, it, None)
+                if chunk is None:
+                    break
+                await resp.write(chunk)
+        finally:
+            with contextlib.suppress(Exception):
+                await resp.write_eof()
+        return resp
 
     # -- object tagging / object lock ----------------------------------------
 

@@ -23,6 +23,7 @@ from .auth import Credentials, STREAMING_PAYLOAD, signing_key
 from .errors import S3Error
 
 _EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
+MAX_CHUNK_SIZE = 16 * (1 << 20)  # reference maxChunkSize, streaming-signature-v4.go
 
 
 def _chunk_string_to_sign(amz_date: str, scope: str, prev_sig: str, chunk: bytes) -> str:
@@ -127,3 +128,86 @@ def decode_chunked(
 def is_streaming_request(headers: dict) -> bool:
     h = {k.lower(): v for k, v in headers.items()}
     return h.get("x-amz-content-sha256", "") == STREAMING_PAYLOAD
+
+
+class SignedChunkReader:
+    """Incremental aws-chunked decoder+verifier over a sync .read(n) source.
+
+    The streaming-PUT analogue of decode_chunked: the reference's
+    newSignV4ChunkedReader (cmd/streaming-signature-v4.go:160) wraps the
+    request body and verifies each chunk's chained signature as the object
+    layer consumes it -- memory stays O(chunkSize)."""
+
+    def __init__(self, reader, seed_signature: str, secret_key: str, amz_date: str, region: str):
+        self._r = reader
+        self._amz_date = amz_date
+        date = amz_date[:8]
+        self._scope = f"{date}/{region}/s3/aws4_request"
+        self._key = signing_key(secret_key, date, region)
+        self._prev = seed_signature
+        self._raw = bytearray()  # undecoded wire bytes
+        self._out = bytearray()  # decoded payload ready to serve
+        self._done = False
+
+    def _fill_raw(self, need: int) -> None:
+        while len(self._raw) < need:
+            chunk = self._r.read(max(64 * 1024, need - len(self._raw)))
+            if not chunk:
+                raise S3Error("IncompleteBody", "truncated aws-chunked body")
+            self._raw += chunk
+
+    def _read_header_line(self) -> str:
+        while True:
+            nl = self._raw.find(b"\r\n")
+            if nl >= 0:
+                line = bytes(self._raw[:nl]).decode("latin-1")
+                del self._raw[: nl + 2]
+                return line
+            if len(self._raw) > 16384:
+                raise S3Error("InvalidRequest", "oversized chunk header")
+            chunk = self._r.read(64 * 1024)
+            if not chunk:
+                raise S3Error("IncompleteBody", "truncated chunk header")
+            self._raw += chunk
+
+    def _decode_one(self) -> None:
+        header = self._read_header_line()
+        if ";" not in header:
+            raise S3Error("InvalidRequest", "malformed chunk header")
+        size_hex, _, attrs = header.partition(";")
+        try:
+            size = int(size_hex, 16)
+        except ValueError:
+            raise S3Error("InvalidRequest", "bad chunk size")
+        if size > MAX_CHUNK_SIZE:
+            # Memory stays O(MAX_CHUNK_SIZE): a declared terabyte chunk must
+            # not buffer before its signature check (the reference caps
+            # chunks at 16 MiB, streaming-signature-v4.go maxChunkSize).
+            raise S3Error("InvalidRequest", "chunk size exceeds maximum")
+        sig = ""
+        for attr in attrs.split(";"):
+            k, _, v = attr.partition("=")
+            if k.strip() == "chunk-signature":
+                sig = v.strip()
+        if not sig:
+            raise S3Error("InvalidRequest", "missing chunk-signature")
+        self._fill_raw(size + 2)
+        chunk = bytes(self._raw[:size])
+        if self._raw[size : size + 2] != b"\r\n":
+            raise S3Error("InvalidRequest", "missing chunk trailer")
+        del self._raw[: size + 2]
+        want = _sign(self._key, _chunk_string_to_sign(self._amz_date, self._scope, self._prev, chunk))
+        if not hmac.compare_digest(want, sig):
+            raise S3Error("SignatureDoesNotMatch", "chunk signature mismatch")
+        self._prev = want
+        if size == 0:
+            self._done = True
+        else:
+            self._out += chunk
+
+    def read(self, n: int) -> bytes:
+        while not self._done and len(self._out) < n:
+            self._decode_one()
+        out = bytes(self._out[:n])
+        del self._out[:n]
+        return out

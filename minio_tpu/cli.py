@@ -219,6 +219,7 @@ def serve(argv: list[str]) -> int:
         a.quiet,
         a.json,
         msg="online",
+        codec=type(node.codec).__name__,
         drives=len(node.drives),
         sets=n_sets,
         set_drive_count=node.set_drive_count,
@@ -237,6 +238,9 @@ def serve(argv: list[str]) -> int:
         node.replication.close()
     if getattr(node, "site_repl", None) is not None:
         node.site_repl.close()
+    from .runtime import shutdown_data_plane
+
+    shutdown_data_plane(node.codec)
     t.join(5)
     return 0
 

@@ -128,6 +128,8 @@ class TargetQueue:
         if queue_dir:
             os.makedirs(queue_dir, exist_ok=True)
             self._reload_spool()
+            if self._mem:
+                self._wake.set()  # drain recovered spool without the idle tick
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 

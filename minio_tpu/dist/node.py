@@ -182,8 +182,23 @@ class Node:
 
     def build(self) -> "Node":
         quorum = self.wait_for_format()
+        layer_codec = self.codec
+        if self.codec is None:
+            # Install the served data-plane codec: the cross-request batching
+            # device pipeline when an accelerator is reachable, host C++
+            # otherwise (the reference's always-on fast codec,
+            # erasure-coding.go:63). Probed with a bounded timeout on a
+            # background thread so a wedged device tunnel cannot hang boot;
+            # the layer is built with codec=None so it resolves the process
+            # default lazily and picks up the async device upgrade.
+            from ..runtime import install_data_plane_codec
+
+            self.codec = install_data_plane_codec(background=True)
+            layer_codec = None
+        else:
+            codec_mod.set_default_codec(self.codec)
         sets = ErasureSets.from_drives(
-            list(self.drives), quorum, parity=self.parity, codec=self.codec
+            list(self.drives), quorum, parity=self.parity, codec=layer_codec
         )
         self.pools = ServerPools([sets])
         lockers: list = [self.locker] + [RemoteLocker(u, self.token) for u in self.peer_urls]

@@ -18,8 +18,10 @@ Differences from the reference worth noting (TPU-first design):
 from __future__ import annotations
 
 import hashlib
+import io
 import os
 import uuid
+from typing import Iterator
 
 from ..ops import bitrot as bitrot_mod
 from ..storage.interface import StorageAPI
@@ -41,6 +43,103 @@ from .types import (
 BLOCK_SIZE = 1 << 20  # blockSizeV2 (cmd/object-api-common.go:40)
 META_BUCKET = ".minio_tpu.sys"
 DIGEST_LEN = 32
+# Blocks per codec call on the streaming path: the put/get working set is
+# O(GROUP_BLOCKS x BLOCK_SIZE), not O(objectSize), while each group is still
+# a device-batchable [G, K, S] tensor (the reference streams one 1 MiB block
+# at a time, erasure-encode.go:73-109; grouping keeps the TPU batch win).
+GROUP_BLOCKS = 16
+
+
+def _as_reader(data) -> io.BufferedIOBase:
+    """bytes | file-like -> .read(n) reader."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return io.BytesIO(bytes(data))
+    if hasattr(data, "read"):
+        return data
+    raise TypeError(f"put_object data must be bytes or a reader, got {type(data)!r}")
+
+
+def _read_full(reader, n: int) -> bytes:
+    """Read exactly n bytes unless EOF intervenes (short read = EOF)."""
+    out = bytearray()
+    while len(out) < n:
+        chunk = reader.read(n - len(out))
+        if not chunk:
+            break
+        out += chunk
+    return bytes(out)
+
+
+def _iter_blocks(reader, first: bytes) -> Iterator[bytes]:
+    """Yield BLOCK_SIZE blocks from `first` + reader; last may be short."""
+    buf = bytearray(first)
+    while True:
+        if len(buf) < BLOCK_SIZE:
+            chunk = reader.read(BLOCK_SIZE - len(buf))
+            if not chunk:
+                break
+            buf += chunk
+            continue
+        yield bytes(buf[:BLOCK_SIZE])
+        del buf[:BLOCK_SIZE]
+    if buf:
+        yield bytes(buf)
+
+
+class ShardStageWriter:
+    """Grouped-encode + per-drive staged shard appends with quorum tracking.
+
+    The streaming-write engine shared by put_object and multipart part
+    uploads: each GROUP_BLOCKS batch of 1 MiB blocks goes through the codec
+    as one device call, and each drive's shard-row frames are appended to its
+    staged file in parallel. Failed drives are dropped from subsequent
+    appends; the caller checks `alive()` against its write quorum.
+    (The reference's parallelWriter + Encode loop, erasure-encode.go:29-109.)
+    """
+
+    def __init__(self, codec, disks, distribution, k: int, m: int, stage_path):
+        """stage_path(i) -> staged shard-file path under META_BUCKET."""
+        self.codec = codec
+        self.disks = disks
+        self.distribution = distribution
+        self.k, self.m = k, m
+        self.stage_path = stage_path
+        self.ok = [d is not None for d in disks]
+
+    def create(self) -> None:
+        """Create empty staged files up front (zero-byte payloads commit a
+        real — empty — shard file; appends extend it)."""
+
+        def mk(i):
+            if not self.ok[i]:
+                return
+            self.disks[i].create_file(META_BUCKET, self.stage_path(i), b"")
+
+        for i, (_, e) in enumerate(meta_mod.parallel_map(mk, range(len(self.disks)))):
+            if e is not None:
+                self.ok[i] = False
+
+    def append_group(self, group: list[bytes]) -> None:
+        if not group:
+            return
+        encoded = self.codec.encode(group, self.k, self.m)
+        row_frames = [
+            _frame_shard([e[0][row] for e in encoded], [e[1][row] for e in encoded])
+            for row in range(self.k + self.m)
+        ]
+
+        def wr(i):
+            if not self.ok[i]:
+                return
+            row = self.distribution[i] - 1
+            self.disks[i].append_file(META_BUCKET, self.stage_path(i), row_frames[row])
+
+        for i, (_, e) in enumerate(meta_mod.parallel_map(wr, range(len(self.disks)))):
+            if e is not None:
+                self.ok[i] = False
+
+    def alive(self) -> int:
+        return sum(self.ok)
 
 _NS_LOCK_SINGLETON = None
 
@@ -119,13 +218,20 @@ class ErasureObjects:
         self.set_index = set_index
         self.pool_index = pool_index
         self.parity = default_parity(len(disks)) if parity is None else parity
-        self.codec = codec or codec_mod.default_codec()
+        # None = resolve the process-wide codec lazily per call, so a codec
+        # installed at boot (runtime.install_data_plane_codec) serves layers
+        # built before it landed.
+        self._codec = codec
         # Namespace lock: serializes writers per object. Defaults to a
         # process-local locker; Node.build swaps in the dsync quorum lockers
         # (reference: NSLock via dsync, cmd/erasure-object.go:933-941).
         self.ns_lock = ns_lock if ns_lock is not None else _process_ns_lock()
 
     # ------------------------------------------------------------------ util
+
+    @property
+    def codec(self) -> codec_mod.BlockCodec:
+        return self._codec if self._codec is not None else codec_mod.default_codec()
 
     @property
     def multipart(self):
@@ -215,74 +321,106 @@ class ErasureObjects:
     # ------------------------------------------------------------------- put
 
     def put_object(
-        self, bucket: str, object_name: str, data: bytes, opts: PutObjectOptions | None = None
+        self, bucket: str, object_name: str, data, opts: PutObjectOptions | None = None
     ) -> ObjectInfo:
+        """Streaming erasure put: `data` is bytes or a .read(n) stream.
+
+        Blocks are encoded + hashed in GROUP_BLOCKS batches and the shard
+        frames appended to per-drive staged files as they are produced, so
+        memory stays O(GROUP_BLOCKS x BLOCK_SIZE) regardless of object size
+        (the reference's per-1MiB-block loop, erasure-encode.go:73-109, with
+        the blocks grouped into device batches). Objects smaller than the
+        inline threshold take the one-shot xl.meta-inline path."""
         opts = opts or PutObjectOptions()
         self.get_bucket_info(bucket)  # raises BucketNotFound
 
         n = self.drive_count
         m = self.parity
         k = n - m
-        size = len(data)
         distribution = hash_order(f"{bucket}/{object_name}", n)
         version_id = opts.version_id or (str(uuid.uuid4()) if opts.versioned else "")
         mod_time = now()
-        etag = opts.etag or hashlib.md5(data).hexdigest()
-        inline = size < SMALL_FILE_THRESHOLD
-        data_dir = "" if inline else str(uuid.uuid4())
 
-        # Encode + hash every block through the codec service (device-batched).
+        reader = _as_reader(data)
+        head = _read_full(reader, SMALL_FILE_THRESHOLD)
+        if len(head) < SMALL_FILE_THRESHOLD:
+            return self._put_inline(
+                bucket, object_name, head, opts, k, m, distribution, version_id, mod_time
+            )
+        return self._put_streaming(
+            bucket, object_name, reader, head, opts, k, m, distribution, version_id, mod_time
+        )
+
+    def _make_put_fi(
+        self,
+        bucket: str,
+        object_name: str,
+        shard_row: int,
+        *,
+        k: int,
+        m: int,
+        size: int,
+        distribution,
+        version_id: str,
+        mod_time: float,
+        data_dir: str,
+        base_meta: dict,
+        inline_blob: bytes = b"",
+    ) -> FileInfo:
+        return FileInfo(
+            volume=bucket,
+            name=object_name,
+            version_id=version_id,
+            data_dir=data_dir,
+            mod_time=mod_time,
+            size=size,
+            metadata=dict(base_meta),
+            parts=[ObjectPartInfo(1, size, actual_size=size, mod_time=mod_time)],
+            erasure=ErasureInfo(
+                data_blocks=k,
+                parity_blocks=m,
+                block_size=BLOCK_SIZE,
+                index=shard_row + 1,
+                distribution=list(distribution),
+            ),
+            inline_data=inline_blob,
+        )
+
+    def _put_inline(
+        self, bucket, object_name, data: bytes, opts, k, m, distribution, version_id, mod_time
+    ) -> ObjectInfo:
+        """Small object: shards inline in xl.meta, one codec call."""
+        size = len(data)
+        etag = opts.etag or hashlib.md5(data).hexdigest()
         blocks = [data[i : i + BLOCK_SIZE] for i in range(0, size, BLOCK_SIZE)]
         encoded = self.codec.encode(blocks, k, m) if blocks else []
-        # Per shard row: the full interleaved bitrot file image.
         shard_files = [
             _frame_shard([e[0][row] for e in encoded], [e[1][row] for e in encoded])
-            for row in range(n)
+            for row in range(k + m)
         ]
-
         write_quorum = k + 1 if k == m else k
-
-        base_meta = {
-            "etag": etag,
-            "content-type": opts.content_type,
-            **opts.user_defined,
-        }
-
-        def make_fi(drive_index: int) -> FileInfo:
-            shard_row = distribution[drive_index] - 1
-            return FileInfo(
-                volume=bucket,
-                name=object_name,
-                version_id=version_id,
-                data_dir=data_dir,
-                mod_time=mod_time,
-                size=size,
-                metadata=dict(base_meta),
-                parts=[ObjectPartInfo(1, size, actual_size=size, mod_time=mod_time)],
-                erasure=ErasureInfo(
-                    data_blocks=k,
-                    parity_blocks=m,
-                    block_size=BLOCK_SIZE,
-                    index=shard_row + 1,
-                    distribution=list(distribution),
-                ),
-                inline_data=shard_files[shard_row] if inline else b"",
-            )
-
-        upload_id = str(uuid.uuid4())
+        base_meta = {"etag": etag, "content-type": opts.content_type, **opts.user_defined}
 
         def write_one(args) -> None:
             i, disk = args
             if disk is None:
                 raise errors.DiskNotFound()
-            fi = make_fi(i)
-            if inline:
-                disk.write_metadata(bucket, object_name, fi)
-                return
             shard_row = distribution[i] - 1
-            tmp_path = f"tmp/{upload_id}/{i}"
-            disk.create_file(META_BUCKET, f"{tmp_path}/part.1", shard_files[shard_row])
-            disk.rename_data(META_BUCKET, tmp_path, fi, bucket, object_name)
+            fi = self._make_put_fi(
+                bucket,
+                object_name,
+                shard_row,
+                k=k,
+                m=m,
+                size=size,
+                distribution=distribution,
+                version_id=version_id,
+                mod_time=mod_time,
+                data_dir="",
+                base_meta=base_meta,
+                inline_blob=shard_files[shard_row],
+            )
+            disk.write_metadata(bucket, object_name, fi)
 
         lk = self.ns_lock.new(bucket, object_name)
         if not lk.acquire(writer=True, timeout=30):
@@ -294,13 +432,139 @@ class ErasureObjects:
         errs = [e for _, e in results]
         n_ok = sum(1 for e in errs if e is None)
         if n_ok < write_quorum:
-            # Roll back what we can; partial writes are heal fodder otherwise.
             self._cleanup_failed_put(bucket, object_name, version_id, errs)
             raise errors.ErasureWriteQuorum(
                 bucket, object_name, f"write quorum {write_quorum} not met ({n_ok} ok)"
             )
+        fi = self._make_put_fi(
+            bucket,
+            object_name,
+            distribution[0] - 1,
+            k=k,
+            m=m,
+            size=size,
+            distribution=distribution,
+            version_id=version_id,
+            mod_time=mod_time,
+            data_dir="",
+            base_meta=base_meta,
+        )
+        fi.is_latest = True
+        oi = ObjectInfo.from_file_info(fi, bucket, object_name)
+        oi.etag = etag
+        return oi
 
-        fi = make_fi(0)
+    def _put_streaming(
+        self, bucket, object_name, reader, head: bytes, opts, k, m, distribution,
+        version_id, mod_time,
+    ) -> ObjectInfo:
+        """Large object: grouped block encode + per-drive staged appends,
+        committed with rename_data under the namespace lock."""
+        n = k + m
+        data_dir = str(uuid.uuid4())
+        upload_id = str(uuid.uuid4())
+        write_quorum = k + 1 if k == m else k
+        md5h = None if opts.etag else hashlib.md5()
+        disks = self._online()
+        size = 0
+
+        def tmp_dir(i: int) -> str:
+            return f"tmp/{upload_id}/{i}"
+
+        writer = ShardStageWriter(
+            self.codec, disks, distribution, k, m, lambda i: f"{tmp_dir(i)}/part.1"
+        )
+        ok = writer.ok
+
+        def cleanup(indices) -> None:
+            def rm(i):
+                d = disks[i]
+                if d is None:
+                    return
+                try:
+                    d.delete(META_BUCKET, f"tmp/{upload_id}", recursive=True)
+                except errors.StorageError:
+                    pass
+
+            meta_mod.parallel_map(rm, list(indices))
+
+        try:
+            writer.create()
+            group: list[bytes] = []
+            for block in _iter_blocks(reader, head):
+                if md5h is not None:
+                    md5h.update(block)
+                size += len(block)
+                group.append(block)
+                if len(group) >= GROUP_BLOCKS:
+                    writer.append_group(group)
+                    group = []
+                    if writer.alive() < write_quorum:
+                        raise errors.ErasureWriteQuorum(
+                            bucket, object_name, f"write quorum {write_quorum} lost mid-stream"
+                        )
+            writer.append_group(group)
+            if writer.alive() < write_quorum:
+                raise errors.ErasureWriteQuorum(
+                    bucket, object_name, f"write quorum {write_quorum} lost mid-stream"
+                )
+        except BaseException:
+            cleanup(range(n))
+            raise
+
+        etag = opts.etag or md5h.hexdigest()
+        base_meta = {"etag": etag, "content-type": opts.content_type, **opts.user_defined}
+
+        def commit(i) -> None:
+            if not ok[i]:
+                raise errors.DiskNotFound()
+            shard_row = distribution[i] - 1
+            fi = self._make_put_fi(
+                bucket,
+                object_name,
+                shard_row,
+                k=k,
+                m=m,
+                size=size,
+                distribution=distribution,
+                version_id=version_id,
+                mod_time=mod_time,
+                data_dir=data_dir,
+                base_meta=base_meta,
+            )
+            disks[i].rename_data(META_BUCKET, tmp_dir(i), fi, bucket, object_name)
+
+        lk = self.ns_lock.new(bucket, object_name)
+        if not lk.acquire(writer=True, timeout=30):
+            cleanup(range(n))
+            raise errors.ErasureWriteQuorum(bucket, object_name, "namespace lock timeout")
+        try:
+            results = meta_mod.parallel_map(commit, list(range(n)))
+        finally:
+            lk.release()
+        errs = [e for _, e in results]
+        n_ok = sum(1 for e in errs if e is None)
+        # Drop stragglers' staging dirs (committed drives' tmp dirs were
+        # consumed by rename_data).
+        cleanup([i for i, e in enumerate(errs) if e is not None])
+        if n_ok < write_quorum:
+            self._cleanup_failed_put(bucket, object_name, version_id, errs)
+            raise errors.ErasureWriteQuorum(
+                bucket, object_name, f"write quorum {write_quorum} not met ({n_ok} ok)"
+            )
+        fi = self._make_put_fi(
+            bucket,
+            object_name,
+            distribution[0] - 1,
+            k=k,
+            m=m,
+            size=size,
+            distribution=distribution,
+            version_id=version_id,
+            mod_time=mod_time,
+            data_dir=data_dir,
+            base_meta=base_meta,
+        )
         fi.is_latest = True
         oi = ObjectInfo.from_file_info(fi, bucket, object_name)
         oi.etag = etag
@@ -366,6 +630,22 @@ class ErasureObjects:
         offset: int = 0,
         length: int = -1,
     ) -> tuple[ObjectInfo, bytes]:
+        oi, stream = self.get_object_stream(bucket, object_name, opts, offset, length)
+        return oi, b"".join(stream)
+
+    def get_object_stream(
+        self,
+        bucket: str,
+        object_name: str,
+        opts: GetObjectOptions | None = None,
+        offset: int = 0,
+        length: int = -1,
+    ) -> tuple[ObjectInfo, Iterator[bytes]]:
+        """Streaming erasure get: yields decoded byte chunks covering
+        [offset, offset+length), reading ONLY the shard-file frames of the
+        covered blocks (range -> block/shard-offset mapping; the reference's
+        ShardFileOffset + lazy parallelReader, cmd/erasure-coding.go:141,
+        erasure-decode.go:31-202). Memory is O(GROUP_BLOCKS x BLOCK_SIZE)."""
         opts = opts or GetObjectOptions()
         self.get_bucket_info(bucket)
         fi, metas, disks = self._read_quorum_fi(bucket, object_name, opts.version_id)
@@ -376,28 +656,15 @@ class ErasureObjects:
                 else errors.ObjectNotFound(bucket, object_name)
             )
         oi = ObjectInfo.from_file_info(fi, bucket, object_name)
-        data = self._read_object_data(bucket, object_name, fi, metas, disks)
-        if offset or (length >= 0):
-            end = len(data) if length < 0 else min(offset + length, len(data))
-            if offset > len(data):
-                raise errors.InvalidArgument(bucket, object_name, "range out of bounds")
-            data = data[offset:end]
-        return oi, data
+        size = fi.size
+        if offset < 0 or offset > size:
+            raise errors.InvalidArgument(bucket, object_name, "range out of bounds")
+        end = size if length < 0 else min(offset + length, size)
+        if size == 0 or offset >= end:
+            return oi, iter(())
 
-    def _read_object_data(
-        self,
-        bucket: str,
-        object_name: str,
-        fi: FileInfo,
-        metas: list[FileInfo | None],
-        disks: list[StorageAPI | None],
-    ) -> bytes:
-        if fi.size == 0:
-            return b""
         k = fi.erasure.data_blocks
-        mth = fi.erasure.parity_blocks
         online = meta_mod.list_online_disks(disks, metas, [None] * len(disks), fi)
-        # Position j -> drive holding shard j.
         by_shard = meta_mod.shuffle_disks_by_index(online, fi.erasure.distribution)
         metas_by_shard = meta_mod.shuffle_disks_by_index(  # type: ignore[arg-type]
             [m if o is not None else None for m, o in zip(metas, online)],
@@ -406,14 +673,24 @@ class ErasureObjects:
         inline = bool(fi.inline_data) or any(
             m is not None and m.inline_data for m in metas_by_shard
         )
-        out = bytearray()
-        for part in fi.parts:
-            out += self._read_part(
-                bucket, object_name, fi, by_shard, metas_by_shard, part, inline
-            )
-        return bytes(out[: fi.size])
 
-    def _read_part(
+        def gen() -> Iterator[bytes]:
+            abs_pos = 0
+            for part in fi.parts:
+                p_lo = max(offset - abs_pos, 0)
+                p_hi = min(end - abs_pos, part.size)
+                if p_lo < p_hi:
+                    yield from self._stream_part_range(
+                        bucket, object_name, fi, by_shard, metas_by_shard,
+                        part, inline, p_lo, p_hi,
+                    )
+                abs_pos += part.size
+                if abs_pos >= end:
+                    return
+
+        return oi, gen()
+
+    def _stream_part_range(
         self,
         bucket: str,
         object_name: str,
@@ -422,83 +699,104 @@ class ErasureObjects:
         metas_by_shard,
         part: ObjectPartInfo,
         inline: bool,
-    ) -> bytes:
+        lo: int,
+        hi: int,
+    ) -> Iterator[bytes]:
+        """Decode part-local byte range [lo, hi), group by group."""
         k = fi.erasure.data_blocks
         mth = fi.erasure.parity_blocks
-        chunk_sizes = _shard_chunk_sizes(part.size, k)
+        chunk_full = -(-BLOCK_SIZE // k)
+        frame_full = DIGEST_LEN + chunk_full
+        nblocks = -(-part.size // BLOCK_SIZE)
+        last_block_len = part.size - (nblocks - 1) * BLOCK_SIZE
+
+        def chunk_len(b: int) -> int:
+            return chunk_full if b < nblocks - 1 else -(-last_block_len // k)
+
+        def block_len(b: int) -> int:
+            return BLOCK_SIZE if b < nblocks - 1 else last_block_len
+
         part_file = f"part.{part.number}"
+        b0, b1 = lo // BLOCK_SIZE, (hi - 1) // BLOCK_SIZE
+        for g0 in range(b0, b1 + 1, GROUP_BLOCKS):
+            g1 = min(g0 + GROUP_BLOCKS - 1, b1)
+            window_sizes = [chunk_len(b) for b in range(g0, g1 + 1)]
+            file_off = g0 * frame_full
+            file_len = sum(DIGEST_LEN + s for s in window_sizes)
 
-        def read_shard(j: int) -> list[tuple[bytes, bytes]] | None:
-            """Frames for shard row j, or None if unavailable/corrupt."""
-            disk = by_shard[j]
-            if disk is None:
-                return None
-            try:
-                if inline:
-                    m = metas_by_shard[j]
-                    blob = m.inline_data if m is not None else b""
-                    if not blob:
-                        return None
-                else:
-                    blob = disk.read_file(
-                        bucket, os.path.join(object_name, fi.data_dir, part_file)
-                    )
-                return _parse_frames(blob, chunk_sizes)
-            except (errors.DiskError, errors.FileCorrupt):
-                return None
+            def read_window(j: int) -> list[tuple[bytes, bytes]] | None:
+                disk = by_shard[j]
+                try:
+                    if inline:
+                        m = metas_by_shard[j]
+                        blob = m.inline_data if m is not None else b""
+                        if not blob:
+                            return None
+                        blob = blob[file_off : file_off + file_len]
+                    else:
+                        if disk is None:
+                            return None
+                        blob = disk.read_file(
+                            bucket,
+                            os.path.join(object_name, fi.data_dir, part_file),
+                            file_off,
+                            file_len,
+                        )
+                    return _parse_frames(blob, window_sizes)
+                except (errors.DiskError, errors.FileCorrupt):
+                    return None
 
-        # Read data shards first; pull parity lazily on any failure --
-        # file-level or per-chunk bitrot -- mirroring the lazy-spare
-        # parallelReader (cmd/erasure-decode.go:101-202, readTriggerCh).
-        frames: list[list[tuple[bytes, bytes]] | None] = [None] * (k + mth)
-        loaded = [False] * (k + mth)
-        results = meta_mod.parallel_map(read_shard, list(range(k)))
-        for j in range(k):
-            frames[j] = results[j][0]
-            loaded[j] = True
-
-        def load_spares() -> None:
-            spare = [j for j in range(k + mth) if not loaded[j]]
-            if not spare:
-                return
-            spare_results = meta_mod.parallel_map(read_shard, spare)
-            for idx, j in enumerate(spare):
-                frames[j] = spare_results[idx][0]
+            # Data rows first; parity pulled lazily on any failure (the
+            # lazy-spare parallelReader discipline, erasure-decode.go:119).
+            frames: list[list[tuple[bytes, bytes]] | None] = [None] * (k + mth)
+            loaded = [False] * (k + mth)
+            results = meta_mod.parallel_map(read_window, list(range(k)))
+            for j in range(k):
+                frames[j] = results[j][0]
                 loaded[j] = True
 
-        if any(frames[j] is None for j in range(k)):
-            load_spares()
+            def load_spares() -> None:
+                spare = [j for j in range(k + mth) if not loaded[j]]
+                if not spare:
+                    return
+                spare_results = meta_mod.parallel_map(read_window, spare)
+                for idx, j in enumerate(spare):
+                    frames[j] = spare_results[idx][0]
+                    loaded[j] = True
 
-        out = bytearray()
-        total = part.size
-        for b in range(len(chunk_sizes)):
-            def valid_rows() -> list[bytes | None]:
-                rows: list[bytes | None] = [None] * (k + mth)
-                for j in range(k + mth):
-                    if frames[j] is not None:
-                        digest, chunk = frames[j][b]
-                        if bitrot_mod.digest_of(chunk) == digest:
-                            rows[j] = chunk
-                        else:
-                            frames[j] = None  # corrupt: drop the whole shard
-                return rows
-
-            rows = valid_rows()
-            if sum(1 for r in rows if r is not None) < k:
+            if any(frames[j] is None for j in range(k)):
                 load_spares()
+
+            for b in range(g0, g1 + 1):
+                w = b - g0
+
+                def valid_rows() -> list[bytes | None]:
+                    rows: list[bytes | None] = [None] * (k + mth)
+                    for j in range(k + mth):
+                        if frames[j] is not None:
+                            digest, chunk = frames[j][w]
+                            if bitrot_mod.digest_of(chunk) == digest:
+                                rows[j] = chunk
+                            else:
+                                frames[j] = None  # corrupt: drop the shard
+                    return rows
+
                 rows = valid_rows()
-            present = [j for j in range(k + mth) if rows[j] is not None]
-            if len(present) < k:
-                raise errors.InsufficientReadQuorum(bucket, object_name)
-            if any(rows[j] is None for j in range(k)):
-                want = tuple(j for j in range(k) if rows[j] is None)
-                rebuilt = self.codec.reconstruct(rows, k, mth, want)
-                for idx, j in enumerate(want):
-                    rows[j] = rebuilt[idx]
-            block_len = min(BLOCK_SIZE, total - b * BLOCK_SIZE)
-            joined = b"".join(rows[j] for j in range(k))  # type: ignore[misc]
-            out += joined[:block_len]
-        return bytes(out[:total])
+                if sum(1 for r in rows if r is not None) < k:
+                    load_spares()
+                    rows = valid_rows()
+                present = [j for j in range(k + mth) if rows[j] is not None]
+                if len(present) < k:
+                    raise errors.InsufficientReadQuorum(bucket, object_name)
+                if any(rows[j] is None for j in range(k)):
+                    want = tuple(j for j in range(k) if rows[j] is None)
+                    rebuilt = self.codec.reconstruct(rows, k, mth, want)
+                    for idx, j in enumerate(want):
+                        rows[j] = rebuilt[idx]
+                joined = b"".join(rows[j] for j in range(k))  # type: ignore[misc]
+                s = max(lo - b * BLOCK_SIZE, 0)
+                e = min(hi - b * BLOCK_SIZE, block_len(b))
+                yield joined[s:e]
 
     # ---------------------------------------------------------------- delete
 

@@ -15,6 +15,7 @@ mount — see gateway.py.
 
 from __future__ import annotations
 
+import contextlib
 import hashlib
 import json
 import os
@@ -47,6 +48,8 @@ def _valid_bucket(bucket: str) -> bool:
 
 class FSObjectLayer:
     """ObjectLayer over one filesystem path (fs-v1.go fsObjects role)."""
+
+    supports_streaming = True  # put_object accepts .read(n) streams
 
     def __init__(self, root: str):
         self.root = root
@@ -117,23 +120,43 @@ class FSObjectLayer:
     # -- objects -------------------------------------------------------------
 
     def put_object(
-        self, bucket: str, object_name: str, data: bytes,
+        self, bucket: str, object_name: str, data,
         opts: PutObjectOptions | None = None,
     ) -> ObjectInfo:
+        """data: bytes or a .read(n) stream (streamed straight to disk)."""
         opts = opts or PutObjectOptions()
         self._check_bucket(bucket)
         path = self._obj_path(bucket, object_name)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tmp = path + f".tmp-{uuid.uuid4().hex}"
-        with open(tmp, "wb") as f:
-            f.write(data)
+        md5h = hashlib.md5()
+        size = 0
+        try:
+            with open(tmp, "wb") as f:
+                if isinstance(data, (bytes, bytearray, memoryview)):
+                    buf = bytes(data)
+                    f.write(buf)
+                    md5h.update(buf)
+                    size = len(buf)
+                else:
+                    while True:
+                        chunk = data.read(1 << 20)
+                        if not chunk:
+                            break
+                        f.write(chunk)
+                        md5h.update(chunk)
+                        size += len(chunk)
+        except BaseException:
+            with contextlib.suppress(OSError):
+                os.remove(tmp)
+            raise
         os.replace(tmp, path)  # atomic commit (fs-v1 putObject rename)
-        etag = opts.etag or hashlib.md5(data).hexdigest()
+        etag = opts.etag or md5h.hexdigest()
         meta = {
             "etag": etag,
             "content_type": opts.content_type,
             "mod_time": time.time(),
-            "size": len(data),
+            "size": size,
             "user_defined": dict(opts.user_defined),
         }
         mp = self._meta_path(bucket, object_name)
@@ -189,6 +212,30 @@ class FSObjectLayer:
                 f.seek(offset)
             data = f.read() if length < 0 else f.read(length)
         return oi, data
+
+    def get_object_stream(
+        self, bucket: str, object_name: str,
+        opts: GetObjectOptions | None = None, offset: int = 0, length: int = -1,
+    ):
+        """(ObjectInfo, chunk iterator) — plain-file chunked reads."""
+        oi = self.get_object_info(bucket, object_name, opts)
+        end = oi.size if length < 0 else min(offset + length, oi.size)
+        path = self._obj_path(bucket, object_name)
+
+        def gen():
+            remaining = end - offset
+            if remaining <= 0:
+                return
+            with open(path, "rb") as f:
+                f.seek(offset)
+                while remaining > 0:
+                    chunk = f.read(min(1 << 20, remaining))
+                    if not chunk:
+                        return
+                    remaining -= len(chunk)
+                    yield chunk
+
+        return oi, gen()
 
     def put_object_metadata(
         self, bucket: str, object_name: str, version_id: str = "",

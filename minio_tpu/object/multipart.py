@@ -23,7 +23,7 @@ from ..storage.types import ErasureInfo, FileInfo, ObjectPartInfo, now
 from ..utils import errors
 from ..utils.hashes import hash_order
 from . import metadata as meta_mod
-from .erasure import BLOCK_SIZE, META_BUCKET, ErasureObjects, _frame_shard
+from .erasure import BLOCK_SIZE, META_BUCKET, ErasureObjects
 from .types import ObjectInfo, PutObjectOptions
 
 MIN_PART_SIZE = 5 * (1 << 20)  # S3 minimum (except last part)
@@ -83,8 +83,18 @@ class MultipartManager:
     # -- parts ---------------------------------------------------------------
 
     def put_object_part(
-        self, bucket: str, object_name: str, upload_id: str, part_number: int, data: bytes
+        self, bucket: str, object_name: str, upload_id: str, part_number: int, data
     ) -> ObjectPartInfo:
+        """Streaming part upload: `data` is bytes or a .read(n) stream.
+
+        Blocks are grouped for the device codec and shard frames appended to
+        per-drive staged part files as they are produced (bounded memory;
+        erasure-multipart.go PutObjectPart streams through erasure.Encode the
+        same way). The part stages under a tmp name and is published with a
+        rename, so a re-upload of the same part number never leaves a
+        half-written file behind."""
+        from .erasure import GROUP_BLOCKS, ShardStageWriter, _as_reader, _iter_blocks
+
         if not (1 <= part_number <= MAX_PARTS):
             raise errors.InvalidArgument(bucket, object_name, "bad part number")
         self._upload_meta(bucket, object_name, upload_id)
@@ -93,33 +103,70 @@ class MultipartManager:
         m = self.eo.parity
         k = n - m
         distribution = hash_order(f"{bucket}/{object_name}", n)
-        etag = hashlib.md5(data).hexdigest()
-
-        blocks = [data[i : i + BLOCK_SIZE] for i in range(0, len(data), BLOCK_SIZE)]
-        encoded = self.eo.codec.encode(blocks, k, m) if blocks else []
-        shard_files = [
-            _frame_shard([e[0][row] for e in encoded], [e[1][row] for e in encoded])
-            for row in range(n)
-        ]
-        part_doc = json.dumps(
-            {"number": part_number, "size": len(data), "etag": etag, "mod_time": now()}
-        ).encode()
+        md5h = hashlib.md5()
+        reader = _as_reader(data)
         udir = _upload_dir(bucket, object_name, upload_id)
-
-        def write(args):
-            i, disk = args
-            if disk is None:
-                raise errors.DiskNotFound()
-            row = distribution[i] - 1
-            disk.create_file(META_BUCKET, f"{udir}/part.{part_number}", shard_files[row])
-            disk.write_all(META_BUCKET, f"{udir}/part.{part_number}.meta", part_doc)
-
-        results = meta_mod.parallel_map(write, list(enumerate(self.eo._online())))
-        n_ok = sum(1 for _, e in results if e is None)
+        stage = f"part.{part_number}.tmp.{uuid.uuid4().hex[:8]}"
+        disks = self.eo._online()
+        writer = ShardStageWriter(
+            self.eo.codec, disks, distribution, k, m, lambda i: f"{udir}/{stage}"
+        )
+        ok = writer.ok
         write_quorum = k + 1 if k == m else k
+        size = 0
+
+        def cleanup() -> None:
+            def rm(i):
+                if disks[i] is None:
+                    return
+                try:
+                    disks[i].delete(META_BUCKET, f"{udir}/{stage}")
+                except errors.StorageError:
+                    pass
+
+            meta_mod.parallel_map(rm, list(range(n)))
+
+        try:
+            writer.create()
+            group: list[bytes] = []
+            for block in _iter_blocks(reader, b""):
+                md5h.update(block)
+                size += len(block)
+                group.append(block)
+                if len(group) >= GROUP_BLOCKS:
+                    writer.append_group(group)
+                    group = []
+                    if writer.alive() < write_quorum:
+                        raise errors.ErasureWriteQuorum(
+                            bucket, object_name, "upload part quorum lost mid-stream"
+                        )
+            writer.append_group(group)
+            if writer.alive() < write_quorum:
+                raise errors.ErasureWriteQuorum(bucket, object_name, "upload part quorum")
+        except BaseException:
+            cleanup()
+            raise
+
+        etag = md5h.hexdigest()
+        mod_time = now()
+        part_doc = json.dumps(
+            {"number": part_number, "size": size, "etag": etag, "mod_time": mod_time}
+        ).encode()
+
+        def publish(i):
+            if not ok[i]:
+                raise errors.DiskNotFound()
+            disks[i].rename_file(
+                META_BUCKET, f"{udir}/{stage}", META_BUCKET, f"{udir}/part.{part_number}"
+            )
+            disks[i].write_all(META_BUCKET, f"{udir}/part.{part_number}.meta", part_doc)
+
+        results = meta_mod.parallel_map(publish, list(range(n)))
+        n_ok = sum(1 for _, e in results if e is None)
         if n_ok < write_quorum:
+            cleanup()
             raise errors.ErasureWriteQuorum(bucket, object_name, "upload part quorum")
-        return ObjectPartInfo(part_number, len(data), len(data), now(), etag)
+        return ObjectPartInfo(part_number, size, size, mod_time, etag)
 
     def list_parts(
         self, bucket: str, object_name: str, upload_id: str, part_marker: int = 0, max_parts: int = 1000

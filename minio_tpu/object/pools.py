@@ -29,6 +29,10 @@ from .types import (
 
 
 class ServerPools:
+    # The API front streams request/response bodies through this layer
+    # (put_object accepts a .read(n) stream; get_object_stream exists).
+    supports_streaming = True
+
     def __init__(self, pools: list[ErasureSets]):
         if not pools:
             raise ValueError("need at least one pool")
@@ -139,6 +143,24 @@ class ServerPools:
         for p in self.pools:
             try:
                 return p.get_object(bucket, object_name, opts, offset, length)
+            except (errors.ObjectNotFound, errors.VersionNotFound) as e:
+                last = e
+        raise last
+
+    def get_object_stream(
+        self,
+        bucket: str,
+        object_name: str,
+        opts: GetObjectOptions | None = None,
+        offset: int = 0,
+        length: int = -1,
+    ):
+        """Streaming get: (ObjectInfo, iterator of decoded chunks)."""
+        opts = opts or GetObjectOptions()
+        last: Exception = errors.ObjectNotFound(bucket, object_name)
+        for p in self.pools:
+            try:
+                return p.get_object_stream(bucket, object_name, opts, offset, length)
             except (errors.ObjectNotFound, errors.VersionNotFound) as e:
                 last = e
         raise last

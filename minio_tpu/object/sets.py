@@ -142,6 +142,13 @@ class ErasureSets:
     def get_object(self, bucket, object_name, opts: GetObjectOptions | None = None, offset=0, length=-1):
         return self.get_hashed_set(object_name).get_object(bucket, object_name, opts, offset, length)
 
+    def get_object_stream(
+        self, bucket, object_name, opts: GetObjectOptions | None = None, offset=0, length=-1
+    ):
+        return self.get_hashed_set(object_name).get_object_stream(
+            bucket, object_name, opts, offset, length
+        )
+
     def get_object_info(self, bucket, object_name, opts: GetObjectOptions | None = None):
         return self.get_hashed_set(object_name).get_object_info(bucket, object_name, opts)
 

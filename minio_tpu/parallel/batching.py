@@ -68,6 +68,10 @@ class BatchingDeviceCodec(BlockCodec):
         self._threads: dict[tuple[int, int], threading.Thread] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        # Served-traffic counters (admin/metrics + tests assert the device
+        # pipeline actually carries production blocks).
+        self.blocks_encoded = 0
+        self.batches_run = 0
 
     # -- worker management ---------------------------------------------------
 
@@ -83,8 +87,8 @@ class BatchingDeviceCodec(BlockCodec):
                 t = threading.Thread(
                     target=self._worker, args=(key,), daemon=True, name=f"encode-batch-{k}-{m}"
                 )
+                t.start()  # start before registering: close() joins registrants
                 self._threads[key] = t
-                t.start()
         return self._queues[key]
 
     def _worker(self, key: tuple[int, int]) -> None:
@@ -122,6 +126,8 @@ class BatchingDeviceCodec(BlockCodec):
             for i, req in enumerate(batch):
                 arr[i] = req.shards
             shards, digests = pipe.encode(arr)
+            self.batches_run += 1
+            self.blocks_encoded += b_real
             shards_np = np.asarray(shards)
             digests_np = np.asarray(digests)
             for i, req in enumerate(batch):
@@ -168,3 +174,10 @@ class BatchingDeviceCodec(BlockCodec):
 
     def close(self) -> None:
         self._stop.set()
+        with self._lock:
+            threads = list(self._threads.values())
+        for t in threads:
+            try:
+                t.join(timeout=1.0)
+            except RuntimeError:  # raced a thread mid-start
+                pass

@@ -1,0 +1,113 @@
+"""Data-plane runtime: install the block codec the server actually serves with.
+
+The reference's hot path always runs its fast codec (AVX2 reedsolomon,
+cmd/erasure-coding.go:63). Here the equivalent decision happens once at boot:
+if an accelerator is reachable, every PutObject/heal block goes through the
+cross-request batching device pipeline (parallel/batching.py); otherwise the
+host C++/numpy codec serves (object/codec.py HostCodec).
+
+Device init is probed in a bounded subprocess first: the environment may
+register a hardware TPU plugin whose in-process client init can block on a
+tunnel, and server boot must never wedge on it.
+
+Env:
+    MINIO_TPU_CODEC = auto | device | host   (default auto)
+    MINIO_TPU_DEVICE_PROBE_S                 probe timeout, default 60
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+
+from .object import codec as codec_mod
+
+
+def probe_device(timeout_s: float) -> str | None:
+    """Bounded subprocess probe of jax device init; platform name or None."""
+    code = "import jax; print(jax.devices()[0].platform, flush=True)"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=timeout_s,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if out.returncode == 0 and out.stdout.strip():
+        return out.stdout.strip().splitlines()[-1]
+    return None
+
+
+def _make_batching():
+    from .parallel.batching import BatchingDeviceCodec
+
+    codec = BatchingDeviceCodec()
+
+    # Warm the jitted pipeline for the production geometry off the serving
+    # path (first XLA compile can take tens of seconds; a cold first
+    # PutObject should not eat it).
+    def _warm():
+        try:
+            block = b"\0" * codec.block_size
+            codec.encode([block], 12, 4)
+        except Exception:  # noqa: BLE001 - warmup is best-effort
+            pass
+
+    threading.Thread(target=_warm, daemon=True, name="codec-warmup").start()
+    return codec
+
+
+_closed = False
+
+
+def install_data_plane_codec(
+    mode: str | None = None,
+    probe_timeout_s: float | None = None,
+    background: bool = False,
+) -> codec_mod.BlockCodec:
+    """Pick + install the process-wide codec; returns it.
+
+    With background=True (server boot), auto mode installs the host codec
+    immediately and upgrades the process default to the batching device
+    codec from a daemon thread once the probe lands -- boot never blocks on
+    a wedged device tunnel, and the object layer's lazy default-codec
+    resolution makes the swap take effect on live traffic."""
+    global _closed
+    _closed = False
+    mode = (mode or os.environ.get("MINIO_TPU_CODEC", "auto")).lower()
+    if probe_timeout_s is None:
+        probe_timeout_s = float(os.environ.get("MINIO_TPU_DEVICE_PROBE_S", "60"))
+    if mode == "host":
+        codec: codec_mod.BlockCodec = codec_mod.HostCodec()
+    elif mode == "device":
+        codec = _make_batching()
+    elif background:
+        codec = codec_mod.HostCodec()
+        codec_mod.set_default_codec(codec)
+
+        def _bg(timeout=probe_timeout_s):
+            platform = probe_device(timeout)
+            if platform not in (None, "cpu") and not _closed:
+                codec_mod.set_default_codec(_make_batching())
+
+        threading.Thread(target=_bg, daemon=True, name="codec-probe").start()
+        return codec
+    else:  # auto, synchronous: only pay device round trips for an accelerator
+        platform = probe_device(probe_timeout_s)
+        codec = _make_batching() if platform not in (None, "cpu") else codec_mod.HostCodec()
+    codec_mod.set_default_codec(codec)
+    return codec
+
+
+def shutdown_data_plane(codec: codec_mod.BlockCodec | None = None) -> None:
+    """Close the batching codec (if installed); safe to call many times."""
+    global _closed
+    _closed = True
+    for c in {id(codec): codec, id(codec_mod._default): codec_mod._default}.values():
+        close = getattr(c, "close", None)
+        if close is not None:
+            close()

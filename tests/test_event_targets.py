@@ -23,7 +23,7 @@ from minio_tpu.utils import errors
 RECORD = {"EventName": "s3:ObjectCreated:Put", "Key": "b/o.txt", "Records": []}
 
 
-def _wait(cond, timeout=5.0):
+def _wait(cond, timeout=15.0):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
         if cond():

@@ -1,0 +1,270 @@
+"""Streaming data-path tests (VERDICT #3): bounded-memory put/get/range.
+
+Mirrors the reference's discipline: 1 MiB blocks stream end to end, range
+reads map to block/shard offsets and touch only covered frames
+(cmd/erasure-encode.go:73-109, erasure-decode.go:31-202,
+erasure-coding.go:141 ShardFileOffset).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from minio_tpu.object.codec import HostCodec
+from minio_tpu.object.erasure import (
+    BLOCK_SIZE,
+    DIGEST_LEN,
+    GROUP_BLOCKS,
+    ErasureObjects,
+)
+from minio_tpu.storage import format as fmt
+from minio_tpu.storage.local import LocalDrive
+
+
+class CountingDrive(LocalDrive):
+    """LocalDrive recording every shard-file read (path, offset, length)."""
+
+    def __init__(self, root):
+        super().__init__(root)
+        self.reads: list[tuple[str, int, int]] = []
+
+    def read_file(self, volume, path, offset=0, length=-1):
+        data = super().read_file(volume, path, offset, length)
+        self.reads.append((path, offset, len(data)))
+        return data
+
+
+class RecordingCodec(HostCodec):
+    def __init__(self):
+        super().__init__()
+        self.encode_sizes: list[int] = []
+
+    def encode(self, blocks, k, m):
+        self.encode_sizes.append(len(blocks))
+        return super().encode(blocks, k, m)
+
+
+@pytest.fixture
+def counted(tmp_path):
+    n = 8
+    dirs = [str(tmp_path / f"disk{i}") for i in range(n)]
+    formats = fmt.init_format(1, n)
+    drives = []
+    for d, f in zip(dirs, formats):
+        os.makedirs(d, exist_ok=True)
+        f.save(d)
+        drives.append(CountingDrive(d))
+    codec = RecordingCodec()
+    layer = ErasureObjects(drives, codec=codec)
+    layer.make_bucket("b")
+    return layer, drives, codec
+
+
+def _body(size: int, seed: int = 0) -> bytes:
+    return np.random.default_rng(seed).integers(0, 256, size, dtype=np.uint8).tobytes()
+
+
+def test_round_trip_and_ranges(counted):
+    layer, drives, codec = counted
+    body = _body(5 * BLOCK_SIZE + 12345)
+    layer.put_object("b", "o", body)
+    _, got = layer.get_object("b", "o")
+    assert got == body
+    for off, ln in [(0, 10), (BLOCK_SIZE - 5, 10), (3 * BLOCK_SIZE + 7, 2 * BLOCK_SIZE),
+                    (len(body) - 9, 9), (len(body), 0), (0, -1), (12345, -1)]:
+        _, got = layer.get_object("b", "o", offset=off, length=ln)
+        end = len(body) if ln < 0 else min(off + ln, len(body))
+        assert got == body[off:end], (off, ln)
+
+
+def test_range_read_touches_only_covered_blocks(counted):
+    """A small range read of a large object reads <=2 blocks' frames per
+    shard file, from the mapped offset -- never the whole file."""
+    layer, drives, codec = counted
+    k = layer._data_blocks()
+    body = _body(32 * BLOCK_SIZE)  # 32 MiB, 32 blocks
+    layer.put_object("b", "big", body)
+    for d in drives:
+        d.reads.clear()
+
+    off = 17 * BLOCK_SIZE + 100
+    _, got = layer.get_object("b", "big", offset=off, length=1000)
+    assert got == body[off : off + 1000]
+
+    chunk_full = -(-BLOCK_SIZE // k)
+    frame_full = DIGEST_LEN + chunk_full
+    part_reads = [r for d in drives for r in d.reads if "part.1" in r[0]]
+    # Only the k data shards are read, one windowed read each.
+    assert len(part_reads) == k, part_reads
+    for path, offset, length in part_reads:
+        assert offset == 17 * frame_full
+        assert length <= 2 * frame_full
+
+
+def test_streaming_put_bounded_groups(counted):
+    """Encode runs in GROUP_BLOCKS batches -- the working set is bounded."""
+    layer, drives, codec = counted
+    body = _body(40 * BLOCK_SIZE + 777)
+    layer.put_object("b", "g", body)
+    assert max(codec.encode_sizes) <= GROUP_BLOCKS
+    # 41 blocks -> at least 3 groups.
+    put_calls = [s for s in codec.encode_sizes if s > 0]
+    assert sum(put_calls) == 41
+    _, got = layer.get_object("b", "g")
+    assert got == body
+
+
+def test_streaming_reader_input(counted):
+    """put_object accepts a .read(n) stream and never materializes it."""
+    layer, drives, codec = counted
+
+    class ChunkReader:
+        def __init__(self, total, chunk=65536):
+            self.total, self.pos, self.chunk = total, 0, chunk
+
+        def read(self, n):
+            n = min(n, self.chunk, self.total - self.pos)
+            if n <= 0:
+                return b""
+            out = (self.pos % 251).to_bytes(1, "big") * n
+            self.pos += n
+            return out
+
+    total = 7 * BLOCK_SIZE + 99
+    oi = layer.put_object("b", "r", ChunkReader(total))
+    assert oi.size == total
+    _, got = layer.get_object("b", "r")
+    want = b"".join((p % 251).to_bytes(1, "big") for p in range(0, 1))  # spot checks below
+    assert len(got) == total
+    # Spot-check bytes at chunk boundaries.
+    for pos in [0, 65535, 65536, BLOCK_SIZE, total - 1]:
+        assert got[pos : pos + 1] == ((pos - pos % 65536) % 251).to_bytes(1, "big"), pos
+
+
+def test_degraded_windowed_read(counted, tmp_path):
+    """Range reads reconstruct from parity when data shards are lost or
+    corrupt -- spares loaded for the same window only."""
+    layer, drives, codec = counted
+    body = _body(10 * BLOCK_SIZE + 5)
+    layer.put_object("b", "d", body)
+
+    # Kill two drives entirely (parity for 8 drives = 4).
+    layer.disks[0] = None
+    layer.disks[3] = None
+    _, got = layer.get_object("b", "d", offset=9 * BLOCK_SIZE, length=BLOCK_SIZE + 5)
+    assert got == body[9 * BLOCK_SIZE :]
+    _, got = layer.get_object("b", "d")
+    assert got == body
+
+
+def test_multipart_zero_byte_part(counted):
+    """S3 permits a zero-byte (only/last) part -- e.g. an empty object
+    created via multipart upload."""
+    layer, drives, codec = counted
+    mp = layer.multipart
+    up = mp.new_multipart_upload("b", "empty")
+    p1 = mp.put_object_part("b", "empty", up, 1, b"")
+    assert p1.size == 0
+    mp.complete_multipart_upload("b", "empty", up, [(1, p1.etag)])
+    oi, got = layer.get_object("b", "empty")
+    assert got == b""
+    assert oi.size == 0
+
+
+def test_multipart_streaming_and_cross_part_range(counted):
+    layer, drives, codec = counted
+    mp = layer.multipart
+    up = mp.new_multipart_upload("b", "mp")
+    p1_body = _body(5 * (1 << 20), seed=1)
+    p2_body = _body(3 * (1 << 20) + 17, seed=2)
+    p1 = mp.put_object_part("b", "mp", up, 1, p1_body)
+    p2 = mp.put_object_part("b", "mp", up, 2, p2_body)
+    assert max(codec.encode_sizes) <= GROUP_BLOCKS
+    mp.complete_multipart_upload("b", "mp", up, [(1, p1.etag), (2, p2.etag)])
+    full = p1_body + p2_body
+    _, got = layer.get_object("b", "mp")
+    assert got == full
+    # Range crossing the part boundary.
+    off = 5 * (1 << 20) - 1000
+    _, got = layer.get_object("b", "mp", offset=off, length=2000)
+    assert got == full[off : off + 2000]
+
+
+def test_get_object_stream_yields_chunks(counted):
+    layer, drives, codec = counted
+    body = _body(3 * BLOCK_SIZE)
+    layer.put_object("b", "s", body)
+    oi, stream = layer.get_object_stream("b", "s")
+    chunks = list(stream)
+    assert all(len(c) <= BLOCK_SIZE for c in chunks)
+    assert b"".join(chunks) == body
+    assert oi.size == len(body)
+
+
+_RSS_SCRIPT = r"""
+import os, resource, sys
+sys.path.insert(0, {repo!r})
+from minio_tpu.object.erasure import ErasureObjects, BLOCK_SIZE
+from minio_tpu.storage import format as fmt
+from minio_tpu.storage.local import LocalDrive
+
+root = {root!r}
+n = 8
+drives = []
+formats = fmt.init_format(1, n)
+for i, f in enumerate(formats):
+    d = os.path.join(root, f"disk{{i}}")
+    os.makedirs(d, exist_ok=True)
+    f.save(d)
+    drives.append(LocalDrive(d))
+layer = ErasureObjects(drives)
+layer.make_bucket("b")
+baseline_mib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+
+TOTAL = 512 * (1 << 20)
+
+class Gen:
+    def __init__(self):
+        self.pos = 0
+    def read(self, nbytes):
+        nbytes = min(nbytes, TOTAL - self.pos)
+        if nbytes <= 0:
+            return b""
+        out = bytes([self.pos // BLOCK_SIZE % 256]) * nbytes
+        self.pos += nbytes
+        return out
+
+layer.put_object("b", "huge", Gen())
+oi, stream = layer.get_object_stream("b", "huge")
+total = 0
+for i, chunk in enumerate(stream):
+    total += len(chunk)
+assert total == TOTAL, total
+peak_mib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+delta = peak_mib - baseline_mib
+print("BASELINE_MIB", baseline_mib, "PEAK_MIB", peak_mib, "DELTA_MIB", delta)
+assert delta < 160, f"RSS grew {{delta}} MiB over baseline (O(objectSize) would be >1200)"
+print("OK")
+"""
+
+
+def test_large_object_bounded_rss(tmp_path):
+    """512 MiB object put+get in a clean subprocess grows RSS by <160 MiB
+    over the post-import baseline (O(objectSize) buffering would need
+    ~1.2 GiB: the object plus its encoded shard files)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = _RSS_SCRIPT.format(repo=repo, root=str(tmp_path))
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "OK" in out.stdout
