@@ -7,8 +7,15 @@ cross-request batching device pipeline (parallel/batching.py); otherwise the
 host C++/numpy codec serves (object/codec.py HostCodec).
 
 Device init is probed in a bounded subprocess first: the environment may
-register a hardware TPU plugin whose in-process client init can block on a
-tunnel, and server boot must never wedge on it.
+register a hardware TPU plugin whose in-process client init can block forever
+on a dead tunnel (observed: PJRT make_c_api_client retrying a refused relay
+at 127.0.0.1:8083), and server boot must never wedge on it. The probe
+ * runs exactly once per process (cached — repeated Node builds / tests
+   must not fork probe swarms),
+ * is spawned in its own session and killed as a process group on timeout
+   (no orphaned children holding tunnel connections),
+ * keeps the child's stdout/stderr tail — including an in-child
+   faulthandler dump of the wedged stack — so a timeout carries evidence.
 
 Env:
     MINIO_TPU_CODEC = auto | device | host   (default auto)
@@ -17,29 +24,147 @@ Env:
 
 from __future__ import annotations
 
+import atexit
 import os
+import signal
 import subprocess
 import sys
+import tempfile
 import threading
+from dataclasses import dataclass, field
 
 from .object import codec as codec_mod
 
 
-def probe_device(timeout_s: float) -> str | None:
-    """Bounded subprocess probe of jax device init; platform name or None."""
-    code = "import jax; print(jax.devices()[0].platform, flush=True)"
+@dataclass
+class ProbeResult:
+    """Outcome of one bounded device-init probe."""
+
+    platform: str | None  # "tpu"/"axon"/... on success, None on failure
+    device_kind: str | None = None
+    error: str | None = None  # short reason on failure
+    detail: str = ""  # stdout+stderr tail (faulthandler dump, relay checks)
+
+    @property
+    def ok(self) -> bool:
+        return self.platform not in (None, "cpu")
+
+
+_live_probe_pgids: set[int] = set()
+_probe_lock = threading.Lock()
+_probe_once_lock = threading.Lock()  # single-flight: at most one child at a time
+_probe_cache: ProbeResult | None = None
+_atexit_registered = False
+
+
+def _reap_live_probes() -> None:
+    """Kill any probe process groups still alive at interpreter exit."""
+    with _probe_lock:
+        pgids = list(_live_probe_pgids)
+        _live_probe_pgids.clear()
+    for pgid in pgids:
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+
+def _tail(text: str, limit: int = 4000) -> str:
+    return text[-limit:] if len(text) > limit else text
+
+
+def probe_device(timeout_s: float, use_cache: bool = True) -> ProbeResult:
+    """Bounded, evidence-preserving, non-leaking probe of jax device init.
+
+    The child (``minio_tpu._probe_child``) prints relay-port reachability and
+    arms a faulthandler dump before importing jax, so on timeout the captured
+    tail pinpoints the wedge. The child runs in its own session; on timeout
+    its whole process group is SIGKILLed, and an atexit hook reaps any probe
+    that outlives us (e.g. a daemon-thread caller exiting mid-probe).
+    """
+    global _probe_cache, _atexit_registered
+    # Single-flight: concurrent callers (e.g. several in-process nodes booting
+    # with background installs) must not fork a probe swarm — the second
+    # caller waits and gets the first's cached result.
+    with _probe_once_lock:
+        with _probe_lock:
+            if use_cache and _probe_cache is not None:
+                return _probe_cache
+            if not _atexit_registered:
+                atexit.register(_reap_live_probes)
+                _atexit_registered = True
+        return _probe_uncached(timeout_s)
+
+
+def _probe_uncached(timeout_s: float) -> ProbeResult:
+    global _probe_cache
+    out_f = tempfile.TemporaryFile(mode="w+b")
+    err_f = tempfile.TemporaryFile(mode="w+b")
     try:
-        out = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            text=True,
-            timeout=timeout_s,
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "minio_tpu._probe_child", str(timeout_s)],
+            stdout=out_f,
+            stderr=err_f,
+            start_new_session=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         )
-    except (subprocess.TimeoutExpired, OSError):
-        return None
-    if out.returncode == 0 and out.stdout.strip():
-        return out.stdout.strip().splitlines()[-1]
-    return None
+    except OSError as e:
+        out_f.close()
+        err_f.close()
+        result = ProbeResult(None, error=f"spawn failed: {e}")
+        with _probe_lock:
+            _probe_cache = result
+        return result
+
+    pgid = proc.pid  # start_new_session=True -> child leads its own pgrp
+    with _probe_lock:
+        _live_probe_pgids.add(pgid)
+    try:
+        try:
+            proc.wait(timeout=timeout_s)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            timed_out = True
+            try:
+                os.killpg(pgid, signal.SIGKILL)
+            except (ProcessLookupError, OSError):
+                pass
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+    finally:
+        with _probe_lock:
+            _live_probe_pgids.discard(pgid)
+
+    out_f.seek(0)
+    err_f.seek(0)
+    # SIGKILL can truncate mid-multibyte-sequence, and native PJRT/absl logs
+    # aren't guaranteed UTF-8 — never let decoding errors mask the evidence.
+    stdout = out_f.read().decode("utf-8", errors="replace")
+    stderr = err_f.read().decode("utf-8", errors="replace")
+    out_f.close()
+    err_f.close()
+    detail = _tail(stdout + ("\n--- stderr ---\n" + stderr if stderr else ""))
+
+    if timed_out:
+        result = ProbeResult(
+            None, error=f"device init wedged past {timeout_s:.0f}s (killed pg)", detail=detail
+        )
+    else:
+        ok_line = next(
+            (ln for ln in reversed(stdout.splitlines()) if ln.startswith("PROBE_OK ")), None
+        )
+        if proc.returncode == 0 and ok_line:
+            parts = ok_line.split()
+            result = ProbeResult(parts[1], parts[2] if len(parts) > 2 else None, detail=detail)
+        else:
+            result = ProbeResult(
+                None, error=f"probe exit={proc.returncode}", detail=detail
+            )
+    with _probe_lock:
+        _probe_cache = result
+    return result
 
 
 def _make_batching():
@@ -61,6 +186,10 @@ def _make_batching():
     return codec
 
 
+# install/shutdown share one lock so a background probe can't install a fresh
+# device codec (spawning worker threads) after shutdown already closed the
+# data plane (TOCTOU the advisor flagged).
+_state_lock = threading.Lock()
 _closed = False
 
 
@@ -77,7 +206,8 @@ def install_data_plane_codec(
     a wedged device tunnel, and the object layer's lazy default-codec
     resolution makes the swap take effect on live traffic."""
     global _closed
-    _closed = False
+    with _state_lock:
+        _closed = False
     mode = (mode or os.environ.get("MINIO_TPU_CODEC", "auto")).lower()
     if probe_timeout_s is None:
         probe_timeout_s = float(os.environ.get("MINIO_TPU_DEVICE_PROBE_S", "60"))
@@ -90,24 +220,36 @@ def install_data_plane_codec(
         codec_mod.set_default_codec(codec)
 
         def _bg(timeout=probe_timeout_s):
-            platform = probe_device(timeout)
-            if platform not in (None, "cpu") and not _closed:
-                codec_mod.set_default_codec(_make_batching())
+            if not probe_device(timeout).ok:
+                return
+            with _state_lock:
+                if _closed:
+                    return
+                dev = _make_batching()
+                codec_mod.set_default_codec(dev)
 
         threading.Thread(target=_bg, daemon=True, name="codec-probe").start()
         return codec
     else:  # auto, synchronous: only pay device round trips for an accelerator
-        platform = probe_device(probe_timeout_s)
-        codec = _make_batching() if platform not in (None, "cpu") else codec_mod.HostCodec()
-    codec_mod.set_default_codec(codec)
+        codec = _make_batching() if probe_device(probe_timeout_s).ok else codec_mod.HostCodec()
+    with _state_lock:
+        if _closed:
+            # shutdown_data_plane raced us: don't install after shutdown.
+            close = getattr(codec, "close", None)
+            if close is not None:
+                close()
+            return codec
+        codec_mod.set_default_codec(codec)
     return codec
 
 
 def shutdown_data_plane(codec: codec_mod.BlockCodec | None = None) -> None:
     """Close the batching codec (if installed); safe to call many times."""
     global _closed
-    _closed = True
-    for c in {id(codec): codec, id(codec_mod._default): codec_mod._default}.values():
+    with _state_lock:
+        _closed = True
+        targets = {id(codec): codec, id(codec_mod._default): codec_mod._default}
+    for c in targets.values():
         close = getattr(c, "close", None)
         if close is not None:
             close()

@@ -34,19 +34,19 @@ def test_install_host_mode():
 
 
 def test_install_auto_falls_back_without_device(monkeypatch):
-    monkeypatch.setattr(runtime, "probe_device", lambda t: None)
+    monkeypatch.setattr(runtime, "probe_device", lambda t: runtime.ProbeResult(None, error="x"))
     codec = runtime.install_data_plane_codec(mode="auto")
     assert isinstance(codec, HostCodec)
 
 
 def test_install_auto_cpu_platform_uses_host(monkeypatch):
-    monkeypatch.setattr(runtime, "probe_device", lambda t: "cpu")
+    monkeypatch.setattr(runtime, "probe_device", lambda t: runtime.ProbeResult("cpu"))
     codec = runtime.install_data_plane_codec(mode="auto")
     assert isinstance(codec, HostCodec)
 
 
 def test_install_auto_accelerator_uses_batching(monkeypatch):
-    monkeypatch.setattr(runtime, "probe_device", lambda t: "tpu")
+    monkeypatch.setattr(runtime, "probe_device", lambda t: runtime.ProbeResult("tpu"))
     codec = runtime.install_data_plane_codec(mode="auto")
     try:
         assert isinstance(codec, BatchingDeviceCodec)
@@ -91,7 +91,7 @@ def test_background_upgrade_reaches_serving_layer(tmp_path, monkeypatch):
     def slow_probe(timeout):
         probe_started.set()
         probe_release.wait(10)
-        return "tpu"
+        return runtime.ProbeResult("tpu")
 
     monkeypatch.setattr(runtime, "probe_device", slow_probe)
     monkeypatch.setenv("MINIO_TPU_CODEC", "auto")
