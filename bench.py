@@ -152,11 +152,32 @@ def device_metrics() -> dict:
         r = fused(fdev)
     jax.block_until_ready(r)
     fused_gibs = FUSED_BATCH * BLOCK * fiters / (time.perf_counter() - t0) / (1 << 30)
+
+    # Fused Pallas kernel (ops/rs_pallas.py): VMEM-resident bit expansion.
+    # Never let a Mosaic regression break the bench line — but a 0.0 must
+    # carry its cause (pallas_error), not masquerade as "not measured".
+    pallas_gibs = 0.0
+    pallas_error = ""
+    try:
+        from minio_tpu.ops.rs_pallas import RSPallasCodec
+
+        pcodec = RSPallasCodec(K, M)
+        penc = jax.jit(pcodec.encode)
+        penc(dev).block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(ITERS):
+            out = penc(dev)
+        out.block_until_ready()
+        pallas_gibs = BATCH * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
+    except Exception as e:  # noqa: BLE001
+        pallas_error = f"{type(e).__name__}: {e}"[:500]
     return {
         "platform": platform,
         "encode_gibs": enc_gibs,
         "decode_recon4_gibs": dec_gibs,
         "fused_encode_hash_gibs": fused_gibs,
+        "pallas_encode_gibs": pallas_gibs,
+        "pallas_error": pallas_error,
     }
 
 
@@ -235,6 +256,8 @@ def main() -> None:
             "device": dm["platform"] != "cpu",
             "cpu_avx2_gibs": round(cpu_enc, 3),
             "fused_encode_hash_gibs": round(dm["fused_encode_hash_gibs"], 3),
+            "pallas_encode_gibs": round(dm.get("pallas_encode_gibs", 0.0), 3),
+            "pallas_error": dm.get("pallas_error", ""),
             "decode_recon4_gibs": round(dm["decode_recon4_gibs"], 3),
             "cpu_decode_recon4_gibs": round(cpu_dec, 3),
             "decode_vs_baseline": (

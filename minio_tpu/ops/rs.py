@@ -12,8 +12,10 @@ decode/reconstruct, and heal all reduce to this one kernel with different
 coefficient matrices (reference equivalents: Encode/ReconstructData/Heal at
 /root/reference/cmd/erasure-coding.go:77-119 and erasure-lowlevel-heal.go:31).
 
-This module is the XLA-only path; ops/rs_pallas.py provides the fused Pallas
-kernel that keeps the 8x bit expansion in VMEM instead of HBM.
+This module is the XLA path; ops/rs_pallas.py is the fused Pallas kernel
+that keeps the 8x bit expansion in VMEM instead of HBM (bit-identical --
+tests/test_rs_pallas.py pins both against the host reference). bench.py
+measures both on the live chip.
 """
 
 from __future__ import annotations
@@ -62,7 +64,7 @@ def gf_matmul(data: jax.Array, w_bits: jax.Array) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=64)
-def _parity_weights(k: int, m: int) -> np.ndarray:
+def parity_weights(k: int, m: int) -> np.ndarray:
     # numpy, not jnp: this cache is populated from inside jit traces, and a
     # jnp constant created there would be a leaked Tracer on the next trace.
     return rs_matrix.bit_expand(rs_matrix.parity_matrix(k, m)).astype(np.int8)
@@ -70,7 +72,7 @@ def _parity_weights(k: int, m: int) -> np.ndarray:
 
 @functools.partial(jax.jit, static_argnums=(1,))
 def _encode_jit(data: jax.Array, km: tuple[int, int]) -> jax.Array:
-    return gf_matmul(data, jnp.asarray(_parity_weights(*km)))
+    return gf_matmul(data, jnp.asarray(parity_weights(*km)))
 
 
 class RSCodec:

@@ -1,0 +1,128 @@
+"""Fused Pallas TPU kernel for the GF(2) bit-matmul Reed-Solomon codec.
+
+The XLA path (ops/rs.py) materializes the 8x bit expansion of every shard
+byte as an int8 tensor between HBM round-trips unless XLA happens to fuse
+it. This kernel pins the whole unpack -> MXU matmul -> mod-2 -> repack
+chain in VMEM per tile: the only HBM traffic is the u8 shard bytes in and
+the u8 parity bytes out (the op is HBM-bandwidth-bound; the matmul itself
+is a skinny [R*8, K*8] x [K*8, TILE_S] int8 contraction).
+
+Formulation (identical math to ops/rs.py, transposed to keep the shard
+byte axis in lanes):
+    bits[k*8+b, s] = (data[k, s] >> b) & 1          # VMEM sublane expand
+    acc            = W_bits @ bits                   # MXU int8 -> int32
+    parity[r, s]   = sum_b ((acc[r*8+b, s] & 1) << b)  # VPU repack
+
+Bit-exactness is pinned by tests against ops/rs_ref (and transitively the
+reference's golden self-test vectors, /root/reference/cmd/erasure-coding.go:
+158-216). Encode and reconstruct are the same kernel with different
+coefficient matrices (reference: Encode/ReconstructData at
+cmd/erasure-coding.go:77-109, heal at cmd/erasure-lowlevel-heal.go:31).
+
+Off-TPU the kernel runs in interpret mode (tests); on a real chip
+`encode_all` / `apply` are drop-in peers of ops/rs.RSCodec and bench.py
+measures both so the faster path can be picked per-platform.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from . import rs, rs_matrix
+
+# Lane tile along the shard-byte axis. 2048 keeps per-tile VMEM below ~1 MiB
+# for K=16 ((K*8) x 2048 int8 bits = 256 KiB) with room for double buffering.
+TILE_S = 2048
+
+
+def _interpret() -> bool:
+    # Interpret only where Mosaic can't run (host CPU in tests). The live
+    # chip registers as "tpu" OR "axon" (tunnel PJRT plugin) — both must get
+    # the real kernel, not the interpreter.
+    return jax.default_backend() == "cpu"
+
+
+def _kernel(w_ref, x_ref, o_ref, *, k: int, r: int, ts: int):
+    shifts = jax.lax.broadcasted_iota(jnp.uint8, (1, 8, 1), 1)
+    x = x_ref[0]  # [K, TS] u8
+    bits = ((x[:, None, :] >> shifts) & jnp.uint8(1)).astype(jnp.int8)
+    bits = bits.reshape(k * 8, ts)
+    acc = jax.lax.dot_general(
+        w_ref[:],
+        bits,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # [R*8, TS]
+    accb = (acc & 1).astype(jnp.uint8).reshape(r, 8, ts)
+    o_ref[0] = jnp.sum(accb << shifts, axis=1, dtype=jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _apply_padded(data: jax.Array, w_bits: jax.Array, k: int, r: int) -> jax.Array:
+    """[B, K, S_pad] u8 x [R*8, K*8] int8 -> [B, R, S_pad] u8 (S_pad % TILE_S == 0)."""
+    b, _, s_pad = data.shape
+    grid = (b, s_pad // TILE_S)
+    return pl.pallas_call(
+        functools.partial(_kernel, k=k, r=r, ts=TILE_S),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((r * 8, k * 8), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, k, TILE_S), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, r, TILE_S), lambda i, j: (i, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((b, r, s_pad), jnp.uint8),
+        interpret=_interpret(),
+    )(w_bits, data)
+
+
+def _pad_s(x: jax.Array) -> jax.Array:
+    s = x.shape[-1]
+    pad = (-s) % TILE_S
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    return x
+
+
+def apply(data: jax.Array, w_bits: jax.Array) -> jax.Array:
+    """[B, K, S] u8 shards x bit-expanded [K*8, R*8] weights -> [B, R, S] u8.
+
+    Weight orientation matches ops/rs.gf_matmul (bit_expand output); the
+    kernel wants [R*8, K*8] so it transposes once host-side.
+    """
+    k8, r8 = w_bits.shape
+    s = data.shape[-1]
+    out = _apply_padded(_pad_s(data), jnp.asarray(w_bits).T.astype(jnp.int8), k8 // 8, r8 // 8)
+    return out[..., :s]
+
+
+class RSPallasCodec:
+    """Drop-in peer of ops/rs.RSCodec backed by the fused Pallas kernel."""
+
+    def __init__(self, k: int, m: int):
+        if k <= 0 or m <= 0:
+            raise ValueError("data and parity counts must be positive")
+        if k + m > rs_matrix.MAX_SHARDS:
+            raise ValueError(f"at most {rs_matrix.MAX_SHARDS} shards")
+        self.k = k
+        self.m = m
+        self._w_parity = rs.parity_weights(k, m)
+
+    def encode(self, data_shards: jax.Array) -> jax.Array:
+        """[B, K, S] u8 -> [B, M, S] parity."""
+        return apply(data_shards, self._w_parity)
+
+    def encode_all(self, data_shards: jax.Array) -> jax.Array:
+        parity = self.encode(data_shards)
+        return jnp.concatenate([data_shards, parity], axis=-2)
+
+    def reconstruct_weights(self, present: tuple[bool, ...], want: tuple[int, ...]):
+        coeffs = rs_matrix.reconstruct_rows(self.k, self.m, present, want)
+        return rs_matrix.bit_expand(coeffs).astype(np.int8)  # same lift as rs.RSCodec
+
+    def apply(self, survivors: jax.Array, w_bits) -> jax.Array:
+        return apply(survivors, w_bits)
