@@ -99,11 +99,21 @@ class ErasurePipeline:
             ).astype(np.int8)
         )
 
-    def reconstruct(self, survivors, present: tuple[bool, ...], want: tuple[int, ...]):
+    def reconstruct(
+        self,
+        survivors,
+        present: tuple[bool, ...],
+        want: tuple[int, ...],
+        with_digests: bool = True,
+    ):
         """[B, K, S] survivor shards (first K present rows, index order) ->
-        [B, len(want), S] rebuilt shards + their digests."""
+        [B, len(want), S] rebuilt shards + their digests (or None).
+
+        Degraded GETs don't need digests of the rebuilt rows -- skipping the
+        hash halves the device work on that path; heal keeps it fused.
+        """
         w = jnp.asarray(self._recon_weights(present, want))
-        return _reconstruct_step(survivors, w)
+        return _reconstruct_step(survivors, w, with_digests)
 
     def verify_digests(self, shards) -> jax.Array:
         """[B, T, S] shards -> [B, T, 32] digests (for bitrot deep-scan)."""
@@ -111,9 +121,11 @@ class ErasurePipeline:
         return hhj.hash256_batch(shards.reshape(b * t, s)).reshape(b, t, 32)
 
 
-@jax.jit
-def _reconstruct_step(survivors: jax.Array, w_bits: jax.Array):
+@functools.partial(jax.jit, static_argnums=(2,))
+def _reconstruct_step(survivors: jax.Array, w_bits: jax.Array, with_digests: bool):
     rebuilt = rs.gf_matmul(survivors, w_bits)
+    if not with_digests:
+        return rebuilt, None
     b, r, s = rebuilt.shape
     digests = hhj.hash256_batch(rebuilt.reshape(b * r, s)).reshape(b, r, 32)
     return rebuilt, digests

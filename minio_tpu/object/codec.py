@@ -40,6 +40,32 @@ class BlockCodec(abc.ABC):
     ) -> list[bytes]:
         """Rebuild the `want` shard rows from available shards (None = lost)."""
 
+    def reconstruct_batch(
+        self,
+        rows_batch: list[list[bytes | None]],
+        k: int,
+        m: int,
+        want: tuple[int, ...],
+        with_digests: bool = False,
+    ) -> list[tuple[list[bytes], list[bytes] | None]]:
+        """Rebuild `want` rows for MANY blocks sharing one present-mask.
+
+        The batched analogue of `reconstruct` -- degraded GETs and heal
+        rebuild whole windows of blocks with the same shards lost
+        (reference per-block loop: cmd/erasure-decode.go:206,
+        erasure-lowlevel-heal.go:31), so device codecs override this to run
+        one [B, K, S] program instead of B round trips. Returns, per block,
+        (rebuilt chunks, their bitrot digests or None when not requested).
+        """
+        from ..ops import bitrot
+
+        out: list[tuple[list[bytes], list[bytes] | None]] = []
+        for rows in rows_batch:
+            chunks = self.reconstruct(rows, k, m, want)
+            digests = [bitrot.digest_of(c) for c in chunks] if with_digests else None
+            out.append((chunks, digests))
+        return out
+
 
 def _split_block(block: bytes, k: int) -> np.ndarray:
     return rs_matrix.split(np.frombuffer(block, dtype=np.uint8), k)
@@ -94,6 +120,77 @@ class HostCodec(BlockCodec):
         return [rebuilt[i].tobytes() for i in want]
 
 
+_RECON_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+
+def bucket_batch(n: int) -> int:
+    """Pad a batch count to a small fixed set of sizes so each (pattern,
+    geometry) costs at most len(_RECON_BUCKETS) XLA compilations."""
+    for b in _RECON_BUCKETS:
+        if n <= b:
+            return b
+    return _RECON_BUCKETS[-1]
+
+
+def run_device_reconstruct(
+    pipe,
+    rows_batch: list[list[bytes | None]],
+    k: int,
+    want: tuple[int, ...],
+    surv: list[int],
+    chunk_size: int,
+    with_digests: bool,
+) -> list[tuple[list[bytes], list[bytes] | None]]:
+    """Assemble a uniform rows_batch into one padded [B, K, S] device
+    reconstruct program and unpack per-block results (shared by DeviceCodec
+    and the batching codec -- the served decode/heal path)."""
+    b_real = len(rows_batch)
+    b_pad = bucket_batch(b_real)
+    present = tuple(r is not None for r in rows_batch[0])
+    arr = np.zeros((b_pad, k, chunk_size), dtype=np.uint8)
+    for bi, rows in enumerate(rows_batch):
+        for ki, j in enumerate(surv):
+            arr[bi, ki] = np.frombuffer(rows[j], dtype=np.uint8)  # type: ignore[arg-type]
+    rebuilt, digests = pipe.reconstruct(arr, present, tuple(want), with_digests=with_digests)
+    rebuilt_np = np.asarray(rebuilt)
+    digests_np = np.asarray(digests) if with_digests else None
+    return [
+        (
+            [rebuilt_np[bi, wi].tobytes() for wi in range(len(want))],
+            (
+                [digests_np[bi, wi].tobytes() for wi in range(len(want))]
+                if digests_np is not None
+                else None
+            ),
+        )
+        for bi in range(b_real)
+    ]
+
+
+def uniform_recon_plan(
+    rows_batch: list[list[bytes | None]], k: int
+) -> tuple[tuple[bool, ...], list[int], int] | None:
+    """Device-eligibility check for a batched reconstruct.
+
+    Returns (present mask, first-K surviving row indices, chunk size) when
+    every block in the batch lost the same shards and all surviving chunks
+    share one length -- the shape a single [B, K, S] device program needs.
+    None means the batch is irregular (mixed tails/patterns): host path.
+    """
+    present = tuple(r is not None for r in rows_batch[0])
+    if sum(present) < k:
+        return None
+    sizes: set[int] = set()
+    for rows in rows_batch:
+        if tuple(r is not None for r in rows) != present:
+            return None
+        sizes.update(len(r) for r in rows if r is not None)
+    if len(sizes) != 1:
+        return None
+    surv = [i for i, p in enumerate(present) if p][:k]
+    return present, surv, sizes.pop()
+
+
 class DeviceCodec(BlockCodec):
     """JAX device codec: one fused encode+hash program per call.
 
@@ -105,6 +202,15 @@ class DeviceCodec(BlockCodec):
 
     def __init__(self):
         self._host = HostCodec()
+        self._pipelines: dict[tuple[int, int], object] = {}
+
+    def _pipe(self, k: int, m: int):
+        from ..models.pipeline import ErasurePipeline, Geometry
+
+        key = (k, m)
+        if key not in self._pipelines:
+            self._pipelines[key] = ErasurePipeline(Geometry(k, m))
+        return self._pipelines[key]
 
     def encode(self, blocks, k, m):
         from ..ops import rs as rs_dev
@@ -136,6 +242,18 @@ class DeviceCodec(BlockCodec):
 
     def reconstruct(self, shards, k, m, want):
         return self._host.reconstruct(shards, k, m, want)
+
+    def reconstruct_batch(self, rows_batch, k, m, want, with_digests=False):
+        """Uniform multi-block rebuilds run as one device program (the served
+        decode/heal path, cmd/erasure-lowlevel-heal.go:31); singles and
+        irregular batches take the low-latency host codec."""
+        plan = uniform_recon_plan(rows_batch, k) if len(rows_batch) > 1 else None
+        if plan is None:
+            return super().reconstruct_batch(rows_batch, k, m, want, with_digests)
+        _, surv, s = plan
+        return run_device_reconstruct(
+            self._pipe(k, m), rows_batch, k, tuple(want), surv, s, with_digests
+        )
 
 
 _default: BlockCodec | None = None

@@ -767,32 +767,49 @@ class ErasureObjects:
             if any(frames[j] is None for j in range(k)):
                 load_spares()
 
+            def valid_rows(w: int) -> list[bytes | None]:
+                rows: list[bytes | None] = [None] * (k + mth)
+                for j in range(k + mth):
+                    if frames[j] is not None:
+                        digest, chunk = frames[j][w]
+                        if bitrot_mod.digest_of(chunk) == digest:
+                            rows[j] = chunk
+                        else:
+                            frames[j] = None  # corrupt: drop the shard
+                return rows
+
+            # Pass 1: verify every block in the window, pulling spares once
+            # if any block falls under read quorum.
+            rows_by_block: list[list[bytes | None]] = []
             for b in range(g0, g1 + 1):
-                w = b - g0
-
-                def valid_rows() -> list[bytes | None]:
-                    rows: list[bytes | None] = [None] * (k + mth)
-                    for j in range(k + mth):
-                        if frames[j] is not None:
-                            digest, chunk = frames[j][w]
-                            if bitrot_mod.digest_of(chunk) == digest:
-                                rows[j] = chunk
-                            else:
-                                frames[j] = None  # corrupt: drop the shard
-                    return rows
-
-                rows = valid_rows()
+                rows = valid_rows(b - g0)
                 if sum(1 for r in rows if r is not None) < k:
                     load_spares()
-                    rows = valid_rows()
-                present = [j for j in range(k + mth) if rows[j] is not None]
-                if len(present) < k:
+                    rows = valid_rows(b - g0)
+                if sum(1 for r in rows if r is not None) < k:
                     raise errors.InsufficientReadQuorum(bucket, object_name)
-                if any(rows[j] is None for j in range(k)):
-                    want = tuple(j for j in range(k) if rows[j] is None)
-                    rebuilt = self.codec.reconstruct(rows, k, mth, want)
-                    for idx, j in enumerate(want):
-                        rows[j] = rebuilt[idx]
+                rows_by_block.append(rows)
+
+            # Pass 2: rebuild missing data rows for the whole window in
+            # batched codec calls, grouped by loss pattern -- a degraded GET
+            # runs ONE device program per window instead of a per-block host
+            # reconstruct (the served decode path, cmd/erasure-decode.go:206).
+            groups: dict[tuple[tuple[bool, ...], tuple[int, ...]], list[int]] = {}
+            for wi, rows in enumerate(rows_by_block):
+                want = tuple(j for j in range(k) if rows[j] is None)
+                if want:
+                    pattern = tuple(r is not None for r in rows)
+                    groups.setdefault((pattern, want), []).append(wi)
+            for (_, want), idxs in groups.items():
+                results = self.codec.reconstruct_batch(
+                    [rows_by_block[wi] for wi in idxs], k, mth, want
+                )
+                for wi, (chunks, _) in zip(idxs, results):
+                    for slot, j in enumerate(want):
+                        rows_by_block[wi][j] = chunks[slot]
+
+            for b in range(g0, g1 + 1):
+                rows = rows_by_block[b - g0]
                 joined = b"".join(rows[j] for j in range(k))  # type: ignore[misc]
                 s = max(lo - b * BLOCK_SIZE, 0)
                 e = min(hi - b * BLOCK_SIZE, block_len(b))
@@ -1069,14 +1086,26 @@ class ErasureObjects:
             for part in parts:
                 frames_by_row = {j: read_part_frames(j, part) for j in surviving}
                 per_row: dict[int, list[tuple[bytes, bytes]]] = {j: [] for j in bad_rows}
-                for b in range(len(part_chunks[part.number])):
-                    rows: list[bytes | None] = [None] * (k + mth)
-                    for j in surviving:
-                        rows[j] = frames_by_row[j][b][1]
-                    rebuilt = self.codec.reconstruct(rows, k, mth, bad_rows)
-                    for idx, j in enumerate(bad_rows):
-                        chunk = rebuilt[idx]
-                        per_row[j].append((bitrot_mod.digest_of(chunk), chunk))
+                nblocks = len(part_chunks[part.number])
+                # Rebuild GROUP_BLOCKS windows per codec call: heal runs the
+                # same batched device program as encode (reconstruct + bitrot
+                # digests in one fused step; the reference loops per block,
+                # cmd/erasure-lowlevel-heal.go:31). The short tail block makes
+                # its window irregular and falls back to the host codec.
+                for g0 in range(0, nblocks, GROUP_BLOCKS):
+                    window = range(g0, min(g0 + GROUP_BLOCKS, nblocks))
+                    rows_batch: list[list[bytes | None]] = []
+                    for b in window:
+                        rows: list[bytes | None] = [None] * (k + mth)
+                        for j in surviving:
+                            rows[j] = frames_by_row[j][b][1]
+                        rows_batch.append(rows)
+                    results = self.codec.reconstruct_batch(
+                        rows_batch, k, mth, bad_rows, with_digests=True
+                    )
+                    for chunks, digests in results:
+                        for idx, j in enumerate(bad_rows):
+                            per_row[j].append((digests[idx], chunks[idx]))
                 for j in bad_rows:
                     rebuilt_files[j][part.number] = _frame_shard(
                         [c for _, c in per_row[j]], [d for d, _ in per_row[j]]

@@ -19,6 +19,7 @@ from ..storage.xlmeta import XLMeta
 from ..utils import errors
 from ..utils.hashes import crc_hash_mod, sip_hash_mod
 from . import codec as codec_mod
+from . import metacache as metacache_mod
 from . import metadata as meta_mod
 from .erasure import ErasureObjects
 from .types import (
@@ -58,6 +59,9 @@ class ErasureSets:
             self.sets.append(
                 ErasureObjects(sub, parity=parity, codec=codec, set_index=s, pool_index=pool_index)
             )
+        self.metacache = metacache_mod.MetacacheManager(
+            self._walk_merged, persist=self._persist_cache, load=self._load_cache
+        )
 
     @classmethod
     def from_drives(
@@ -122,6 +126,7 @@ class ErasureSets:
         return self.sets[0].get_bucket_info(bucket)
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        self.metacache.invalidate(bucket)
         results = meta_mod.parallel_map(lambda s: s.delete_bucket(bucket, force), self.sets)
         errs = [e for _, e in results]
         for e in errs:
@@ -137,7 +142,10 @@ class ErasureSets:
     # -- objects (route to one set) -------------------------------------------
 
     def put_object(self, bucket, object_name, data, opts: PutObjectOptions | None = None):
-        return self.get_hashed_set(object_name).put_object(bucket, object_name, data, opts)
+        try:
+            return self.get_hashed_set(object_name).put_object(bucket, object_name, data, opts)
+        finally:
+            self.metacache.invalidate(bucket)
 
     def get_object(self, bucket, object_name, opts: GetObjectOptions | None = None, offset=0, length=-1):
         return self.get_hashed_set(object_name).get_object(bucket, object_name, opts, offset, length)
@@ -153,21 +161,30 @@ class ErasureSets:
         return self.get_hashed_set(object_name).get_object_info(bucket, object_name, opts)
 
     def put_object_metadata(self, bucket, object_name, version_id="", updates=None, removes=None):
-        return self.get_hashed_set(object_name).put_object_metadata(
-            bucket, object_name, version_id, updates, removes
-        )
+        try:
+            return self.get_hashed_set(object_name).put_object_metadata(
+                bucket, object_name, version_id, updates, removes
+            )
+        finally:
+            self.metacache.invalidate(bucket)
 
     def transition_object(
         self, bucket, object_name, version_id, tier, remote_name,
         expected_etag="", expected_mtime=0.0,
     ):
-        return self.get_hashed_set(object_name).transition_object(
-            bucket, object_name, version_id, tier, remote_name,
-            expected_etag, expected_mtime,
-        )
+        try:
+            return self.get_hashed_set(object_name).transition_object(
+                bucket, object_name, version_id, tier, remote_name,
+                expected_etag, expected_mtime,
+            )
+        finally:
+            self.metacache.invalidate(bucket)
 
     def delete_object(self, bucket, object_name, opts: DeleteObjectOptions | None = None):
-        return self.get_hashed_set(object_name).delete_object(bucket, object_name, opts)
+        try:
+            return self.get_hashed_set(object_name).delete_object(bucket, object_name, opts)
+        finally:
+            self.metacache.invalidate(bucket)
 
     def heal_object(self, bucket, object_name, version_id="", dry_run=False) -> HealResultItem:
         return self.get_hashed_set(object_name).heal_object(bucket, object_name, version_id, dry_run)
@@ -188,9 +205,12 @@ class ErasureSets:
         )
 
     def complete_multipart_upload(self, bucket, object_name, upload_id, parts):
-        return self.get_hashed_set(object_name).multipart.complete_multipart_upload(
-            bucket, object_name, upload_id, parts
-        )
+        try:
+            return self.get_hashed_set(object_name).multipart.complete_multipart_upload(
+                bucket, object_name, upload_id, parts
+            )
+        finally:
+            self.metacache.invalidate(bucket)
 
     def abort_multipart_upload(self, bucket, object_name, upload_id):
         return self.get_hashed_set(object_name).multipart.abort_multipart_upload(
@@ -203,7 +223,31 @@ class ErasureSets:
             out.extend(s.multipart.list_multipart_uploads(bucket, prefix))
         return sorted(out, key=lambda u: (u["object"], u["initiated"]))
 
-    # -- listing (merge sorted per-drive walks; metacache-set.go's job) -------
+    # -- listing (metacache over merged sorted per-drive walks) ---------------
+
+    def _persist_cache(self, path: str, blob: bytes) -> None:
+        """Write a metacache image to the first online drives (best effort,
+        the putMetacacheObject role, cmd/metacache-set.go write-back)."""
+        written = 0
+        for d in self.sets[0].disks:
+            if d is None or not d.is_online():
+                continue
+            d.create_file(metacache_mod.META_BUCKET, path, blob)
+            written += 1
+            if written >= 2:
+                return
+        if written == 0:
+            raise errors.DiskNotFound()
+
+    def _load_cache(self, path: str) -> bytes:
+        for d in self.sets[0].disks:
+            if d is None or not d.is_online():
+                continue
+            try:
+                return d.read_file(metacache_mod.META_BUCKET, path)
+            except errors.DiskError:
+                continue
+        raise errors.FileNotFound(metacache_mod.META_BUCKET, path)
 
     def _walk_merged(self, bucket: str, prefix: str = ""):
         """Yield (name, xl_meta_bytes) sorted by name, deduped across drives
@@ -256,11 +300,21 @@ class ErasureSets:
         self.get_bucket_info(bucket)
         max_keys = max(0, min(max_keys, 1000))
         out = ListObjectsInfo()
+        if max_keys == 0:
+            # S3 answers max-keys=0 with an empty, non-truncated result; a
+            # truncated one would carry an empty next_marker and strand pagers.
+            return out
         prefixes: set[str] = set()
-        for name, raw in self._walk_merged(bucket, prefix):
-            if marker and name <= marker:
+        # next_marker is the LAST RETURNED item (S3 V1 semantics): an object
+        # key, or a common prefix -- in which case resumption must skip the
+        # whole subtree rolled up into it.
+        last_item = ""
+        for name, raw in self.metacache.entries_from(bucket, prefix, marker):
+            if marker and (
+                name <= marker
+                or (delimiter and marker.endswith(delimiter) and name.startswith(marker))
+            ):
                 continue
-            key = name
             if delimiter:
                 rest = name[len(prefix) :]
                 if delimiter in rest:
@@ -268,9 +322,10 @@ class ErasureSets:
                     if cp not in prefixes:
                         if len(out.objects) + len(prefixes) >= max_keys:
                             out.is_truncated = True
-                            out.next_marker = name
+                            out.next_marker = last_item
                             break
                         prefixes.add(cp)
+                        last_item = cp
                     continue
             try:
                 meta = XLMeta.from_bytes(raw)
@@ -281,9 +336,10 @@ class ErasureSets:
                 continue
             if len(out.objects) + len(prefixes) >= max_keys:
                 out.is_truncated = True
-                out.next_marker = key
+                out.next_marker = last_item
                 break
             out.objects.append(ObjectInfo.from_file_info(fi, bucket, name))
+            last_item = name
         out.prefixes = sorted(prefixes)
         return out
 
@@ -299,9 +355,11 @@ class ErasureSets:
         self.get_bucket_info(bucket)
         max_keys = max(0, min(max_keys, 1000))
         out = ListObjectVersionsInfo()
+        if max_keys == 0:
+            return out
         prefixes: set[str] = set()
         done = False
-        for name, raw in self._walk_merged(bucket, prefix):
+        for name, raw in self.metacache.entries_from(bucket, prefix, ""):
             if done:
                 break
             if key_marker and name < key_marker:
@@ -316,14 +374,22 @@ class ErasureSets:
                 meta = XLMeta.from_bytes(raw)
             except errors.StorageError:
                 continue
+            # Resuming inside the marker object: versions are ordered newest
+            # first, so skip every version up to AND INCLUDING version_marker
+            # (S3 version-id-marker semantics), not just the marker itself.
+            skipping = bool(key_marker and name == key_marker)
             for fi in meta.versions:
-                if key_marker and name == key_marker:
-                    if not version_marker or fi.version_id == version_marker:
-                        continue
+                if skipping:
+                    if not version_marker:
+                        skipping = False  # key_marker alone: whole object done
+                        break
+                    if fi.version_id == version_marker:
+                        skipping = False
+                    continue
                 if len(out.objects) >= max_keys:
                     out.is_truncated = True
-                    out.next_key_marker = name
-                    out.next_version_marker = fi.version_id
+                    out.next_key_marker = out.objects[-1].name
+                    out.next_version_marker = out.objects[-1].version_id
                     done = True
                     break
                 fi.is_latest = fi is meta.versions[0]

@@ -72,6 +72,8 @@ class BatchingDeviceCodec(BlockCodec):
         # pipeline actually carries production blocks).
         self.blocks_encoded = 0
         self.batches_run = 0
+        self.blocks_reconstructed = 0
+        self.recon_batches_run = 0
 
     # -- worker management ---------------------------------------------------
 
@@ -171,6 +173,26 @@ class BatchingDeviceCodec(BlockCodec):
 
     def reconstruct(self, shards, k, m, want):
         return self._host.reconstruct(shards, k, m, want)
+
+    def reconstruct_batch(self, rows_batch, k, m, want, with_digests=False):
+        """Degraded-GET / heal windows of full blocks with a uniform loss
+        pattern run as ONE padded-batch device program (the served decode
+        path the reference runs per block, cmd/erasure-decode.go:206,
+        erasure-lowlevel-heal.go:31); tails and irregular batches fall back
+        to the host codec, mirroring the encode-side split."""
+        from ..object.codec import run_device_reconstruct, uniform_recon_plan
+
+        plan = uniform_recon_plan(rows_batch, k) if len(rows_batch) > 1 else None
+        if plan is None or plan[2] != rs_matrix.shard_size(self.block_size, k):
+            return self._host.reconstruct_batch(rows_batch, k, m, want, with_digests)
+        _, surv, s = plan
+        self._ensure_worker(k, m)
+        out = run_device_reconstruct(
+            self._pipelines[(k, m)], rows_batch, k, tuple(want), surv, s, with_digests
+        )
+        self.recon_batches_run += 1
+        self.blocks_reconstructed += len(rows_batch)
+        return out
 
     def close(self) -> None:
         self._stop.set()

@@ -17,7 +17,7 @@ from minio_tpu.storage.local import LocalDrive
 
 
 class ErasureHarness:
-    def __init__(self, tmp_path, n_disks: int = 16, parity: int | None = None):
+    def __init__(self, tmp_path, n_disks: int = 16, parity: int | None = None, codec=None):
         self.dirs = [str(tmp_path / f"disk{i}") for i in range(n_disks)]
         formats = fmt.init_format(1, n_disks)
         self.drives: list[LocalDrive | None] = []
@@ -25,7 +25,7 @@ class ErasureHarness:
             os.makedirs(d, exist_ok=True)
             f.save(d)
             self.drives.append(LocalDrive(d))
-        self.layer = ErasureObjects(self.drives, parity=parity)
+        self.layer = ErasureObjects(self.drives, parity=parity, codec=codec)
 
     def take_offline(self, *indices: int) -> None:
         for i in indices:

@@ -68,3 +68,70 @@ def test_mixed_sizes_one_call(batcher):
     for i in range(3):
         assert dev[i][0] == host[i][0], i
         assert dev[i][1] == host[i][1], i
+
+
+class TestDeviceReconstructServing:
+    """The decode/heal serving path runs the batched device pipeline
+    (VERDICT r3 #3): degraded GETs and heal must advance the reconstruct
+    counters, not silently punt to the host codec."""
+
+    def _harness(self, tmp_path):
+        from tests.harness import ErasureHarness
+
+        batcher = BatchingDeviceCodec(block_size=BLOCK, max_batch=8, batch_timeout_s=0.002)
+        h = ErasureHarness(tmp_path, n_disks=16, codec=batcher)
+        h.layer.make_bucket("b")
+        return h, batcher
+
+    def _data_row_drives(self, layer, bucket, name, n, k=12):
+        """Indices of n drives whose shard row is a data row."""
+        fi, _, _ = layer._read_quorum_fi(bucket, name, "")
+        out = [i for i, rot in enumerate(fi.erasure.distribution) if rot - 1 < k]
+        return out[:n]
+
+    def test_degraded_get_runs_device_batch(self, tmp_path):
+        h, batcher = self._harness(tmp_path)
+        try:
+            rng = np.random.default_rng(10)
+            data = rng.integers(0, 256, 3 * BLOCK).astype(np.uint8).tobytes()
+            h.layer.put_object("b", "obj", data)
+            h.take_offline(*self._data_row_drives(h.layer, "b", "obj", 2))
+            before = batcher.blocks_reconstructed
+            _, got = h.layer.get_object("b", "obj")
+            assert got == data
+            assert batcher.blocks_reconstructed >= before + 3  # all 3 full blocks
+            assert batcher.recon_batches_run >= 1
+        finally:
+            batcher.close()
+
+    def test_heal_runs_device_batch(self, tmp_path):
+        h, batcher = self._harness(tmp_path)
+        try:
+            rng = np.random.default_rng(11)
+            data = rng.integers(0, 256, 3 * BLOCK).astype(np.uint8).tobytes()
+            h.layer.put_object("b", "obj", data)
+            deleted = 0
+            for i in self._data_row_drives(h.layer, "b", "obj", 3):
+                assert h.delete_shard(i, "b", "obj")
+                deleted += 1
+            assert deleted == 3
+            before = batcher.blocks_reconstructed
+            h.layer.heal_object("b", "obj")
+            assert batcher.blocks_reconstructed >= before + 3
+            _, got = h.layer.get_object("b", "obj")
+            assert got == data
+        finally:
+            batcher.close()
+
+    def test_degraded_tail_block_host_fallback_is_exact(self, tmp_path):
+        """Tail blocks (irregular window) must still read back correctly."""
+        h, batcher = self._harness(tmp_path)
+        try:
+            rng = np.random.default_rng(12)
+            data = rng.integers(0, 256, 2 * BLOCK + 12345).astype(np.uint8).tobytes()
+            h.layer.put_object("b", "obj", data)
+            h.take_offline(*self._data_row_drives(h.layer, "b", "obj", 2))
+            _, got = h.layer.get_object("b", "obj")
+            assert got == data
+        finally:
+            batcher.close()
