@@ -67,24 +67,57 @@ class ErasurePipeline:
         def encode_step(data_shards: jax.Array):
             """[B, K, S] -> ([B, K+M, S] shards, [B, K+M, 32] digests)."""
             all_shards = self.codec.encode_all(data_shards)
-            if mesh is not None:
-                all_shards = jax.lax.with_sharding_constraint(
-                    all_shards, mesh_lib.stream_sharding(mesh)
-                )
             b, t, s = all_shards.shape
             digests = hhj.hash256_batch(all_shards.reshape(b * t, s)).reshape(b, t, 32)
             return all_shards, digests
 
         if mesh is None:
             return jax.jit(encode_step)
-        return jax.jit(
-            encode_step,
-            in_shardings=mesh_lib.data_sharding(mesh),
-            out_shardings=(
-                mesh_lib.shard_output_sharding(mesh),
-                mesh_lib.digest_sharding(mesh),
-            ),
+
+        # Mesh path: explicit SPMD. The erasure matmul is pointwise in the
+        # byte axis so it runs sp-sharded with no communication; the
+        # encode->hash boundary is a REAL ICI all-to-all (lax.all_to_all
+        # moves the sp byte shards into the stream axis) plus a tp slice of
+        # the streams. Round 3 expressed this reshard as a
+        # with_sharding_constraint, which GSPMD lowered as an involuntary
+        # full rematerialization (replicate + slice); shard_map pins the
+        # collective instead.
+        tp, sp = mesh.shape["tp"], mesh.shape["sp"]
+        if geom.total % (tp * sp):
+            raise ValueError(
+                f"shard streams ({geom.total}) must divide evenly over the "
+                f"tp x sp grid ({tp}x{sp}); uneven stream sharding would "
+                "silently drop digests"
+            )
+        w_parity = rs.parity_weights(geom.data, geom.parity)
+
+        def encode_local(data_local: jax.Array):
+            # data_local: [B/dp, K, S/sp], replicated over tp.
+            parity = rs.gf_matmul(data_local, jnp.asarray(w_parity))
+            all_local = jnp.concatenate([data_local, parity], axis=1)
+            # Barrier: without it XLA keeps the parameter-aliasing data rows
+            # and the freshly computed parity rows in different layouts, and
+            # the tiled all-to-all verifier rejects the mixed-layout chunks.
+            all_local = jax.lax.optimization_barrier(all_local)
+            # [B/dp, T, S/sp] -> all-to-all -> [B/dp, T/sp, S]: byte shards
+            # ride ICI into full per-stream rows (sp-major stream order).
+            x = jax.lax.all_to_all(all_local, "sp", split_axis=1, concat_axis=2, tiled=True)
+            t_loc = x.shape[1] // tp
+            ti = jax.lax.axis_index("tp")
+            x = jax.lax.dynamic_slice_in_dim(x, ti * t_loc, t_loc, axis=1)
+            digests = hhj.hash256_batch(x.reshape(-1, x.shape[-1])).reshape(
+                x.shape[0], t_loc, 32
+            )
+            return all_local, digests
+
+        mapped = jax.shard_map(
+            encode_local,
+            mesh=mesh,
+            in_specs=mesh_lib.data_spec(),
+            out_specs=(mesh_lib.shard_output_spec(), mesh_lib.digest_spec()),
+            check_vma=False,
         )
+        return jax.jit(mapped)
 
     def encode(self, data_shards) -> tuple[jax.Array, jax.Array]:
         return self._encode_fn(data_shards)

@@ -145,7 +145,7 @@ def run_device_reconstruct(
     reconstruct program and unpack per-block results (shared by DeviceCodec
     and the batching codec -- the served decode/heal path)."""
     b_real = len(rows_batch)
-    b_pad = bucket_batch(b_real)
+    b_pad = max(bucket_batch(b_real), b_real)  # never allocate under b_real
     present = tuple(r is not None for r in rows_batch[0])
     arr = np.zeros((b_pad, k, chunk_size), dtype=np.uint8)
     for bi, rows in enumerate(rows_batch):

@@ -19,12 +19,18 @@ from __future__ import annotations
 
 import bisect
 import hashlib
+import struct
 import threading
 import time
 
 import msgpack
 
 META_BUCKET = ".minio.sys"
+
+# Persisted image layout: 8-byte big-endian unix-time header, then the
+# msgpack body. The header lets a reader reject a stale image without
+# unpacking a potentially multi-MB entry list.
+_HDR = struct.Struct(">d")
 
 # How long a filled cache may serve pages before a fresh walk is forced.
 DEFAULT_TTL_S = 15.0
@@ -67,6 +73,10 @@ class MetacacheManager:
         self.ttl_s = ttl_s
         self._caches: dict[tuple[str, str], _Cache] = {}
         self._generations: dict[str, int] = {}
+        # Persisted images are only worth consulting once per (bucket,
+        # prefix) per process: after that, either the in-memory cache or a
+        # walk is strictly fresher.
+        self._cold_checked: set[tuple[str, str]] = set()
         self._lock = threading.Lock()
         # Instrumentation: tests pin that paging does not re-walk per page.
         self.walks = 0
@@ -104,15 +114,18 @@ class MetacacheManager:
         key = (bucket, prefix)
         with self._lock:
             cache = self._caches.get(key)
+            check_cold = key not in self._cold_checked
+            self._cold_checked.add(key)
         if cache is not None and self._valid(cache, bucket):
             self.hits += 1
             return self._page(cache, marker)
-        cache = self._load_persisted(bucket, prefix)
-        if cache is not None:
-            with self._lock:
-                self._caches[key] = cache
-            self.hits += 1
-            return self._page(cache, marker)
+        if check_cold:
+            cache = self._load_persisted(bucket, prefix)
+            if cache is not None:
+                with self._lock:
+                    self._caches[key] = cache
+                self.hits += 1
+                return self._page(cache, marker)
         return self._fill(key, marker)
 
     def _page(self, cache: _Cache, marker: str):
@@ -142,13 +155,13 @@ class MetacacheManager:
                 self._caches[key] = cache
             if self._persist is not None:
                 try:
+                    body = msgpack.packb(
+                        {"v": 1, "bucket": bucket, "prefix": prefix,
+                         "entries": list(zip(names, raws))},
+                        use_bin_type=True,
+                    )
                     self._persist(
-                        cache_path(bucket, prefix),
-                        msgpack.packb(
-                            {"v": 1, "bucket": bucket, "prefix": prefix,
-                             "time": time.time(), "entries": list(zip(names, raws))},
-                            use_bin_type=True,
-                        ),
+                        cache_path(bucket, prefix), _HDR.pack(time.time()) + body
                     )
                 except Exception:  # noqa: BLE001 - persistence is best effort
                     pass
@@ -157,9 +170,11 @@ class MetacacheManager:
     def _load_persisted(self, bucket: str, prefix: str) -> _Cache | None:
         """Cold-start reuse of a persisted image, bounded by wall-clock TTL.
 
-        Only consulted when there is no in-memory cache at all (a fresh
-        process); the write-generation guard cannot span restarts, so the
-        TTL alone bounds staleness here.
+        Only consulted once per key per process, before the first walk (a
+        fresh process has no in-memory cache); the write-generation guard
+        cannot span restarts, so the TTL alone bounds staleness here. The
+        image's remaining TTL is its ORIGINAL one: filled_at is backdated by
+        the image's age so a 14s-old image serves for 1s more, not 15s.
         """
         if self._load is None:
             return None
@@ -168,11 +183,16 @@ class MetacacheManager:
                 return None  # bucket already written in this process: walk
         try:
             blob = self._load(cache_path(bucket, prefix))
-            doc = msgpack.unpackb(blob, raw=False)
-            if doc.get("v") != 1 or time.time() - doc.get("time", 0) > self.ttl_s:
+            age = time.time() - _HDR.unpack(blob[: _HDR.size])[0]
+            if not 0 <= age <= self.ttl_s:
+                return None
+            doc = msgpack.unpackb(blob[_HDR.size :], raw=False)
+            if doc.get("v") != 1:
                 return None
             names = [n for n, _ in doc["entries"]]
             raws = [r for _, r in doc["entries"]]
-            return _Cache(names, raws, self.generation(bucket))
+            cache = _Cache(names, raws, self.generation(bucket))
+            cache.filled_at -= age
+            return cache
         except Exception:  # noqa: BLE001
             return None

@@ -378,6 +378,15 @@ class ErasureSets:
             # first, so skip every version up to AND INCLUDING version_marker
             # (S3 version-id-marker semantics), not just the marker itself.
             skipping = bool(key_marker and name == key_marker)
+            if (
+                skipping
+                and version_marker
+                and not any(v.version_id == version_marker for v in meta.versions)
+            ):
+                # Marker version was deleted between pages: emit everything
+                # rather than silently dropping the key's remaining versions
+                # (duplicates are recoverable client-side; losses are not).
+                skipping = False
             for fi in meta.versions:
                 if skipping:
                     if not version_marker:

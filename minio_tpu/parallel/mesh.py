@@ -57,20 +57,25 @@ def make_mesh(n_devices: int | None = None, shape: tuple[int, int, int] | None =
     return Mesh(dev_array, AXES)
 
 
-def data_sharding(mesh: Mesh) -> NamedSharding:
+def data_spec() -> P:
     """[B, K, S] input blocks: batch over dp, bytes over sp."""
-    return NamedSharding(mesh, P("dp", None, "sp"))
+    return P("dp", None, "sp")
 
 
-def stream_sharding(mesh: Mesh) -> NamedSharding:
-    """[B, nshards, S] hash streams: batch over dp, shard streams over tp+sp."""
-    return NamedSharding(mesh, P("dp", ("tp", "sp"), None))
+def digest_spec() -> P:
+    """[B, nshards, 32] digests: batch over dp, streams over sp then tp.
+
+    sp is MAJOR on the stream axis because the encode->hash all-to-all
+    (lax.all_to_all over sp, models/pipeline.py) deals stream blocks to sp
+    peers first; each peer then slices its tp share locally.
+    """
+    return P("dp", ("sp", "tp"), None)
 
 
-def digest_sharding(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P("dp", ("tp", "sp"), None))
-
-
-def shard_output_sharding(mesh: Mesh) -> NamedSharding:
+def shard_output_spec() -> P:
     """[B, K+M, S] encoded shards leaving the device: match data layout."""
-    return NamedSharding(mesh, P("dp", None, "sp"))
+    return P("dp", None, "sp")
+
+
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, data_spec())
