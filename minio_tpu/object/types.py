@@ -86,6 +86,10 @@ class PutObjectOptions:
     version_id: str = ""
     content_type: str = "application/octet-stream"
     etag: str = ""  # override (transformed payloads keep the plaintext etag)
+    # Legacy whole-file bitrot ("sha256" | "blake2b" | "highwayhash256"):
+    # shard files hold raw bytes and one checksum per part lives in the
+    # metadata (cmd/bitrot-whole.go). Empty = default interleaved streaming.
+    bitrot_algorithm: str = ""
 
 
 @dataclass

@@ -61,6 +61,23 @@ def digest_of(chunk: bytes, algo: BitrotAlgorithm = DEFAULT_ALGORITHM) -> bytes:
     return h.digest()
 
 
+def digests_of_batch(
+    chunks: list[bytes], algo: BitrotAlgorithm = DEFAULT_ALGORITHM
+) -> list[bytes]:
+    """Digests of many chunks; equal-length HighwayHash batches run as ONE
+    native C call (the GET-verify / deep-scan fast path) instead of a
+    Python-driven per-chunk loop."""
+    if algo in (BitrotAlgorithm.HIGHWAYHASH256, BitrotAlgorithm.HIGHWAYHASH256S):
+        from . import native
+
+        if native.available() and len(chunks) > 1 and len({len(c) for c in chunks}) == 1:
+            import numpy as np
+
+            arr = np.stack([np.frombuffer(c, dtype=np.uint8) for c in chunks])
+            return [d.tobytes() for d in native.hh256_batch(arr, hh.MAGIC_KEY)]
+    return [digest_of(c, algo) for c in chunks]
+
+
 def shard_file_size(size: int, shard_size: int, algo: BitrotAlgorithm = DEFAULT_ALGORITHM) -> int:
     """On-disk size of a bitrot-protected shard file (cmd/bitrot.go:146-151)."""
     if not algo.streaming:

@@ -74,6 +74,8 @@ class BatchingDeviceCodec(BlockCodec):
         self.batches_run = 0
         self.blocks_reconstructed = 0
         self.recon_batches_run = 0
+        self.digests_verified = 0
+        self.verify_batches_run = 0
 
     # -- worker management ---------------------------------------------------
 
@@ -192,6 +194,39 @@ class BatchingDeviceCodec(BlockCodec):
         )
         self.recon_batches_run += 1
         self.blocks_reconstructed += len(rows_batch)
+        return out
+
+    def digests_batch(self, chunks):
+        """Deep-scan / heal verification batches run on the device
+        (pipeline.verify_digests, the scanner's batched bitrot consumer --
+        VERDICT r3 #9); small or ragged batches stay on the host."""
+        if len(chunks) < 4 or len({len(c) for c in chunks}) != 1:
+            return self._host.digests_batch(chunks)
+        from ..models.pipeline import ErasurePipeline, Geometry
+        from ..object.codec import bucket_batch
+
+        key = "verify"
+        with self._lock:
+            pipe = self._pipelines.get(key)
+            if pipe is None:
+                # Geometry is irrelevant for pure digesting; any instance
+                # provides the jitted verify step.
+                pipe = self._pipelines[key] = ErasurePipeline(Geometry(1, 1))
+        # Bucketed sub-batches (<= the largest bucket) so each chunk length
+        # costs a bounded number of XLA compilations, however many chunks a
+        # big part brings.
+        out: list[bytes] = []
+        cap = bucket_batch(len(chunks))
+        for lo in range(0, len(chunks), cap):
+            sub = chunks[lo : lo + cap]
+            n_pad = bucket_batch(len(sub))
+            arr = np.zeros((n_pad, 1, len(sub[0])), dtype=np.uint8)
+            for i, c in enumerate(sub):
+                arr[i, 0] = np.frombuffer(c, dtype=np.uint8)
+            digs = np.asarray(pipe.verify_digests(arr))  # [n_pad, 1, 32]
+            self.verify_batches_run += 1
+            self.digests_verified += len(sub)
+            out.extend(digs[i, 0].tobytes() for i in range(len(sub)))
         return out
 
     def close(self) -> None:

@@ -135,3 +135,26 @@ class TestDeviceReconstructServing:
             assert got == data
         finally:
             batcher.close()
+
+
+def test_scanner_deep_scan_runs_device_verify(tmp_path):
+    """The scanner's sampled deep-check verifies bitrot through the batched
+    device pipeline (VERDICT r3 #9): verify counters must advance."""
+    from tests.harness import ErasureHarness
+    from tests.test_control import _PoolsShim
+    from minio_tpu.control.scanner import DataScanner
+
+    batcher = BatchingDeviceCodec(block_size=BLOCK, max_batch=8, batch_timeout_s=0.002)
+    try:
+        h = ErasureHarness(tmp_path, n_disks=16, codec=batcher)
+        h.layer.make_bucket("scanb")
+        rng = np.random.default_rng(21)
+        h.layer.put_object(
+            "scanb", "obj", rng.integers(0, 256, 2 * BLOCK).astype(np.uint8).tobytes()
+        )
+        sc = DataScanner(_PoolsShim(h), heal_sample=1)  # deep-check everything
+        sc.scan_cycle()
+        assert batcher.verify_batches_run >= 1
+        assert batcher.digests_verified >= 16  # at least one full row set
+    finally:
+        batcher.close()

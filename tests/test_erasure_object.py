@@ -222,3 +222,76 @@ class TestHeal:
             hz.delete_object_dir(i, BUCKET, "obj")
         with pytest.raises((errors.InsufficientReadQuorum, errors.ErasureReadQuorum)):
             hz.layer.heal_object(BUCKET, "obj")
+
+
+class TestWholeFileBitrot:
+    """Legacy whole-file bitrot layout (cmd/bitrot-whole.go): raw shard
+    files + one checksum per part per row in metadata; VERDICT r3 #10."""
+
+    @pytest.mark.parametrize("algo", ["sha256", "blake2b", "highwayhash256"])
+    def test_roundtrip_and_range(self, hz, algo):
+        data = _data((1 << 20) + 4321, seed=30)
+        hz.layer.put_object(BUCKET, "legacy", data, PutObjectOptions(bitrot_algorithm=algo))
+        _, got = hz.layer.get_object(BUCKET, "legacy")
+        assert got == data
+        _, part = hz.layer.get_object(BUCKET, "legacy", offset=999_000, length=50_000)
+        assert part == data[999_000 : 999_000 + 50_000]
+
+    def test_corrupt_then_read_uses_spares(self, hz):
+        data = _data(2 * (1 << 20) + 7, seed=31)
+        hz.layer.put_object(
+            BUCKET, "legacy", data, PutObjectOptions(bitrot_algorithm="sha256")
+        )
+        corrupted = 0
+        for i in range(16):
+            if hz.corrupt_shard(i, BUCKET, "legacy", at=50) and (
+                corrupted := corrupted + 1
+            ) >= 2:
+                break
+        assert corrupted == 2
+        _, got = hz.layer.get_object(BUCKET, "legacy")
+        assert got == data
+
+    def test_corrupt_then_heal(self, hz):
+        data = _data((1 << 20) + 99, seed=32)
+        hz.layer.put_object(
+            BUCKET, "legacy", data, PutObjectOptions(bitrot_algorithm="sha256")
+        )
+        assert hz.corrupt_shard(3, BUCKET, "legacy", at=10)
+        res = hz.layer.heal_object(BUCKET, "legacy")
+        assert res.disks_healed == 1
+        # Healed copy carries a fresh whole-file checksum; clean re-heal.
+        res2 = hz.layer.heal_object(BUCKET, "legacy", dry_run=True)
+        assert res2.disks_healed == 0
+        _, got = hz.layer.get_object(BUCKET, "legacy")
+        assert got == data
+
+    def test_too_many_corrupt_rows_fails(self, hz):
+        data = _data((1 << 20) + 5, seed=33)
+        hz.layer.put_object(
+            BUCKET, "legacy", data, PutObjectOptions(bitrot_algorithm="sha256")
+        )
+        corrupted = 0
+        for i in range(16):
+            if hz.corrupt_shard(i, BUCKET, "legacy", at=20) and (
+                corrupted := corrupted + 1
+            ) >= 5:
+                break
+        assert corrupted == 5  # parity is 4: unhealable/unreadable
+        with pytest.raises(errors.InsufficientReadQuorum):
+            hz.layer.get_object(BUCKET, "legacy")
+
+
+class TestListBucketsQuorum:
+    def test_stray_bucket_on_one_drive_not_listed(self, hz):
+        hz.layer.make_bucket("realb")
+        os.makedirs(os.path.join(hz.dirs[0], "straggler"), exist_ok=True)
+        names = [b.name for b in hz.layer.list_buckets()]
+        assert "realb" in names and BUCKET in names
+        assert "straggler" not in names
+
+    def test_bucket_survives_minority_drive_loss(self, hz):
+        hz.layer.make_bucket("quorumb")
+        hz.take_offline(0, 1, 2)
+        names = [b.name for b in hz.layer.list_buckets()]
+        assert "quorumb" in names

@@ -48,6 +48,15 @@ class RecordingCodec(HostCodec):
         self.encode_sizes.append(len(blocks))
         return super().encode(blocks, k, m)
 
+    def encode_frames(self, blocks, k, m):
+        # The streaming writer encodes via the framed-row entry point; count
+        # group sizes here too, but only once per group (the default
+        # implementation recurses into encode()).
+        uniform = self._native is not None and blocks and len({len(b) for b in blocks}) == 1
+        if uniform:
+            self.encode_sizes.append(len(blocks))
+        return super().encode_frames(blocks, k, m)
+
 
 @pytest.fixture
 def counted(tmp_path):
