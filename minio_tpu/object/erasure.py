@@ -189,6 +189,17 @@ def default_parity(drive_count: int) -> int:
     return 4
 
 
+def _whole_layout(metas) -> bool:
+    """Majority vote across drive metas on the whole-file-bitrot layout.
+
+    The quorum FileInfo representative is an arbitrary matching drive, and
+    erasure.checksums is per-drive (excluded from the quorum key) -- one
+    drive with a lost or spurious checksums list must not flip the decoder
+    for a healthy object."""
+    votes = [bool(m.erasure.checksums) for m in metas if m is not None]
+    return bool(votes) and sum(votes) * 2 > len(votes)
+
+
 def _whole_sum_matches(meta: FileInfo, part_number: int, blob: bytes) -> bool:
     """Verify a raw whole-file-bitrot row blob against the per-part checksum
     in the drive's own metadata (cmd/bitrot-whole.go:62 wholeBitrotReader
@@ -401,11 +412,23 @@ class ErasureObjects:
         version_id = opts.version_id or (str(uuid.uuid4()) if opts.versioned else "")
         mod_time = now()
 
+        # Validate the bitrot algorithm up front; naming the default
+        # streaming algorithm explicitly is the default layout, not legacy.
+        wants_whole = False
+        if opts.bitrot_algorithm:
+            try:
+                wants_whole = not bitrot_mod.BitrotAlgorithm(opts.bitrot_algorithm).streaming
+            except ValueError:
+                raise errors.InvalidArgument(
+                    bucket, object_name,
+                    f"unknown bitrot algorithm {opts.bitrot_algorithm!r}",
+                ) from None
+
         reader = _as_reader(data)
         head = _read_full(reader, SMALL_FILE_THRESHOLD)
         # Whole-file bitrot objects always take the streaming (shard-file)
         # path: the legacy layout has no inline representation.
-        if len(head) < SMALL_FILE_THRESHOLD and not opts.bitrot_algorithm:
+        if len(head) < SMALL_FILE_THRESHOLD and not wants_whole:
             return self._put_inline(
                 bucket, object_name, head, opts, k, m, distribution, version_id, mod_time
             )
@@ -537,13 +560,7 @@ class ErasureObjects:
 
         whole_algo = None
         if opts.bitrot_algorithm:
-            try:
-                whole_algo = bitrot_mod.BitrotAlgorithm(opts.bitrot_algorithm)
-            except ValueError:
-                raise errors.InvalidArgument(
-                    bucket, object_name,
-                    f"unknown bitrot algorithm {opts.bitrot_algorithm!r}",
-                ) from None
+            whole_algo = bitrot_mod.BitrotAlgorithm(opts.bitrot_algorithm)
             if whole_algo.streaming:
                 whole_algo = None  # streaming IS the default layout
         writer = ShardStageWriter(
@@ -763,7 +780,7 @@ class ErasureObjects:
 
         stream_range = (
             self._stream_part_range_whole
-            if fi.erasure.checksums
+            if _whole_layout(metas)
             else self._stream_part_range
         )
 
@@ -946,6 +963,13 @@ class ErasureObjects:
         part_file = f"part.{part.number}"
         blobs: list[bytes | None] = [None] * (k + mth)
         loaded = [False] * (k + mth)
+        # Verification must hash the ENTIRE row file (whole-file semantics,
+        # same cost the reference's wholeBitrotReader pays), but only the
+        # region covering the requested blocks is retained afterwards, so a
+        # small range GET of a large legacy object doesn't hold k full rows.
+        b0, b1 = lo // BLOCK_SIZE, (hi - 1) // BLOCK_SIZE
+        region_off = b0 * chunk_full
+        region_end = (b1 + 1) * chunk_full
 
         def load_row(j: int) -> bytes | None:
             meta = metas_by_shard[j]
@@ -965,7 +989,7 @@ class ErasureObjects:
                 return None
             if not _whole_sum_matches(meta, part.number, blob):
                 return None  # whole-file bitrot: the entire row is suspect
-            return blob
+            return blob[region_off:region_end]
 
         def ensure(rows_idx: list[int]) -> None:
             todo = [j for j in rows_idx if not loaded[j]]
@@ -982,17 +1006,15 @@ class ErasureObjects:
         if sum(1 for b in blobs if b is not None) < k:
             raise errors.InsufficientReadQuorum(bucket, object_name)
 
-        b0, b1 = lo // BLOCK_SIZE, (hi - 1) // BLOCK_SIZE
         for g0 in range(b0, b1 + 1, GROUP_BLOCKS):
             g1 = min(g0 + GROUP_BLOCKS - 1, b1)
             rows_by_block: list[list[bytes | None]] = []
             for b in range(g0, g1 + 1):
                 cl = chunk_len(b)
+                off = b * chunk_full - region_off
                 rows_by_block.append(
                     [
-                        blobs[j][b * chunk_full : b * chunk_full + cl]
-                        if blobs[j] is not None
-                        else None
+                        blobs[j][off : off + cl] if blobs[j] is not None else None
                         for j in range(k + mth)
                     ]
                 )
@@ -1231,9 +1253,18 @@ class ErasureObjects:
         part_chunks = {p.number: _shard_chunk_sizes(p.size, k) for p in parts}
         # Legacy whole-file-bitrot objects: raw shard files, one checksum per
         # part per row in each drive's own metadata (cmd/bitrot-whole.go).
-        whole = bool(fi.erasure.checksums)
+        # Majority vote -- one drive's lost cs list must not flip the layout.
+        whole = _whole_layout(metas)
+
+        # Verified single-part whole-file blobs are kept for the rebuild so
+        # the heal doesn't read every surviving row twice (verify + rebuild).
+        # Multi-part objects skip the cache to bound memory at one part.
+        whole_blobs: dict[tuple[int, int], bytes] = {}
 
         def _read_raw(j: int, part: ObjectPartInfo) -> bytes:
+            cached = whole_blobs.get((j, part.number))
+            if cached is not None:
+                return cached
             disk = by_shard[j]
             if disk is None:
                 raise errors.DiskNotFound()
@@ -1271,7 +1302,11 @@ class ErasureObjects:
                 blob = _read_raw(j, part)
             except (errors.DiskError, errors.FileCorrupt):
                 return False
-            return _whole_sum_matches(m, part.number, blob)
+            if not _whole_sum_matches(m, part.number, blob):
+                return False
+            if len(parts) == 1:
+                whole_blobs[(j, part.number)] = blob
+            return True
 
         # Which shard rows need rebuilding? (missing drive, bad metadata, or
         # failed verification of any part chunk.) Verification is batched
@@ -1340,9 +1375,25 @@ class ErasureObjects:
         surviving = [j for j, ok in enumerate(oks) if ok][: k]
         rebuilt_files: dict[int, dict[int, bytes]] = {j: {} for j in bad_rows}  # row -> part -> blob
         rebuilt_sums: dict[int, list[dict]] = {j: [] for j in bad_rows}  # whole-file only
-        whole_algo_name = (
-            fi.erasure.checksums[0].get("algo", "") if whole and fi.erasure.checksums else ""
-        )
+        whole_algo_heal = None
+        if whole:
+            # Algorithm for rebuilt checksums: first parsable entry from a
+            # VERIFIED surviving row (the quorum representative's field may
+            # be the one corrupted drive's).
+            for j in surviving:
+                m_ = metas_by_shard[j]
+                for ent in m_.erasure.checksums if m_ is not None else []:
+                    try:
+                        whole_algo_heal = bitrot_mod.BitrotAlgorithm(ent.get("algo", ""))
+                        break
+                    except ValueError:
+                        continue
+                if whole_algo_heal is not None:
+                    break
+            if whole_algo_heal is None:
+                raise errors.FileCorrupt(
+                    "whole-file bitrot object has no parsable checksum algorithm"
+                )
         if fi.size > 0:
             for part in parts:
                 frames_by_row = {j: read_part_frames(j, part) for j in surviving}
@@ -1371,12 +1422,11 @@ class ErasureObjects:
                     if whole:
                         raw = b"".join(c for _, c in per_row[j])
                         rebuilt_files[j][part.number] = raw
-                        algo = bitrot_mod.BitrotAlgorithm(whole_algo_name)
                         rebuilt_sums[j].append(
                             {
                                 "part": part.number,
-                                "algo": whole_algo_name,
-                                "hash": bitrot_mod.digest_of(raw, algo).hex(),
+                                "algo": whole_algo_heal.value,
+                                "hash": bitrot_mod.digest_of(raw, whole_algo_heal).hex(),
                             }
                         )
                     else:

@@ -1,0 +1,47 @@
+"""Pallas HighwayHash kernel vs numpy oracle (interpret mode on CPU).
+
+Lengths cover: below one chunk (pure XLA path), exact chunk multiples,
+chain + remainder packets, and tail bytes -- plus non-TILE_N stream counts
+exercising the lane padding.
+"""
+
+import numpy as np
+import pytest
+
+from minio_tpu.ops import highwayhash as hh
+from minio_tpu.ops import highwayhash_pallas as hhp
+
+
+@pytest.mark.parametrize(
+    "n_streams,length",
+    [
+        (3, 100),          # no full chunk: pure XLA fallback path
+        (2, 8 * 32),       # exactly one kernel chunk
+        (5, 8 * 32 + 32),  # chain + 1 remainder packet
+        (4, 16 * 32 + 7),  # two chunks + tail bytes
+        (1, 3 * 8 * 32 + 21),
+    ],
+)
+def test_matches_oracle(n_streams, length):
+    rng = np.random.default_rng(n_streams * 1000 + length)
+    data = rng.integers(0, 256, (n_streams, length)).astype(np.uint8)
+    want = hh.hash256_batch(data)
+    got = np.asarray(hhp.hash256_batch(data))
+    assert np.array_equal(want, got)
+
+
+def test_matches_oracle_shard_chunk():
+    """The production shape: 1 MiB / 12 shard chunks."""
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, (4, 87382)).astype(np.uint8)
+    want = hh.hash256_batch(data)
+    got = np.asarray(hhp.hash256_batch(data))
+    assert np.array_equal(want, got)
+
+
+def test_3d_batch_shape():
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (2, 3, 512)).astype(np.uint8)
+    got = np.asarray(hhp.hash256_batch(data))
+    want = hh.hash256_batch(data.reshape(6, 512)).reshape(2, 3, 32)
+    assert np.array_equal(want, got)
