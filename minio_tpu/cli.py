@@ -149,10 +149,31 @@ def serve(argv: list[str]) -> int:
         _log(a.quiet, a.json, msg="self-tests passed", seconds=round(time.perf_counter() - t0, 3))
 
     try:
-        endpoints = expand_endpoints(a.endpoints)
+        # Multi-pool rule (the reference's endpoint-ellipses multi-arg
+        # semantics): when more than one argument carries an ellipsis
+        # pattern, EACH argument is an independent server pool; plain
+        # path lists stay one pool (`server /d1 /d2 /d3 /d4`).
+        ellipsis_args = [arg for arg in a.endpoints if "..." in arg]
+        if ellipsis_args and len(ellipsis_args) != len(a.endpoints):
+            # All-or-none (the reference's rule): a forgotten ellipsis on
+            # one pool argument must not silently collapse pool boundaries.
+            raise ValueError(
+                "either every endpoint argument uses {a...b} ellipses "
+                "(one pool per argument) or none do (one flat pool)"
+            )
+        if len(ellipsis_args) > 1:
+            pools = [expand_endpoints([arg]) for arg in a.endpoints]
+            flat = [e for pool in pools for e in pool]
+            if len(set(flat)) != len(flat):
+                raise ValueError("duplicate endpoints across pools")
+            endpoints: list = pools
+            n_endpoints = len(flat)
+        else:
+            endpoints = expand_endpoints(a.endpoints)
+            n_endpoints = len(endpoints)
     except ValueError as e:
         p.error(str(e))
-    _log(a.quiet, a.json, msg="endpoints", count=len(endpoints))
+    _log(a.quiet, a.json, msg="endpoints", count=n_endpoints)
 
     host, port = _parse_address(p, a.address)
 
@@ -160,7 +181,11 @@ def serve(argv: list[str]) -> int:
 
     from .dist.node import Node
 
-    if len(endpoints) == 1 and not endpoints[0].startswith(("http://", "https://")):
+    if (
+        len(endpoints) == 1
+        and isinstance(endpoints[0], str)
+        and not endpoints[0].startswith(("http://", "https://"))
+    ):
         # Single path -> FS backend, no erasure (the reference picks FS for
         # one endpoint, server-main.go:636-643) — UNLESS the path already
         # holds an erasure format from an earlier deployment; silently
@@ -214,13 +239,14 @@ def serve(argv: list[str]) -> int:
     if stop_evt.is_set():  # signalled during bootstrap
         t.join(5)
         return 0
-    n_sets = len(node.pools.pools[0].sets)
+    n_sets = sum(len(p.sets) for p in node.pools.pools)
     _log(
         a.quiet,
         a.json,
         msg="online",
         codec=type(node.codec).__name__,
         drives=len(node.drives),
+        pools=len(node.pools.pools),
         sets=n_sets,
         set_drive_count=node.set_drive_count,
         s3=f"http://{host}:{port}",

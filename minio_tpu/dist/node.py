@@ -66,7 +66,16 @@ class Node:
         check_skew: bool = False,
     ):
         self.url = url.rstrip("/")
-        self.endpoints = [Endpoint.parse(e) for e in endpoints]
+        # endpoints: flat list (one pool) or list of lists (server pools --
+        # each argument group is an independent pool, the reference's
+        # `minio server poolA{1...n} poolB{1...n}` expansion,
+        # cmd/endpoint-ellipses.go multi-arg pools).
+        if endpoints and isinstance(endpoints[0], (list, tuple)):
+            pool_specs = [list(p) for p in endpoints]
+        else:
+            pool_specs = [list(endpoints)]
+        self.pool_endpoints = [[Endpoint.parse(e) for e in pool] for pool in pool_specs]
+        self.endpoints = [ep for pool in self.pool_endpoints for ep in pool]
         self.token = cluster_token(root_password)
         self.creds = Credentials(root_user, root_password)
         self.region = region
@@ -74,27 +83,36 @@ class Node:
 
         # Drive construction: local paths open directly, remote via REST.
         self.local_drives: dict[str, StorageAPI] = {}
-        self.drives = []
+        self.pool_drives: list[list[StorageAPI]] = []
         peer_urls: set[str] = set()
         from ..control.pubsub import GLOBAL_TRACE
         from ..storage.metered import MeteredDrive
 
-        for ep in self.endpoints:
-            if ep.is_local_path or ep.url == self.url:
-                # Local drives are metered (per-API latency EWMAs + storage
-                # traces, xl-storage-disk-id-check.go role).
-                d = MeteredDrive(LocalDrive(ep.path), trace=GLOBAL_TRACE)
-                self.local_drives[ep.path] = d
-                self.drives.append(d)
-            else:
-                peer_urls.add(ep.url)
-                self.drives.append(RemoteDrive(ep.url, ep.path, self.token))
+        for pool in self.pool_endpoints:
+            drives: list[StorageAPI] = []
+            for ep in pool:
+                if ep.is_local_path or ep.url == self.url:
+                    # Local drives are metered (per-API latency EWMAs +
+                    # storage traces, xl-storage-disk-id-check.go role).
+                    d = MeteredDrive(LocalDrive(ep.path), trace=GLOBAL_TRACE)
+                    self.local_drives[ep.path] = d
+                    drives.append(d)
+                else:
+                    peer_urls.add(ep.url)
+                    drives.append(RemoteDrive(ep.url, ep.path, self.token))
+            self.pool_drives.append(drives)
+        self.drives = [d for pool in self.pool_drives for d in pool]
         self.peer_urls = sorted(peer_urls)
 
-        n = len(self.drives)
-        self.set_drive_count = set_drive_count or _default_set_count(n)
-        if n % self.set_drive_count:
-            raise ValueError(f"{n} drives not divisible into sets of {self.set_drive_count}")
+        # One set size must fit every pool (the reference requires per-pool
+        # divisibility too; set count may differ per pool).
+        self.set_drive_count = set_drive_count or _default_set_count(len(self.pool_drives[0]))
+        for pi, drives in enumerate(self.pool_drives):
+            if len(drives) % self.set_drive_count:
+                raise ValueError(
+                    f"pool {pi}: {len(drives)} drives not divisible into "
+                    f"sets of {self.set_drive_count}"
+                )
         self.parity = parity
         # Leader = the node owning the first endpoint (server-main.go:507
         # "first local" orchestrates format).
@@ -110,9 +128,9 @@ class Node:
 
     # -- format consensus ----------------------------------------------------
 
-    def _read_formats(self) -> list[fmt_mod.DriveFormat | None]:
+    def _read_formats(self, drives) -> list[fmt_mod.DriveFormat | None]:
         out: list[fmt_mod.DriveFormat | None] = []
-        for d in self.drives:
+        for d in drives:
             try:
                 raw = d.read_all(fmt_mod.SYS_DIR, fmt_mod.FORMAT_FILE)
                 out.append(fmt_mod.DriveFormat.from_json(raw.decode()))
@@ -120,17 +138,27 @@ class Node:
                 out.append(None)
         return out
 
-    def wait_for_format(self, timeout: float = 30.0) -> fmt_mod.DriveFormat:
-        """Reach format quorum, creating fresh formats if the whole cluster
-        is unformatted and this node leads (prepare-storage.go role)."""
+    def wait_for_format(
+        self,
+        timeout: float = 30.0,
+        drives: list | None = None,
+        deployment_id: str | None = None,
+    ) -> fmt_mod.DriveFormat:
+        """Reach format quorum for one pool's drives, creating fresh formats
+        if the whole pool is unformatted and this node leads
+        (prepare-storage.go role). Pools after the first inherit the
+        cluster deployment id."""
+        drives = self.drives if drives is None else drives
         deadline = time.monotonic() + timeout
         while True:
-            formats = self._read_formats()
+            formats = self._read_formats(drives)
             n_fmt = sum(1 for f in formats if f is not None)
             if n_fmt == 0 and self.is_leader:
-                n_sets = len(self.drives) // self.set_drive_count
-                fresh = fmt_mod.init_format(n_sets, self.set_drive_count)
-                for d, f in zip(self.drives, fresh):
+                n_sets = len(drives) // self.set_drive_count
+                fresh = fmt_mod.init_format(
+                    n_sets, self.set_drive_count, deployment_id=deployment_id
+                )
+                for d, f in zip(drives, fresh):
                     try:
                         d.write_all(fmt_mod.SYS_DIR, fmt_mod.FORMAT_FILE, f.to_json().encode())
                     except errors.DiskError:
@@ -145,12 +173,12 @@ class Node:
                     # Heal format onto unformatted drives that we can reach:
                     # give each missing slot the id the quorum expects.
                     flat_ids = [i for s in quorum.sets for i in s]
-                    for d, f in zip(self.drives, formats):
+                    for d, f in zip(drives, formats):
                         if f is None and d.is_online():
                             # Which slot is this drive? By position in the
                             # endpoint list (the reference heals by position
                             # too, format-erasure.go:783).
-                            idx = self.drives.index(d)
+                            idx = drives.index(d)
                             if idx < len(flat_ids):
                                 healed = fmt_mod.DriveFormat(
                                     deployment_id=quorum.deployment_id,
@@ -181,7 +209,6 @@ class Node:
     # -- assembly ------------------------------------------------------------
 
     def build(self) -> "Node":
-        quorum = self.wait_for_format()
         layer_codec = self.codec
         if self.codec is None:
             # Install the served data-plane codec: the cross-request batching
@@ -197,15 +224,34 @@ class Node:
             layer_codec = None
         else:
             codec_mod.set_default_codec(self.codec)
-        sets = ErasureSets.from_drives(
-            list(self.drives), quorum, parity=self.parity, codec=layer_codec
-        )
-        self.pools = ServerPools([sets])
+        # One ErasureSets per pool; pools after the first share the cluster
+        # deployment id (erasure-server-pool.go newErasureServerPools role).
+        pool_sets: list[ErasureSets] = []
+        dep_id: str | None = None
+        for pi, drives in enumerate(self.pool_drives):
+            quorum = self.wait_for_format(drives=drives, deployment_id=dep_id)
+            if dep_id is not None and quorum.deployment_id != dep_id:
+                # A pre-formatted pool from a DIFFERENT cluster must not be
+                # silently merged into this namespace (the reference rejects
+                # mismatched deployment ids at startup).
+                raise errors.UnformattedDisk(
+                    f"pool {pi} belongs to deployment {quorum.deployment_id}, "
+                    f"cluster is {dep_id}"
+                )
+            dep_id = dep_id or quorum.deployment_id
+            pool_sets.append(
+                ErasureSets.from_drives(
+                    list(drives), quorum, parity=self.parity, codec=layer_codec,
+                    pool_index=pi,
+                )
+            )
+        self.pools = ServerPools(pool_sets)
         lockers: list = [self.locker] + [RemoteLocker(u, self.token) for u in self.peer_urls]
         self.ns_lock = NamespaceLock(lockers)
         self.pools.ns_lock = self.ns_lock
-        for s in sets.sets:
-            s.ns_lock = self.ns_lock
+        for sets in pool_sets:
+            for s in sets.sets:
+                s.ns_lock = self.ns_lock
         self.iam = IAMSys(self.creds.access_key, self.creds.secret_key)
         from ..control.kms import StaticKeyKMS
 

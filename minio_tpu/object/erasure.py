@@ -828,7 +828,12 @@ class ErasureObjects:
 
         part_file = f"part.{part.number}"
         b0, b1 = lo // BLOCK_SIZE, (hi - 1) // BLOCK_SIZE
-        for g0 in range(b0, b1 + 1, GROUP_BLOCKS):
+
+        def make_window(g0: int):
+            """Issue the window's data-row reads immediately (futures); the
+            readahead stage -- window g+1's drive IO overlaps window g's
+            verify/decode (klauspost/readahead's role in the reference read
+            pipeline, cmd/object-api-utils.go:686)."""
             g1 = min(g0 + GROUP_BLOCKS - 1, b1)
             window_sizes = [chunk_len(b) for b in range(g0, g1 + 1)]
             file_off = g0 * frame_full
@@ -856,13 +861,22 @@ class ErasureObjects:
                 except (errors.DiskError, errors.FileCorrupt):
                     return None
 
+            futures = meta_mod.parallel_submit(read_window, list(range(k)))
+            return g1, read_window, futures
+
+        starts = list(range(b0, b1 + 1, GROUP_BLOCKS))
+        pending = make_window(starts[0])
+        for win_i, g0 in enumerate(starts):
+            g1, read_window, futures = pending
+            # Kick off the NEXT window's reads before decoding this one.
+            pending = make_window(starts[win_i + 1]) if win_i + 1 < len(starts) else None
+
             # Data rows first; parity pulled lazily on any failure (the
             # lazy-spare parallelReader discipline, erasure-decode.go:119).
             frames: list[list[tuple[bytes, bytes]] | None] = [None] * (k + mth)
             loaded = [False] * (k + mth)
-            results = meta_mod.parallel_map(read_window, list(range(k)))
             for j in range(k):
-                frames[j] = results[j][0]
+                frames[j] = futures[j].result()[0]
                 loaded[j] = True
 
             def load_spares() -> None:
@@ -1304,7 +1318,11 @@ class ErasureObjects:
                 return False
             if not _whole_sum_matches(m, part.number, blob):
                 return False
-            if len(parts) == 1:
+            # Rows are verified in index order and the rebuild re-reads only
+            # the FIRST k surviving rows, so caching the first k verified
+            # rows covers exactly the reuse set (single-part only: memory is
+            # bounded at k rows ~ the part size).
+            if len(parts) == 1 and len(whole_blobs) < k:
                 whole_blobs[(j, part.number)] = blob
             return True
 

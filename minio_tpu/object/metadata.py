@@ -30,6 +30,20 @@ def parallel_map(fn, items):
     return list(_POOL.map(wrap, items))
 
 
+def parallel_submit(fn, items):
+    """Like parallel_map but returns futures of (result, error) immediately
+    — the read-ahead primitive (klauspost/readahead's role: issue the next
+    window's drive reads while the current one decodes)."""
+
+    def wrap(item):
+        try:
+            return fn(item), None
+        except Exception as e:  # noqa: BLE001
+            return None, e
+
+    return [_POOL.submit(wrap, item) for item in items]
+
+
 def read_all_file_info(
     disks: list[StorageAPI | None], bucket: str, path: str, version_id: str = ""
 ) -> tuple[list[FileInfo | None], list[Exception | None]]:

@@ -54,7 +54,7 @@ class BatchingDeviceCodec(BlockCodec):
     def __init__(
         self,
         block_size: int = 1 << 20,
-        max_batch: int = 32,
+        max_batch: int = 64,
         batch_timeout_s: float = 0.0005,
         mesh=None,
     ):
@@ -207,19 +207,25 @@ class BatchingDeviceCodec(BlockCodec):
         if len(chunks) < 4 or len({len(c) for c in chunks}) != 1:
             return self._host.digests_batch(chunks)
         length = len(chunks[0])
-        with self._lock:
-            if length not in self._verify_lens:
-                if length < (16 << 10) or len(self._verify_lens) >= 8:
-                    # Tiny chunks or too many distinct lengths: the device
-                    # compile would cost more than it saves — host path.
-                    pass_to_host = True
+        # Full-chunk lengths (ceil(block/k) for any plausible k) are the
+        # steady-state production sizes: always device-eligible, never
+        # counted against the cap, so one-off tail lengths can't lock the
+        # hot path out of the compile budget.
+        full_chunk = length in {-(-self.block_size // k) for k in range(1, 33)}
+        if not full_chunk:
+            with self._lock:
+                if length not in self._verify_lens:
+                    if length < (16 << 10) or len(self._verify_lens) >= 8:
+                        # Tiny chunks or too many distinct lengths: the
+                        # device compile costs more than it saves.
+                        pass_to_host = True
+                    else:
+                        self._verify_lens.add(length)
+                        pass_to_host = False
                 else:
-                    self._verify_lens.add(length)
                     pass_to_host = False
-            else:
-                pass_to_host = False
-        if pass_to_host:
-            return self._host.digests_batch(chunks)
+            if pass_to_host:
+                return self._host.digests_batch(chunks)
         from ..models.pipeline import ErasurePipeline, Geometry
         from ..object.codec import bucket_batch
 
