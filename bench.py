@@ -216,7 +216,7 @@ def object_layer_metrics(use_device: bool) -> dict:
 
 
 def device_metrics() -> dict:
-    """Encode / fused encode+hash / reconstruct GiB/s on the live device."""
+    """Encode / hash / fused / reconstruct GiB/s on the live device."""
     import jax
     import jax.numpy as jnp
 
@@ -234,17 +234,52 @@ def device_metrics() -> dict:
     def encode_only(x):
         return codec.encode(x)
 
-    @jax.jit
-    def fused(x):
-        shards = codec.encode_all(x)
-        return shards, hhj.hash256_batch(shards)
-
     encode_only(dev).block_until_ready()
     t0 = time.perf_counter()
     for _ in range(ITERS):
         out = encode_only(dev)
     out.block_until_ready()
     enc_gibs = BATCH * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
+
+    # Hash-only throughput of both device implementations over the fused
+    # batch's stream shape; the fused number below uses the winner (also
+    # what pipeline.hash_batch_fn serves with).
+    hdata = jax.device_put(
+        jnp.asarray(
+            rng.integers(0, 256, (FUSED_BATCH * (K + M), SHARD), dtype=np.uint8)
+        )
+    )
+    hash_impls: dict[str, object] = {"xla": hhj.hash256_batch}
+    hash_errors: dict[str, str] = {}
+    try:
+        from minio_tpu.ops import highwayhash_pallas as hhp
+
+        hash_impls["pallas"] = hhp.hash256_batch
+    except Exception as e:  # noqa: BLE001
+        hash_errors["pallas"] = f"{type(e).__name__}: {e}"[:300]
+    hash_gibs: dict[str, float] = {}
+    for name, fn in hash_impls.items():
+        try:
+            jfn = jax.jit(fn)
+            jfn(hdata).block_until_ready()
+            hiters = max(4, ITERS // 2)
+            t0 = time.perf_counter()
+            for _ in range(hiters):
+                hout = jfn(hdata)
+            hout.block_until_ready()
+            hash_gibs[name] = (
+                hdata.size * hiters / (time.perf_counter() - t0) / (1 << 30)
+            )
+        except Exception as e:  # noqa: BLE001
+            hash_errors[name] = f"{type(e).__name__}: {e}"[:300]
+    best_hash = max(hash_gibs, key=hash_gibs.get) if hash_gibs else "xla"
+    best_hash_fn = hash_impls.get(best_hash, hhj.hash256_batch)
+
+    @jax.jit
+    def fused(x):
+        shards = codec.encode_all(x)
+        b, t, s = shards.shape
+        return shards, best_hash_fn(shards.reshape(b * t, s))
 
     # Reconstruct 4 missing data shards from the 12 surviving rows.
     w = codec.reconstruct_weights(PRESENT, MISSING)
@@ -290,6 +325,10 @@ def device_metrics() -> dict:
         "encode_gibs": enc_gibs,
         "decode_recon4_gibs": dec_gibs,
         "fused_encode_hash_gibs": fused_gibs,
+        "fused_hash_impl": best_hash,
+        "hash_xla_gibs": round(hash_gibs.get("xla", 0.0), 3),
+        "hash_pallas_gibs": round(hash_gibs.get("pallas", 0.0), 3),
+        "hash_errors": hash_errors,
         "pallas_encode_gibs": pallas_gibs,
         "pallas_error": pallas_error,
     }
@@ -395,6 +434,10 @@ def device_line(dm: dict, cpu_enc: float, cpu_dec: float, obj: dict) -> dict:
         "device": dm["platform"] != "cpu",
         "cpu_avx2_gibs": round(cpu_enc, 3),
         "fused_encode_hash_gibs": round(dm["fused_encode_hash_gibs"], 3),
+        "fused_hash_impl": dm.get("fused_hash_impl", ""),
+        "hash_xla_gibs": dm.get("hash_xla_gibs", 0.0),
+        "hash_pallas_gibs": dm.get("hash_pallas_gibs", 0.0),
+        "hash_errors": dm.get("hash_errors", {}),
         "pallas_encode_gibs": round(dm.get("pallas_encode_gibs", 0.0), 3),
         "pallas_error": dm.get("pallas_error", ""),
         "decode_recon4_gibs": round(dm["decode_recon4_gibs"], 3),

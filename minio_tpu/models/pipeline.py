@@ -23,9 +23,29 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import os
+
 from ..ops import highwayhash_jax as hhj
 from ..ops import rs, rs_matrix
 from ..parallel import mesh as mesh_lib
+
+
+def hash_batch_fn():
+    """The device hash implementation the pipeline serves with.
+
+    MINIO_TPU_HASH = xla | pallas | auto (default). Auto picks the Pallas
+    VMEM-chain kernel on real TPU (the scan version pays a while-loop
+    dispatch per packet chunk) and the XLA scan elsewhere (Pallas interpret
+    mode on CPU is far slower than compiled XLA).
+    """
+    mode = os.environ.get("MINIO_TPU_HASH", "auto").lower()
+    if mode == "xla":
+        return hhj.hash256_batch
+    if mode == "pallas" or jax.default_backend() in ("tpu", "axon"):
+        from ..ops import highwayhash_pallas as hhp
+
+        return hhp.hash256_batch
+    return hhj.hash256_batch
 
 
 @dataclass(frozen=True)
@@ -68,7 +88,7 @@ class ErasurePipeline:
             """[B, K, S] -> ([B, K+M, S] shards, [B, K+M, 32] digests)."""
             all_shards = self.codec.encode_all(data_shards)
             b, t, s = all_shards.shape
-            digests = hhj.hash256_batch(all_shards.reshape(b * t, s)).reshape(b, t, 32)
+            digests = hash_batch_fn()(all_shards.reshape(b * t, s)).reshape(b, t, 32)
             return all_shards, digests
 
         if mesh is None:
@@ -151,7 +171,7 @@ class ErasurePipeline:
     def verify_digests(self, shards) -> jax.Array:
         """[B, T, S] shards -> [B, T, 32] digests (for bitrot deep-scan)."""
         b, t, s = shards.shape
-        return hhj.hash256_batch(shards.reshape(b * t, s)).reshape(b, t, 32)
+        return hash_batch_fn()(shards.reshape(b * t, s)).reshape(b, t, 32)
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
@@ -160,5 +180,5 @@ def _reconstruct_step(survivors: jax.Array, w_bits: jax.Array, with_digests: boo
     if not with_digests:
         return rebuilt, None
     b, r, s = rebuilt.shape
-    digests = hhj.hash256_batch(rebuilt.reshape(b * r, s)).reshape(b, r, 32)
+    digests = hash_batch_fn()(rebuilt.reshape(b * r, s)).reshape(b, r, 32)
     return rebuilt, digests
