@@ -1,0 +1,323 @@
+"""Embedded web console: the minio/console role, self-contained.
+
+The reference embeds the external `minio/console` React app on a separate
+port (cmd/common-main.go:197 initConsoleServer). This build serves a single
+self-contained page plus a small JSON API under the reserved /mtpu prefix
+(same port; "mtpu" is a reserved namespace like the reference's "minio"
+bucket), covering the operator surface: login, cluster info, per-bucket
+usage, object browsing, and a Prometheus snapshot. Everything else is the
+admin REST's job (api/admin.py).
+
+Auth: POST /mtpu/console/api/login with root or admin:*-allowed
+credentials returns an HS256 JWT (signed with the root secret, 12 h
+expiry; verified with api/jwt.verify); API calls carry it as a Bearer
+token. The page renders all server-supplied strings through DOM
+textContent -- object keys are attacker-controlled and must never reach
+innerHTML.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hmac
+import json
+import time
+
+from aiohttp import web
+
+from ..utils import errors as oerr
+from .jwt import JWTError, sign_hs256, verify as jwt_verify
+
+CONSOLE_PREFIX = "/mtpu/console"
+TOKEN_TTL_S = 12 * 3600
+
+
+def make_console_app(ctx) -> web.Application:
+    """ctx: the admin context (iam, layer, metrics, node back-reference)."""
+    app = web.Application()
+
+    def _ready() -> None:
+        if not getattr(ctx, "ready", True):
+            raise web.HTTPServiceUnavailable(text="server initializing")
+
+    def _secret() -> str:
+        return ctx.iam.root.secret_key
+
+    def _authed(request: web.Request) -> str:
+        _ready()
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            raise web.HTTPUnauthorized(text="missing bearer token")
+        try:
+            payload = jwt_verify(auth[7:], hmac_secret=_secret())
+        except JWTError as e:
+            raise web.HTTPUnauthorized(text=str(e)) from None
+        return payload.get("sub", "")
+
+    def _json(data, status=200) -> web.Response:
+        return web.json_response(data, status=status)
+
+    async def login(request: web.Request) -> web.Response:
+        _ready()
+        try:
+            doc = json.loads(await request.read() or b"{}")
+        except ValueError:
+            return _json({"error": "bad json"}, 400)
+        ak = doc.get("accessKey", "")
+        sk = doc.get("secretKey", "")
+        if not isinstance(ak, str) or not isinstance(sk, str):
+            return _json({"error": "invalid credentials"}, 401)
+        creds = ctx.iam.lookup(ak)
+        try:
+            ok = creds is not None and hmac.compare_digest(
+                creds.secret_key.encode(), sk.encode()
+            )
+        except (TypeError, UnicodeError):
+            ok = False
+        if not ok:
+            return _json({"error": "invalid credentials"}, 401)
+        if ak != ctx.iam.root.access_key and not ctx.iam.is_allowed(
+            ak, "admin:*", "arn:aws:s3:::*"
+        ):
+            return _json({"error": "console requires admin privileges"}, 403)
+        token = sign_hs256({"sub": ak, "exp": int(time.time()) + TOKEN_TTL_S}, _secret())
+        return _json({"token": token})
+
+    def _usage_summary() -> dict:
+        scanner = getattr(getattr(ctx, "node", None), "scanner", None)
+        if scanner is not None and getattr(scanner, "usage", None) is not None:
+            try:
+                return scanner.usage.summary()
+            except Exception:  # noqa: BLE001 - usage is advisory
+                pass
+        return {}
+
+    async def info(request: web.Request) -> web.Response:
+        _authed(request)
+
+        def work():
+            layer = ctx.layer
+            pools = getattr(layer, "pools", [])
+            drives_total = drives_online = sets = 0
+            for p in pools:
+                for s in getattr(p, "sets", []):
+                    sets += 1
+                    for d in s.disks:
+                        drives_total += 1
+                        if d is not None and d.is_online():
+                            drives_online += 1
+            return {
+                "pools": len(pools),
+                "sets": sets,
+                "drivesTotal": drives_total,
+                "drivesOnline": drives_online,
+                "usage": _usage_summary(),
+            }
+
+        return _json(await asyncio.to_thread(work))
+
+    async def buckets(request: web.Request) -> web.Response:
+        _authed(request)
+
+        def work():
+            usage = _usage_summary().get("bucketsUsage", {})
+            out = []
+            for b in ctx.layer.list_buckets():
+                u = usage.get(b.name, {})
+                out.append(
+                    {
+                        "name": b.name,
+                        "created": b.created,
+                        "objects": u.get("objectsCount", None),
+                        "size": u.get("objectsTotalSize", None),
+                    }
+                )
+            return {"buckets": out}
+
+        return _json(await asyncio.to_thread(work))
+
+    async def objects(request: web.Request) -> web.Response:
+        _authed(request)
+        q = request.rel_url.query
+        bucket = q.get("bucket", "")
+        if not bucket:
+            return _json({"error": "bucket required"}, 400)
+        try:
+            max_keys = int(q.get("max-keys", "100"))
+        except ValueError:
+            return _json({"error": "bad max-keys"}, 400)
+
+        def work():
+            return ctx.layer.list_objects(
+                bucket,
+                prefix=q.get("prefix", ""),
+                marker=q.get("marker", ""),
+                delimiter=q.get("delimiter", "/"),
+                max_keys=max_keys,
+            )
+
+        try:
+            res = await asyncio.to_thread(work)
+        except (oerr.BucketNotFound, oerr.BucketNameInvalid) as e:
+            return _json({"error": str(e)}, 404)
+        except oerr.StorageError as e:
+            return _json({"error": str(e)}, 400)
+        return _json(
+            {
+                "objects": [
+                    {"name": o.name, "size": o.size, "modTime": o.mod_time, "etag": o.etag}
+                    for o in res.objects
+                ],
+                "prefixes": res.prefixes,
+                "truncated": res.is_truncated,
+                "nextMarker": res.next_marker,
+            }
+        )
+
+    async def metrics(request: web.Request) -> web.Response:
+        _authed(request)
+        m = getattr(ctx, "metrics", None)
+        text = await asyncio.to_thread(m.render) if m is not None else ""
+        return web.Response(text=text, content_type="text/plain")
+
+    async def index(request: web.Request) -> web.Response:
+        return web.Response(text=_PAGE, content_type="text/html")
+
+    app.router.add_post("/api/login", login)
+    app.router.add_get("/api/info", info)
+    app.router.add_get("/api/buckets", buckets)
+    app.router.add_get("/api/objects", objects)
+    app.router.add_get("/api/metrics", metrics)
+    app.router.add_get("", index)
+    app.router.add_get("/", index)
+    return app
+
+
+# The page builds every data-driven node with document.createElement +
+# textContent (never innerHTML) -- bucket names and object keys are
+# user-controlled input.
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>minio_tpu console</title>
+<style>
+ :root { color-scheme: dark; }
+ body { font: 14px/1.5 system-ui, sans-serif; margin: 0; background: #101418; color: #dde3ea; }
+ header { padding: 14px 24px; background: #161c24; border-bottom: 1px solid #232b36;
+          display: flex; align-items: baseline; gap: 12px; }
+ header h1 { font-size: 16px; margin: 0; } header span { color: #7c8a9c; font-size: 12px; }
+ main { padding: 24px; max-width: 1080px; margin: auto; }
+ .cards { display: flex; gap: 16px; flex-wrap: wrap; margin-bottom: 24px; }
+ .card { background: #161c24; border: 1px solid #232b36; border-radius: 8px;
+         padding: 14px 20px; min-width: 130px; }
+ .card b { display: block; font-size: 22px; } .card span { color: #7c8a9c; font-size: 12px; }
+ table { width: 100%; border-collapse: collapse; background: #161c24;
+         border: 1px solid #232b36; border-radius: 8px; }
+ th, td { text-align: left; padding: 8px 14px; border-bottom: 1px solid #1d2530; }
+ th { color: #7c8a9c; font-weight: 500; font-size: 12px; }
+ tr:hover td { background: #1a2129; } a { color: #62b0ff; cursor: pointer; text-decoration: none; }
+ #login { max-width: 320px; margin: 12vh auto; background: #161c24; padding: 28px;
+          border-radius: 10px; border: 1px solid #232b36; }
+ input { width: 100%; box-sizing: border-box; margin: 6px 0; padding: 9px 10px;
+         background: #0d1116; color: #dde3ea; border: 1px solid #2a3442; border-radius: 6px; }
+ button { margin-top: 10px; width: 100%; padding: 9px; background: #2463eb; color: white;
+          border: 0; border-radius: 6px; cursor: pointer; font-size: 14px; }
+ .err { color: #ff7a7a; font-size: 13px; min-height: 18px; }
+ .crumbs { margin: 12px 0; color: #7c8a9c; } .hide { display: none; }
+</style></head><body>
+<header><h1>minio_tpu</h1><span>console</span>
+ <span style="margin-left:auto"><a id="logout" class="hide">sign out</a></span></header>
+<main>
+ <div id="login"><h3>Sign in</h3>
+  <input id="ak" placeholder="access key" autocomplete="username">
+  <input id="sk" placeholder="secret key" type="password" autocomplete="current-password">
+  <div class="err" id="lerr"></div><button id="go">Sign in</button></div>
+ <div id="dash" class="hide">
+  <div class="cards" id="cards"></div>
+  <div class="crumbs" id="crumbs"></div>
+  <table id="tbl"><thead></thead><tbody></tbody></table>
+ </div>
+</main><script>
+const $ = q => document.querySelector(q);
+let tok = sessionStorage.getItem('tok') || '';
+const api = async (p, opt = {}) => {
+  opt.headers = Object.assign({Authorization: 'Bearer ' + tok}, opt.headers || {});
+  const r = await fetch('/mtpu/console/api' + p, opt);
+  if (r.status === 401) { out(); throw 0; }
+  return r;
+};
+function out() {
+  tok = ''; sessionStorage.removeItem('tok');
+  $('#login').classList.remove('hide'); $('#dash').classList.add('hide');
+  $('#logout').classList.add('hide');
+}
+$('#logout').onclick = out;
+$('#go').onclick = async () => {
+  const r = await fetch('/mtpu/console/api/login', {method: 'POST',
+    body: JSON.stringify({accessKey: $('#ak').value, secretKey: $('#sk').value})});
+  const d = await r.json();
+  if (!r.ok) { $('#lerr').textContent = d.error || 'login failed'; return; }
+  tok = d.token; sessionStorage.setItem('tok', tok); boot();
+};
+const fmt = n => n == null ? '\\u2013' :
+  n >= 1<<30 ? (n/(1<<30)).toFixed(1)+' GiB' : n >= 1<<20 ? (n/(1<<20)).toFixed(1)+' MiB' :
+  n >= 1024 ? (n/1024).toFixed(1)+' KiB' : n + ' B';
+// DOM builders: every data string lands in textContent, never markup.
+const el = (tag, text, onclick) => {
+  const e = document.createElement(tag);
+  if (text != null) e.textContent = text;
+  if (onclick) { e.addEventListener('click', onclick); }
+  return e;
+};
+const row = cells => {
+  const tr = document.createElement('tr');
+  for (const c of cells) { const td = document.createElement('td');
+    td.append(c instanceof Node ? c : el('span', c)); tr.append(td); }
+  return tr;
+};
+const head = cols => {
+  const tr = document.createElement('tr');
+  for (const c of cols) tr.append(el('th', c));
+  $('#tbl thead').replaceChildren(tr);
+  $('#tbl tbody').replaceChildren();
+};
+async function boot() {
+  $('#login').classList.add('hide'); $('#dash').classList.remove('hide');
+  $('#logout').classList.remove('hide');
+  const i = await (await api('/info')).json();
+  const cards = [['pools', i.pools], ['sets', i.sets], ['drives online', i.drivesOnline],
+    ['drives total', i.drivesTotal], ['objects', i.usage.objectsCount ?? '\\u2013'],
+    ['data', fmt(i.usage.objectsTotalSize)]];
+  $('#cards').replaceChildren(...cards.map(([k, v]) => {
+    const c = el('div'); c.className = 'card'; c.append(el('b', v), el('span', k)); return c;
+  }));
+  showBuckets();
+}
+async function showBuckets() {
+  $('#crumbs').replaceChildren(el('a', 'buckets', showBuckets));
+  const d = await (await api('/buckets')).json();
+  head(['bucket', 'objects', 'size']);
+  const body = $('#tbl tbody');
+  if (!d.buckets.length) body.append(row(['no buckets', '', '']));
+  for (const b of d.buckets)
+    body.append(row([el('a', b.name, () => showObjs(b.name, '')),
+      b.objects ?? '\\u2013', fmt(b.size)]));
+}
+async function showObjs(bucket, prefix, marker = '') {
+  $('#crumbs').replaceChildren(el('a', 'buckets', showBuckets),
+    el('span', ' / '), el('b', bucket), el('span', ' / ' + prefix));
+  const q = new URLSearchParams({bucket, prefix, marker, 'max-keys': '100'});
+  const d = await (await api('/objects?' + q)).json();
+  head(['key', 'size', 'modified']);
+  const body = $('#tbl tbody');
+  for (const p of d.prefixes)
+    body.append(row([el('a', p, () => showObjs(bucket, p)), '\\u2013', '\\u2013']));
+  for (const o of d.objects)
+    body.append(row([o.name, fmt(o.size),
+      new Date(o.modTime * 1000).toISOString().slice(0, 19)]));
+  if (!d.prefixes.length && !d.objects.length) body.append(row(['empty', '', '']));
+  if (d.truncated)
+    body.append(row([el('a', 'next page \\u2192',
+      () => showObjs(bucket, prefix, d.nextMarker)), '', '']));
+}
+if (tok) boot();
+</script></body></html>
+"""

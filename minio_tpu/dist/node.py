@@ -373,6 +373,9 @@ class Node:
         from ..api.admin import ADMIN_PREFIX, make_admin_app
 
         app.add_subapp(ADMIN_PREFIX, make_admin_app(_LazyAdminContext(self)))
+        from ..api.console import CONSOLE_PREFIX, make_console_app
+
+        app.add_subapp(CONSOLE_PREFIX, make_console_app(_LazyAdminContext(self)))
 
         async def s3_entry(request: web.Request):
             if self.s3 is None:
@@ -393,6 +396,10 @@ class _LazyAdminContext:
     @property
     def ready(self) -> bool:
         return self._node.s3 is not None
+
+    @property
+    def node(self):
+        return self._node
 
     @property
     def layer(self):

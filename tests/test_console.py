@@ -1,0 +1,111 @@
+"""Embedded web console (minio/console role): login, info, browse."""
+
+import json
+import os
+from types import SimpleNamespace
+
+import pytest
+import requests
+
+from minio_tpu.api.server import ThreadedServer
+from minio_tpu.dist.node import Node
+from minio_tpu.object.codec import HostCodec
+
+ROOT, SECRET = "consoleadmin", "consolesecret"
+
+
+@pytest.fixture(scope="module")
+def srv(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("console")
+    dirs = []
+    for i in range(4):
+        d = str(tmp / f"d{i}")
+        os.makedirs(d)
+        dirs.append(d)
+    node = Node(dirs, root_user=ROOT, root_password=SECRET, codec=HostCodec())
+    ts = ThreadedServer(SimpleNamespace(app=node.make_app()))
+    base = ts.start()
+    node.build()
+    yield {"node": node, "base": base}
+    ts.stop()
+
+
+def _login(base, ak=ROOT, sk=SECRET):
+    return requests.post(
+        f"{base}/mtpu/console/api/login",
+        data=json.dumps({"accessKey": ak, "secretKey": sk}),
+        timeout=10,
+    )
+
+
+def test_page_served(srv):
+    r = requests.get(f"{srv['base']}/mtpu/console/", timeout=10)
+    assert r.status_code == 200
+    assert "console" in r.text
+
+
+def test_login_info_and_browse(srv):
+    base, node = srv["base"], srv["node"]
+    r = _login(base)
+    assert r.status_code == 200, r.text
+    hdrs = {"Authorization": f"Bearer {r.json()['token']}"}
+
+    r = requests.get(f"{base}/mtpu/console/api/info", headers=hdrs, timeout=10)
+    assert r.status_code == 200
+    info = r.json()
+    assert info["drivesTotal"] == 4 and info["drivesOnline"] == 4
+
+    node.pools.make_bucket("conb")
+    node.pools.put_object("conb", "dir/x", b"hello world")
+    r = requests.get(f"{base}/mtpu/console/api/buckets", headers=hdrs, timeout=10)
+    assert any(b["name"] == "conb" for b in r.json()["buckets"])
+
+    r = requests.get(
+        f"{base}/mtpu/console/api/objects", params={"bucket": "conb"},
+        headers=hdrs, timeout=10,
+    )
+    assert r.json()["prefixes"] == ["dir/"]
+    r = requests.get(
+        f"{base}/mtpu/console/api/objects",
+        params={"bucket": "conb", "prefix": "dir/"},
+        headers=hdrs, timeout=10,
+    )
+    assert [o["name"] for o in r.json()["objects"]] == ["dir/x"]
+
+    r = requests.get(f"{base}/mtpu/console/api/metrics", headers=hdrs, timeout=10)
+    assert r.status_code == 200
+
+
+def test_bad_credentials_rejected(srv):
+    base = srv["base"]
+    assert _login(base, sk="wrong").status_code == 401
+    assert requests.get(f"{base}/mtpu/console/api/info", timeout=10).status_code == 401
+    r = requests.get(
+        f"{base}/mtpu/console/api/info",
+        headers={"Authorization": "Bearer junk.junk.junk"},
+        timeout=10,
+    )
+    assert r.status_code == 401
+
+
+def test_non_admin_user_rejected(srv):
+    srv["node"].iam.add_user("plainuser", "plainsecret1234")
+    assert _login(srv["base"], ak="plainuser", sk="plainsecret1234").status_code == 403
+
+
+def test_503_before_build(tmp_path):
+    dirs = []
+    for i in range(4):
+        d = str(tmp_path / f"u{i}")
+        os.makedirs(d)
+        dirs.append(d)
+    node = Node(dirs, root_user=ROOT, root_password=SECRET, codec=HostCodec())
+    ts = ThreadedServer(SimpleNamespace(app=node.make_app()))
+    base = ts.start()
+    try:
+        r = _login(base)
+        assert r.status_code == 503
+        r = requests.get(f"{base}/mtpu/console/api/info", timeout=10)
+        assert r.status_code == 503
+    finally:
+        ts.stop()
