@@ -52,7 +52,15 @@ def make_console_app(ctx) -> web.Application:
             payload = jwt_verify(auth[7:], hmac_secret=_secret())
         except JWTError as e:
             raise web.HTTPUnauthorized(text=str(e)) from None
-        return payload.get("sub", "")
+        ak = payload.get("sub", "")
+        # Re-check the principal on EVERY call: a deleted/disabled admin's
+        # token must die with the account, not live out its 12h expiry.
+        if ak != ctx.iam.root.access_key and (
+            ctx.iam.lookup(ak) is None
+            or not ctx.iam.is_allowed(ak, "admin:*", "arn:aws:s3:::*")
+        ):
+            raise web.HTTPUnauthorized(text="account no longer authorized")
+        return ak
 
     def _json(data, status=200) -> web.Response:
         return web.json_response(data, status=status)
@@ -62,6 +70,8 @@ def make_console_app(ctx) -> web.Application:
         try:
             doc = json.loads(await request.read() or b"{}")
         except ValueError:
+            return _json({"error": "bad json"}, 400)
+        if not isinstance(doc, dict):
             return _json({"error": "bad json"}, 400)
         ak = doc.get("accessKey", "")
         sk = doc.get("secretKey", "")
@@ -84,7 +94,7 @@ def make_console_app(ctx) -> web.Application:
         return _json({"token": token})
 
     def _usage_summary() -> dict:
-        scanner = getattr(getattr(ctx, "node", None), "scanner", None)
+        scanner = getattr(ctx, "scanner", None)
         if scanner is not None and getattr(scanner, "usage", None) is not None:
             try:
                 return scanner.usage.summary()
@@ -129,7 +139,7 @@ def make_console_app(ctx) -> web.Application:
                         "name": b.name,
                         "created": b.created,
                         "objects": u.get("objectsCount", None),
-                        "size": u.get("objectsTotalSize", None),
+                        "size": u.get("size", None),
                     }
                 )
             return {"buckets": out}
@@ -253,7 +263,9 @@ $('#logout').onclick = out;
 $('#go').onclick = async () => {
   const r = await fetch('/mtpu/console/api/login', {method: 'POST',
     body: JSON.stringify({accessKey: $('#ak').value, secretKey: $('#sk').value})});
-  const d = await r.json();
+  let d = {};
+  try { d = await r.json(); }
+  catch { $('#lerr').textContent = 'server error (' + r.status + ')'; return; }
   if (!r.ok) { $('#lerr').textContent = d.error || 'login failed'; return; }
   tok = d.token; sessionStorage.setItem('tok', tok); boot();
 };

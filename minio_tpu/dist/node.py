@@ -398,10 +398,6 @@ class _LazyAdminContext:
         return self._node.s3 is not None
 
     @property
-    def node(self):
-        return self._node
-
-    @property
     def layer(self):
         return self._node.pools
 

@@ -30,18 +30,44 @@ from ..ops import rs, rs_matrix
 from ..parallel import mesh as mesh_lib
 
 
+_PALLAS_HASH_OK: bool | None = None
+
+
+def _pallas_hash_works() -> bool:
+    """One-time probe: the Pallas hash kernel must actually lower on this
+    backend AND match the host oracle before the serving path may select
+    it (Mosaic op support varies by release; a kernel that fails to lower
+    must degrade to the XLA scan, not crash every PutObject)."""
+    global _PALLAS_HASH_OK
+    if _PALLAS_HASH_OK is None:
+        try:
+            from ..ops import highwayhash as hh_host
+            from ..ops import highwayhash_pallas as hhp
+
+            probe = np.arange(2 * 256, dtype=np.uint8).reshape(2, 256)  # 8 packets: kernel path
+            got = np.asarray(hhp.hash256_batch(probe))
+            want = hh_host.hash256_batch(probe)
+            _PALLAS_HASH_OK = np.array_equal(got, want)
+        except Exception:  # noqa: BLE001 - any lowering/runtime failure
+            _PALLAS_HASH_OK = False
+    return _PALLAS_HASH_OK
+
+
 def hash_batch_fn():
     """The device hash implementation the pipeline serves with.
 
     MINIO_TPU_HASH = xla | pallas | auto (default). Auto picks the Pallas
     VMEM-chain kernel on real TPU (the scan version pays a while-loop
-    dispatch per packet chunk) and the XLA scan elsewhere (Pallas interpret
-    mode on CPU is far slower than compiled XLA).
+    dispatch per packet chunk) — but only after a live probe confirms it
+    lowers and matches the oracle; the XLA scan serves elsewhere (Pallas
+    interpret mode on CPU is far slower than compiled XLA).
     """
     mode = os.environ.get("MINIO_TPU_HASH", "auto").lower()
     if mode == "xla":
         return hhj.hash256_batch
-    if mode == "pallas" or jax.default_backend() in ("tpu", "axon"):
+    if mode == "pallas" or (
+        jax.default_backend() in ("tpu", "axon") and _pallas_hash_works()
+    ):
         from ..ops import highwayhash_pallas as hhp
 
         return hhp.hash256_batch
