@@ -18,6 +18,7 @@ import base64
 import contextlib
 import datetime
 import hashlib
+import re
 import secrets
 import time as _time
 import urllib.parse
@@ -989,13 +990,17 @@ class S3Server:
                     self._put_object_legal_hold, bucket, key, q, body
                 )
             if "uploadId" in q and "partNumber" in q:
+                if "x-amz-copy-source" in request.headers:
+                    # UploadPartCopy (CopyObjectPartHandler equivalent).
+                    return await asyncio.to_thread(
+                        self._upload_part_copy,
+                        bucket, key, q["uploadId"], int(q["partNumber"]), request,
+                    )
                 return await asyncio.to_thread(
                     self._upload_part, bucket, key, q["uploadId"], int(q["partNumber"]), body
                 )
             if "x-amz-copy-source" in request.headers:
-                return await asyncio.to_thread(
-                    self._copy_object, bucket, key, request.headers["x-amz-copy-source"], request
-                )
+                return await asyncio.to_thread(self._copy_object, bucket, key, request)
             return await asyncio.to_thread(self._put_object, bucket, key, body, request)
         if m == "GET" and "uploadId" in q:
             return await asyncio.to_thread(self._list_parts, bucket, key, q)
@@ -1038,6 +1043,32 @@ class S3Server:
     ) -> web.Response:
         part = self.layer.put_object_part(bucket, key, upload_id, part_number, body)
         return web.Response(status=200, headers={"ETag": f'"{part.etag}"'})
+
+    def _upload_part_copy(
+        self, bucket: str, key: str, upload_id: str, part_number: int,
+        request: web.Request,
+    ) -> web.Response:
+        """UploadPartCopy: a part sourced from an existing object, with
+        optional x-amz-copy-source-range (CopyObjectPartHandler role)."""
+        _, data = self._resolve_copy_source(request)
+        rng = request.headers.get("x-amz-copy-source-range", "")
+        if rng:
+            m = re.fullmatch(r"bytes=(\d+)-(\d+)", rng.strip())
+            if not m:
+                raise S3Error("InvalidArgument", "bad x-amz-copy-source-range")
+            lo, hi = int(m.group(1)), int(m.group(2))
+            # The whole range must lie inside the source (the reference's
+            # errInvalidRangeSource): silent truncation would assemble a
+            # short object with a 200.
+            if lo > hi or hi >= len(data):
+                raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
+            data = data[lo : hi + 1]
+        part = self.layer.put_object_part(bucket, key, upload_id, part_number, data)
+        return _xml(
+            f'<CopyPartResult xmlns="{XML_NS}">'
+            f"<LastModified>{_iso(part.mod_time)}</LastModified>"
+            f"<ETag>&quot;{part.etag}&quot;</ETag></CopyPartResult>"
+        )
 
     def _list_parts(self, bucket: str, key: str, q) -> web.Response:
         upload_id = q["uploadId"]
@@ -1323,10 +1354,16 @@ class S3Server:
         self._emit("s3:ObjectCreated:Put", bucket, oi)
         return web.Response(status=200, headers=headers)
 
-    def _copy_object(
-        self, bucket: str, key: str, source: str, request: web.Request
-    ) -> web.Response:
-        src = urllib.parse.unquote(source)
+    def _resolve_copy_source(self, request: web.Request):
+        """Fetch + precondition-check the x-amz-copy-source object.
+
+        Shared by CopyObject and UploadPartCopy; enforces the
+        x-amz-copy-source-if-{match,none-match,modified-since,
+        unmodified-since} conditions (the reference's
+        checkCopyObjectPreconditions, cmd/object-handlers-common.go)."""
+        from email.utils import parsedate_to_datetime
+
+        src = urllib.parse.unquote(request.headers["x-amz-copy-source"])
         if src.startswith("/"):
             src = src[1:]
         vid = ""
@@ -1336,6 +1373,43 @@ class S3Server:
             raise S3Error("InvalidArgument", "bad copy source")
         src_bucket, src_key = src.split("/", 1)
         src_oi, data = self.layer.get_object(src_bucket, src_key, GetObjectOptions(vid))
+
+        h = request.headers
+
+        def httpdate(name: str) -> float | None:
+            v = h.get(name)
+            if not v:
+                return None
+            try:
+                return parsedate_to_datetime(v).timestamp()
+            except (TypeError, ValueError):
+                return None
+
+        # RFC 7232 precedence: a present If-Match supersedes
+        # If-Unmodified-Since, If-None-Match supersedes If-Modified-Since.
+        # Dates compare at SECOND granularity (HTTP dates carry no
+        # fractional part; echoing an object's own Last-Modified must pass).
+        mod_s = int(src_oi.mod_time)
+        im = h.get("x-amz-copy-source-if-match")
+        if im is not None:
+            if im.strip('"') != src_oi.etag:
+                raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
+        else:
+            ius = httpdate("x-amz-copy-source-if-unmodified-since")
+            if ius is not None and mod_s > int(ius):
+                raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
+        inm = h.get("x-amz-copy-source-if-none-match")
+        if inm is not None:
+            if inm.strip('"') == src_oi.etag:
+                raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
+        else:
+            ims = httpdate("x-amz-copy-source-if-modified-since")
+            if ims is not None and mod_s <= int(ims):
+                raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
+        return src_oi, data
+
+    def _copy_object(self, bucket: str, key: str, request: web.Request) -> web.Response:
+        src_oi, data = self._resolve_copy_source(request)
         opts = self._put_opts(bucket, request, key)
         if request.headers.get("x-amz-metadata-directive", "COPY") == "COPY":
             opts.user_defined = dict(src_oi.user_defined)
@@ -1428,6 +1502,8 @@ class S3Server:
         rng = request.headers.get("Range", "")
         if rng:
             offset, length, _ = _parse_range(rng)
+            if offset < 0:  # suffix range: last N bytes
+                offset = max(len(payload) + offset, 0)
             if offset >= len(payload) or not payload:
                 raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
             end = len(payload) if length < 0 else min(offset + length, len(payload))
@@ -1552,12 +1628,17 @@ class S3Server:
                 data = self._transform_get(bucket, key, data, oi, request)
                 logical = len(data)
                 if rng:
+                    if offset < 0:  # suffix range: last N logical bytes
+                        offset = max(logical + offset, 0)
                     if offset >= logical > 0:
                         raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
                     end = logical if length < 0 else min(offset + length, logical)
                     data = data[offset:end]
                 oi.size = logical
             else:
+                if rng and offset < 0:  # suffix range: last N bytes
+                    offset = max(probe.size + offset, 0)
+                    length = probe.size - offset
                 stream_fn = getattr(self.layer, "get_object_stream", None)
                 if stream_fn is not None:
                     if rng and offset >= probe.size and probe.size > 0:
@@ -1925,8 +2006,15 @@ def _parse_range(rng: str) -> tuple[int, int, bool]:
         raise S3Error("NotImplemented", "multiple ranges")
     start_s, _, end_s = spec.partition("-")
     if start_s == "":
-        # suffix range: last N bytes -- handled by caller via negative offset
-        raise S3Error("NotImplemented", "suffix ranges")
+        # Suffix range 'bytes=-N' (last N bytes): returned as a NEGATIVE
+        # offset; callers resolve it against the object size.
+        try:
+            n = int(end_s)
+        except ValueError:
+            raise S3Error("InvalidArgument", "bad range") from None
+        if n <= 0:
+            raise S3Error("InvalidArgument", "bad range")
+        return -n, n, True
     start = int(start_s)
     if end_s == "":
         return start, -1, True

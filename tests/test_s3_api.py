@@ -266,3 +266,198 @@ class TestAuth:
         # Tampered signature fails.
         r = rq.get(url[:-4] + "0000")
         assert r.status_code == 403
+
+
+class TestCopyAndMultipartHTTP:
+    """CopyObject preconditions, metadata directives, UploadPartCopy, and
+    the full multipart flow over the wire (cmd/object-handlers_test.go and
+    CopyObjectPartHandler scenarios)."""
+
+    def test_copy_conditionals(self, client):
+        b = _fresh_bucket(client, "copycond")
+        client.put_object(b, "src", b"copy-me")
+        etag = client.head_object(b, "src").headers["ETag"].strip('"')
+
+        r = client.request("PUT", f"/{b}/dst", headers={
+            "x-amz-copy-source": f"/{b}/src",
+            "x-amz-copy-source-if-match": "deadbeef" * 4,
+        })
+        assert r.status_code == 412
+        r = client.request("PUT", f"/{b}/dst", headers={
+            "x-amz-copy-source": f"/{b}/src",
+            "x-amz-copy-source-if-none-match": etag,
+        })
+        assert r.status_code == 412
+        r = client.request("PUT", f"/{b}/dst", headers={
+            "x-amz-copy-source": f"/{b}/src",
+            "x-amz-copy-source-if-match": etag,
+        })
+        assert r.status_code == 200
+        assert client.get_object(b, "dst").content == b"copy-me"
+
+    def test_copy_unmodified_since(self, client):
+        b = _fresh_bucket(client, "copydate")
+        client.put_object(b, "src", b"dated")
+        r = client.request("PUT", f"/{b}/dst", headers={
+            "x-amz-copy-source": f"/{b}/src",
+            "x-amz-copy-source-if-unmodified-since": "Mon, 01 Jan 2001 00:00:00 GMT",
+        })
+        assert r.status_code == 412  # modified after 2001
+        r = client.request("PUT", f"/{b}/dst", headers={
+            "x-amz-copy-source": f"/{b}/src",
+            "x-amz-copy-source-if-modified-since": "Mon, 01 Jan 2001 00:00:00 GMT",
+        })
+        assert r.status_code == 200
+
+    def test_copy_metadata_directive(self, client):
+        b = _fresh_bucket(client, "copymeta")
+        client.put_object(b, "src", b"meta", headers={"x-amz-meta-color": "blue"})
+        client.request("PUT", f"/{b}/copy", headers={"x-amz-copy-source": f"/{b}/src"})
+        assert client.head_object(b, "copy").headers.get("x-amz-meta-color") == "blue"
+        client.request("PUT", f"/{b}/repl", headers={
+            "x-amz-copy-source": f"/{b}/src",
+            "x-amz-metadata-directive": "REPLACE",
+            "x-amz-meta-color": "red",
+        })
+        assert client.head_object(b, "repl").headers.get("x-amz-meta-color") == "red"
+
+    def test_multipart_flow(self, client):
+        b = _fresh_bucket(client, "mpflow")
+        r = client.request("POST", f"/{b}/big", query=[("uploads", "")])
+        assert r.status_code == 200, r.text
+        upload_id = ET.fromstring(r.text).find(f"{NS}UploadId").text
+
+        import numpy as np
+
+        part1 = np.random.default_rng(1).integers(0, 256, 5 << 20, dtype=np.uint8).tobytes()
+        part2 = b"tail-part"
+        etags = []
+        for n, body in ((1, part1), (2, part2)):
+            r = client.request(
+                "PUT", f"/{b}/big",
+                query=[("partNumber", str(n)), ("uploadId", upload_id)], body=body,
+            )
+            assert r.status_code == 200, r.text
+            etags.append(r.headers["ETag"].strip('"'))
+
+        r = client.request("GET", f"/{b}/big", query=[("uploadId", upload_id)])
+        nums = [int(e.text) for e in ET.fromstring(r.text).iter(f"{NS}PartNumber")]
+        assert nums == [1, 2]
+
+        complete = (
+            "<CompleteMultipartUpload>"
+            + "".join(
+                f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+                for n, e in zip((1, 2), etags)
+            )
+            + "</CompleteMultipartUpload>"
+        )
+        r = client.request(
+            "POST", f"/{b}/big", query=[("uploadId", upload_id)], body=complete.encode()
+        )
+        assert r.status_code == 200, r.text
+        got = client.get_object(b, "big").content
+        assert got == part1 + part2
+        # Multipart etag convention: md5-of-md5s with part count suffix.
+        assert client.head_object(b, "big").headers["ETag"].strip('"').endswith("-2")
+
+    def test_multipart_abort(self, client):
+        b = _fresh_bucket(client, "mpabort")
+        r = client.request("POST", f"/{b}/gone", query=[("uploads", "")])
+        upload_id = ET.fromstring(r.text).find(f"{NS}UploadId").text
+        client.request(
+            "PUT", f"/{b}/gone",
+            query=[("partNumber", "1"), ("uploadId", upload_id)], body=b"x" * 1000,
+        )
+        r = client.request("DELETE", f"/{b}/gone", query=[("uploadId", upload_id)])
+        assert r.status_code == 204
+        r = client.request(
+            "POST", f"/{b}/gone", query=[("uploadId", upload_id)],
+            body=b"<CompleteMultipartUpload></CompleteMultipartUpload>",
+        )
+        assert r.status_code == 404
+
+    def test_upload_part_copy(self, client):
+        b = _fresh_bucket(client, "mpcopy")
+        src = (bytes(range(256)) * (20 * 1024 + 1))[: 5 << 20]  # 5 MiB: min part size
+        client.put_object(b, "src", src)
+        r = client.request("POST", f"/{b}/assembled", query=[("uploads", "")])
+        upload_id = ET.fromstring(r.text).find(f"{NS}UploadId").text
+
+        r = client.request(
+            "PUT", f"/{b}/assembled",
+            query=[("partNumber", "1"), ("uploadId", upload_id)],
+            headers={"x-amz-copy-source": f"/{b}/src"},
+        )
+        assert r.status_code == 200, r.text
+        etag1 = ET.fromstring(r.text).find(f"{NS}ETag").text.strip('"')
+
+        r = client.request(
+            "PUT", f"/{b}/assembled",
+            query=[("partNumber", "2"), ("uploadId", upload_id)],
+            headers={
+                "x-amz-copy-source": f"/{b}/src",
+                "x-amz-copy-source-range": "bytes=0-99",
+            },
+        )
+        assert r.status_code == 200, r.text
+        etag2 = ET.fromstring(r.text).find(f"{NS}ETag").text.strip('"')
+
+        complete = (
+            "<CompleteMultipartUpload>"
+            f"<Part><PartNumber>1</PartNumber><ETag>{etag1}</ETag></Part>"
+            f"<Part><PartNumber>2</PartNumber><ETag>{etag2}</ETag></Part>"
+            "</CompleteMultipartUpload>"
+        )
+        r = client.request(
+            "POST", f"/{b}/assembled", query=[("uploadId", upload_id)],
+            body=complete.encode(),
+        )
+        assert r.status_code == 200, r.text
+        assert client.get_object(b, "assembled").content == src + src[:100]
+
+    def test_upload_part_copy_bad_range(self, client):
+        b = _fresh_bucket(client, "mpbadrange")
+        client.put_object(b, "src", b"tiny")
+        r = client.request("POST", f"/{b}/x", query=[("uploads", "")])
+        upload_id = ET.fromstring(r.text).find(f"{NS}UploadId").text
+        r = client.request(
+            "PUT", f"/{b}/x",
+            query=[("partNumber", "1"), ("uploadId", upload_id)],
+            headers={
+                "x-amz-copy-source": f"/{b}/src",
+                "x-amz-copy-source-range": "bytes=100-200",
+            },
+        )
+        assert r.status_code == 416
+
+
+class TestRangesAndTagging:
+    def test_suffix_and_invalid_ranges(self, client):
+        b = _fresh_bucket(client, "ranges")
+        data = bytes(range(256)) * 10
+        client.put_object(b, "obj", data)
+        r = client.get_object(b, "obj", headers={"Range": "bytes=-100"})
+        assert r.status_code == 206 and r.content == data[-100:]
+        r = client.get_object(b, "obj", headers={"Range": "bytes=50-59"})
+        assert r.status_code == 206 and r.content == data[50:60]
+        assert r.headers["Content-Range"] == f"bytes 50-59/{len(data)}"
+        r = client.get_object(b, "obj", headers={"Range": f"bytes={len(data) + 10}-"})
+        assert r.status_code == 416
+
+    def test_object_tagging_roundtrip(self, client):
+        b = _fresh_bucket(client, "tagb")
+        client.put_object(b, "obj", b"tagged")
+        tags = (
+            '<Tagging><TagSet><Tag><Key>env</Key><Value>prod</Value></Tag>'
+            "</TagSet></Tagging>"
+        )
+        r = client.request("PUT", f"/{b}/obj", query=[("tagging", "")], body=tags.encode())
+        assert r.status_code == 200, r.text
+        r = client.request("GET", f"/{b}/obj", query=[("tagging", "")])
+        assert "<Key>env</Key>" in r.text and "<Value>prod</Value>" in r.text
+        assert client.head_object(b, "obj").headers.get("x-amz-tagging-count") == "1"
+        r = client.request("DELETE", f"/{b}/obj", query=[("tagging", "")])
+        assert r.status_code == 204
+        r = client.request("GET", f"/{b}/obj", query=[("tagging", "")])
+        assert "<Key>" not in r.text
