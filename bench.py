@@ -215,15 +215,22 @@ def object_layer_metrics(use_device: bool) -> dict:
     return out
 
 
-def device_metrics() -> dict:
-    """Encode / hash / fused / reconstruct GiB/s on the live device."""
+def device_metrics(progress: dict | None = None) -> dict:
+    """Encode / hash / fused / reconstruct GiB/s on the live device.
+
+    Results are ALSO written into `progress` as each lands, so a watchdog
+    firing mid-run can emit the numbers already measured (first device
+    compiles can be slow; losing a measured 18x headline to a timeout in a
+    later secondary metric would be self-inflicted)."""
     import jax
     import jax.numpy as jnp
 
     from minio_tpu.ops import rs
     from minio_tpu.ops import highwayhash_jax as hhj
 
+    progress = progress if progress is not None else {}
     platform = jax.devices()[0].platform
+    progress["platform"] = platform
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, (BATCH, K, SHARD), dtype=np.uint8)
     dev = jax.device_put(jnp.asarray(data))
@@ -240,6 +247,7 @@ def device_metrics() -> dict:
         out = encode_only(dev)
     out.block_until_ready()
     enc_gibs = BATCH * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
+    progress["encode_gibs"] = enc_gibs
 
     # Hash-only throughput of both device implementations over the fused
     # batch's stream shape; the fused number below uses the winner (also
@@ -272,7 +280,10 @@ def device_metrics() -> dict:
             )
         except Exception as e:  # noqa: BLE001
             hash_errors[name] = f"{type(e).__name__}: {e}"[:300]
+        progress[f"hash_{name}_gibs"] = round(hash_gibs.get(name, 0.0), 3)
+        progress["hash_errors"] = dict(hash_errors)
     best_hash = max(hash_gibs, key=hash_gibs.get) if hash_gibs else "xla"
+    progress["fused_hash_impl"] = best_hash
     best_hash_fn = hash_impls.get(best_hash, hhj.hash256_batch)
 
     @jax.jit
@@ -292,6 +303,7 @@ def device_metrics() -> dict:
         out = recon(surv)
     out.block_until_ready()
     dec_gibs = BATCH * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
+    progress["decode_recon4_gibs"] = dec_gibs
 
     fdev = jax.device_put(jnp.asarray(data[:FUSED_BATCH]))
     jax.block_until_ready(fused(fdev))
@@ -301,6 +313,7 @@ def device_metrics() -> dict:
         r = fused(fdev)
     jax.block_until_ready(r)
     fused_gibs = FUSED_BATCH * BLOCK * fiters / (time.perf_counter() - t0) / (1 << 30)
+    progress["fused_encode_hash_gibs"] = fused_gibs
 
     # Fused Pallas kernel (ops/rs_pallas.py): VMEM-resident bit expansion.
     # Never let a Mosaic regression break the bench line — but a 0.0 must
@@ -320,6 +333,8 @@ def device_metrics() -> dict:
         pallas_gibs = BATCH * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
     except Exception as e:  # noqa: BLE001
         pallas_error = f"{type(e).__name__}: {e}"[:500]
+    progress["pallas_encode_gibs"] = pallas_gibs
+    progress["pallas_error"] = pallas_error
     return {
         "platform": platform,
         "encode_gibs": enc_gibs,
@@ -388,18 +403,42 @@ def main() -> None:
         emit(line)
         return
 
-    # Watchdog: if the in-process run wedges anyway, still print a line.
+    # Watchdog: if the in-process run wedges, emit whatever device numbers
+    # already landed (progressive `progress` dict) rather than the CPU
+    # fallback — a slow secondary compile must not erase a measured headline.
+    progress: dict = {}
+
     def on_timeout(signum, frame):
-        emit(fallback_line(cpu_enc, cpu_dec, "device run watchdog timeout"))
+        if progress.get("encode_gibs"):
+            progress.setdefault("fused_encode_hash_gibs", 0.0)
+            progress.setdefault("decode_recon4_gibs", 0.0)
+            emit(
+                device_line(
+                    progress, cpu_enc, cpu_dec,
+                    {"device_bench_error": "watchdog timeout mid-run (partial numbers)"},
+                )
+            )
+        else:
+            emit(fallback_line(cpu_enc, cpu_dec, "device run watchdog timeout"))
         os._exit(0)
 
     signal.signal(signal.SIGALRM, on_timeout)
-    signal.alarm(900)
+    signal.alarm(1200)
     try:
-        dm = device_metrics()
+        dm = device_metrics(progress)
     except Exception as e:  # noqa: BLE001 - report, never crash the driver
         signal.alarm(0)
-        emit(fallback_line(cpu_enc, cpu_dec, f"device run failed: {type(e).__name__}"))
+        if progress.get("encode_gibs"):
+            progress.setdefault("fused_encode_hash_gibs", 0.0)
+            progress.setdefault("decode_recon4_gibs", 0.0)
+            emit(
+                device_line(
+                    progress, cpu_enc, cpu_dec,
+                    {"device_bench_error": f"{type(e).__name__}: {e}"[:300]},
+                )
+            )
+        else:
+            emit(fallback_line(cpu_enc, cpu_dec, f"device run failed: {type(e).__name__}"))
         return
     finally:
         signal.alarm(0)
