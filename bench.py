@@ -159,6 +159,18 @@ def object_layer_metrics(use_device: bool) -> dict:
         out["putobject_gibs"] = round(PUT_OBJECTS * PUT_SIZE / total / (1 << 30), 3)
         out["putobject_p50_ms"] = round(statistics.median(lat) * 1000, 1)
 
+        # BASELINE primary metric geometry: PutObject p50 at 1 MiB objects
+        # (12+4 @ 1 MiB block -- one block per object, latency-bound).
+        small = body[: 1 << 20]
+        lat1 = []
+        for i in range(50):
+            t0 = time.perf_counter()
+            layer.put_object("bench", f"s-{i}", small)
+            lat1.append(time.perf_counter() - t0)
+        out["putobject_1mib_p50_ms"] = round(statistics.median(lat1) * 1000, 2)
+        for i in range(50):
+            layer.delete_object("bench", f"s-{i}")
+
         # --- 8-concurrent-PUT aggregate (batching fan-in under load) -------
         cbody = body[:CONCURRENT_SIZE]
         rounds = 4
