@@ -1,0 +1,135 @@
+"""Live-chip tuning harness for the device kernels (run when a TPU is up).
+
+Measures, on the real chip, everything bench.py summarizes -- but swept
+over the tunables so the constants in the kernels can be re-pinned:
+
+  * XLA hash chunk unroll (highwayhash_jax.CHUNK): 4..32
+  * Pallas hash tile/chunk (highwayhash_pallas.TILE_N / CHUNK_P)
+  * Pallas RS tile (rs_pallas.TILE_S)
+  * fused encode+hash with each hash impl at serving batch sizes
+
+Each configuration runs in-process; module constants are monkey-set and
+jit caches cleared per point. Prints one line per point; run under
+`timeout` -- first compiles on a cold chip are slow.
+
+    python tools/tpu_tune.py [quick|full]
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+K, M = 12, 4
+BLOCK = 1 << 20
+SHARD = -(-BLOCK // K)
+
+
+def _time(fn, arg, iters=8) -> float:
+    import jax
+
+    out = fn(arg)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(arg)
+    jax.block_until_ready(out)
+    return time.perf_counter() - t0
+
+
+def main() -> None:
+    quick = (sys.argv[1:] or ["quick"])[0] == "quick"
+    import jax
+    import jax.numpy as jnp
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    rng = np.random.default_rng(0)
+
+    # --- Pallas RS tile sweep -------------------------------------------
+    import minio_tpu.ops.rs_pallas as rp
+    from minio_tpu.ops import rs
+
+    batch = 128 if quick else 512
+    data = rng.integers(0, 256, (batch, K, SHARD), dtype=np.uint8)
+    dev = jax.device_put(jnp.asarray(data))
+    codec = rs.RSCodec(K, M)
+    dt = _time(jax.jit(codec.encode), dev)
+    print(f"xla encode: {batch * BLOCK * 8 / dt / 2**30:.2f} GiB/s")
+    for ts in (4096, 8192, 16384) if quick else (2048, 4096, 8192, 16384, 32768):
+        rp.TILE_S = ts
+        rp._apply_padded.clear_cache()
+        pcodec = rp.RSPallasCodec(K, M)
+        try:
+            ok = np.array_equal(
+                np.asarray(codec.encode(dev[:2])), np.asarray(pcodec.encode(dev[:2]))
+            )
+            dt = _time(jax.jit(pcodec.encode), dev)
+            print(f"pallas rs TILE_S={ts}: {batch * BLOCK * 8 / dt / 2**30:.2f} GiB/s exact={ok}")
+        except Exception as e:  # noqa: BLE001
+            print(f"pallas rs TILE_S={ts}: FAIL {str(e)[:120]}")
+
+    # --- hash sweeps -----------------------------------------------------
+    from minio_tpu.ops import highwayhash as hh_host
+    from minio_tpu.ops import highwayhash_jax as hhj
+
+    streams = 1024 if quick else 4096
+    hdata_np = rng.integers(0, 256, (streams, SHARD), dtype=np.uint8)
+    hdata = jax.device_put(jnp.asarray(hdata_np))
+    oracle = hh_host.hash256_batch(hdata_np[:2])
+
+    for chunk in (8, 16, 32):
+        hhj.CHUNK = chunk
+        hhj._hh256_impl.clear_cache()
+        try:
+            ok = np.array_equal(np.asarray(hhj.hash256_batch(hdata[:2])), oracle)
+            dt = _time(jax.jit(hhj.hash256_batch), hdata)
+            print(
+                f"xla hash CHUNK={chunk}: {hdata.size * 8 / dt / 2**30:.2f} GiB/s exact={ok}"
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"xla hash CHUNK={chunk}: FAIL {str(e)[:120]}")
+    hhj.CHUNK = None
+    hhj._hh256_impl.clear_cache()
+
+    import minio_tpu.ops.highwayhash_pallas as hhp
+
+    tiles = ((256, 8), (512, 8), (512, 16)) if quick else (
+        (256, 8), (512, 8), (1024, 8), (512, 16), (1024, 16), (512, 4)
+    )
+    for tile_n, chunk_p in tiles:
+        hhp.TILE_N, hhp.CHUNK_P = tile_n, chunk_p
+        hhp._run_chain.clear_cache()
+        hhp._hh256_pallas.clear_cache()
+        try:
+            ok = np.array_equal(np.asarray(hhp.hash256_batch(hdata[:2])), oracle)
+            dt = _time(jax.jit(hhp.hash256_batch), hdata)
+            print(
+                f"pallas hash TILE_N={tile_n} CHUNK_P={chunk_p}: "
+                f"{hdata.size * 8 / dt / 2**30:.2f} GiB/s exact={ok}"
+            )
+        except Exception as e:  # noqa: BLE001
+            print(f"pallas hash TILE_N={tile_n} CHUNK_P={chunk_p}: FAIL {str(e)[:150]}")
+
+    # --- fused at serving batch sizes ------------------------------------
+    from minio_tpu.models import pipeline as pipe_mod
+
+    for fb in (16, 32, 64) if quick else (16, 32, 64, 128):
+        fdata = jax.device_put(jnp.asarray(data[:fb]))
+        for impl in ("xla", "pallas"):
+            import os
+
+            os.environ["MINIO_TPU_HASH"] = impl
+            p = pipe_mod.ErasurePipeline(pipe_mod.Geometry(K, M))
+            try:
+                dt = _time(p.encode, fdata, iters=4)
+                print(f"fused B={fb} hash={impl}: {fb * BLOCK * 4 / dt / 2**30:.2f} GiB/s")
+            except Exception as e:  # noqa: BLE001
+                print(f"fused B={fb} hash={impl}: FAIL {str(e)[:120]}")
+        os.environ.pop("MINIO_TPU_HASH", None)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/tools/", 1)[0])
+    main()
