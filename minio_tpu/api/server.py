@@ -146,9 +146,18 @@ class _StreamPlan:
         self.content_length = content_length
 
 
-def _obj_xml(o: ObjectInfo) -> str:
+def _enc_key(name: str, url_encode: bool) -> str:
+    """Key/prefix encoding for list responses: S3's encoding-type=url
+    percent-encodes everything but unreserved chars and '/' (boto3 and mc
+    request it by default so control characters survive XML)."""
+    if url_encode:
+        return urllib.parse.quote(name, safe="/")
+    return escape(name)
+
+
+def _obj_xml(o: ObjectInfo, url_encode: bool = False) -> str:
     return (
-        f"<Contents><Key>{escape(o.name)}</Key>"
+        f"<Contents><Key>{_enc_key(o.name, url_encode)}</Key>"
         f"<LastModified>{_iso(o.mod_time)}</LastModified>"
         f"<ETag>&quot;{o.etag}&quot;</ETag><Size>{o.size}</Size>"
         f"<StorageClass>{o.storage_class}</StorageClass>"
@@ -785,6 +794,8 @@ class S3Server:
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
         max_keys = int(q.get("max-keys", "1000"))
+        url_enc = q.get("encoding-type") == "url"
+        enc_tag = "<EncodingType>url</EncodingType>" if url_enc else ""
         v2 = q.get("list-type") == "2"
         if v2:
             token = q.get("continuation-token", "")
@@ -792,9 +803,10 @@ class S3Server:
         else:
             marker = q.get("marker", "")
         res = self.layer.list_objects(bucket, prefix, marker, delimiter, max_keys)
-        contents = "".join(_obj_xml(o) for o in res.objects)
+        contents = "".join(_obj_xml(o, url_enc) for o in res.objects)
         prefixes = "".join(
-            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>" for p in res.prefixes
+            f"<CommonPrefixes><Prefix>{_enc_key(p, url_enc)}</Prefix></CommonPrefixes>"
+            for p in res.prefixes
         )
         if v2:
             next_token = (
@@ -805,22 +817,26 @@ class S3Server:
             )
             return _xml(
                 f'<ListBucketResult xmlns="{XML_NS}">'
-                f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
+                f"<Name>{escape(bucket)}</Name><Prefix>{_enc_key(prefix, url_enc)}</Prefix>"
                 f"<KeyCount>{len(res.objects) + len(res.prefixes)}</KeyCount>"
-                f"<MaxKeys>{max_keys}</MaxKeys><Delimiter>{escape(delimiter)}</Delimiter>"
+                f"<MaxKeys>{max_keys}</MaxKeys>"
+                f"<Delimiter>{_enc_key(delimiter, url_enc)}</Delimiter>"
+                f"{enc_tag}"
                 f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
                 f"{next_token}{contents}{prefixes}</ListBucketResult>"
             )
         next_marker = (
-            f"<NextMarker>{escape(res.next_marker)}</NextMarker>"
+            f"<NextMarker>{_enc_key(res.next_marker, url_enc)}</NextMarker>"
             if res.is_truncated and delimiter
             else ""
         )
         return _xml(
             f'<ListBucketResult xmlns="{XML_NS}">'
-            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
-            f"<Marker>{escape(q.get('marker', ''))}</Marker>"
-            f"<MaxKeys>{max_keys}</MaxKeys><Delimiter>{escape(delimiter)}</Delimiter>"
+            f"<Name>{escape(bucket)}</Name><Prefix>{_enc_key(prefix, url_enc)}</Prefix>"
+            f"<Marker>{_enc_key(q.get('marker', ''), url_enc)}</Marker>"
+            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<Delimiter>{_enc_key(delimiter, url_enc)}</Delimiter>"
+            f"{enc_tag}"
             f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
             f"{next_marker}{contents}{prefixes}</ListBucketResult>"
         )
@@ -829,6 +845,7 @@ class S3Server:
         prefix = q.get("prefix", "")
         delimiter = q.get("delimiter", "")
         max_keys = int(q.get("max-keys", "1000"))
+        url_enc = q.get("encoding-type") == "url"
         res = self.layer.list_object_versions(
             bucket,
             prefix,
@@ -842,25 +859,27 @@ class S3Server:
             vid = o.version_id or "null"
             if o.delete_marker:
                 entries.append(
-                    f"<DeleteMarker><Key>{escape(o.name)}</Key><VersionId>{vid}</VersionId>"
+                    f"<DeleteMarker><Key>{_enc_key(o.name, url_enc)}</Key><VersionId>{vid}</VersionId>"
                     f"<IsLatest>{'true' if o.is_latest else 'false'}</IsLatest>"
                     f"<LastModified>{_iso(o.mod_time)}</LastModified></DeleteMarker>"
                 )
             else:
                 entries.append(
-                    f"<Version><Key>{escape(o.name)}</Key><VersionId>{vid}</VersionId>"
+                    f"<Version><Key>{_enc_key(o.name, url_enc)}</Key><VersionId>{vid}</VersionId>"
                     f"<IsLatest>{'true' if o.is_latest else 'false'}</IsLatest>"
                     f"<LastModified>{_iso(o.mod_time)}</LastModified>"
                     f"<ETag>&quot;{o.etag}&quot;</ETag><Size>{o.size}</Size>"
                     f"<StorageClass>{o.storage_class}</StorageClass></Version>"
                 )
         prefixes = "".join(
-            f"<CommonPrefixes><Prefix>{escape(p)}</Prefix></CommonPrefixes>" for p in res.prefixes
+            f"<CommonPrefixes><Prefix>{_enc_key(p, url_enc)}</Prefix></CommonPrefixes>"
+            for p in res.prefixes
         )
+        enc_tag = "<EncodingType>url</EncodingType>" if url_enc else ""
         return _xml(
             f'<ListVersionsResult xmlns="{XML_NS}">'
-            f"<Name>{escape(bucket)}</Name><Prefix>{escape(prefix)}</Prefix>"
-            f"<MaxKeys>{max_keys}</MaxKeys>"
+            f"<Name>{escape(bucket)}</Name><Prefix>{_enc_key(prefix, url_enc)}</Prefix>"
+            f"<MaxKeys>{max_keys}</MaxKeys>{enc_tag}"
             f"<IsTruncated>{'true' if res.is_truncated else 'false'}</IsTruncated>"
             f"{''.join(entries)}{prefixes}</ListVersionsResult>"
         )

@@ -461,3 +461,30 @@ class TestRangesAndTagging:
         assert r.status_code == 204
         r = client.request("GET", f"/{b}/obj", query=[("tagging", "")])
         assert "<Key>" not in r.text
+
+
+class TestEncodingType:
+    def test_url_encoding_type(self, client):
+        b = _fresh_bucket(client, "encb")
+        weird = "dir/sp ace+plus#hash.txt"
+        client.put_object(b, weird, b"x")
+        r = client.request("GET", f"/{b}", query=[("encoding-type", "url"), ("list-type", "2")])
+        assert r.status_code == 200
+        assert "<EncodingType>url</EncodingType>" in r.text
+        import urllib.parse
+
+        assert f"<Key>{urllib.parse.quote(weird, safe='/')}</Key>" in r.text
+        # Without encoding-type the raw (xml-escaped) key is returned.
+        r = client.request("GET", f"/{b}")
+        assert "<Key>dir/sp ace+plus#hash.txt</Key>" in r.text
+
+    def test_url_encoding_versions(self, client):
+        b = _fresh_bucket(client, "encvb")
+        weird = "v dir/a+b.txt"
+        client.put_object(b, weird, b"x")
+        r = client.request("GET", f"/{b}", query=[("versions", ""), ("encoding-type", "url")])
+        assert r.status_code == 200
+        assert "<EncodingType>url</EncodingType>" in r.text
+        import urllib.parse
+
+        assert f"<Key>{urllib.parse.quote(weird, safe='/')}</Key>" in r.text
