@@ -53,6 +53,14 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
             raise errors.DiskNotFound(dpath)
         return d
 
+    def error_resp(e: Exception) -> web.Response:
+        """Typed error transport: exception class name rides a header."""
+        return web.Response(
+            status=500 if not isinstance(e, errors.StorageError) else 400,
+            headers={ERROR_HEADER: error_to_name(e)},
+            text=str(e),
+        )
+
     def handler(fn):
         async def wrapped(request: web.Request):
             import asyncio
@@ -70,11 +78,7 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
             except web.HTTPException:
                 raise
             except Exception as e:  # noqa: BLE001 - typed error transport
-                return web.Response(
-                    status=500 if not isinstance(e, errors.StorageError) else 400,
-                    headers={ERROR_HEADER: error_to_name(e)},
-                    text=str(e),
-                )
+                return error_resp(e)
 
         return wrapped
 
@@ -178,6 +182,52 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
     def h_verify_file(d, request, body):
         a = args(request, body)
         d.verify_file(a["volume"], a["path"], _fi_unpack(a["fi"]))
+
+    async def h_walk_stream(request: web.Request):
+        """Streaming WalkDir: msgpack-framed [name, raw] entries flow as the
+        walk produces them (the reference's metacache-walk.go:62 streaming
+        discipline) instead of one buffered body per listing -- a 100K-entry
+        remote listing stays O(batch) in memory at both ends. The FIRST
+        batch is pulled before headers go out, so lazy-generator errors
+        (VolumeNotFound on a missing bucket) still take the typed-error
+        path rather than aborting a started chunked response."""
+        import asyncio
+
+        def next_batch(it):
+            out = []
+            for _ in range(256):
+                try:
+                    out.append(next(it))
+                except StopIteration:
+                    break
+            return out
+
+        try:
+            drive = get_drive(request)
+            body = await request.read()
+            a = args(request, body)
+            it = drive.walk_dir(a["volume"], a.get("base", ""), bool(a.get("recursive", True)))
+            first = await asyncio.to_thread(next_batch, it)
+        except web.HTTPException:
+            raise
+        except Exception as e:  # noqa: BLE001 - typed error transport
+            return error_resp(e)
+
+        resp = web.StreamResponse()
+        resp.content_type = "application/x-msgpack"
+        await resp.prepare(request)
+        batch = first
+        while batch:
+            await resp.write(
+                b"".join(msgpack.packb([n, r], use_bin_type=True) for n, r in batch)
+            )
+            if len(batch) < 256:
+                break
+            batch = await asyncio.to_thread(next_batch, it)
+        await resp.write_eof()
+        return resp
+
+    app.router.add_post("/walkdirstream", h_walk_stream)
 
     for name, fn in {
         "diskinfo": h_disk_info,
@@ -346,10 +396,26 @@ class RemoteDrive(StorageAPI):
         return self._call("listdir", {"volume": volume, "path": path})
 
     def walk_dir(self, volume: str, base: str = "", recursive: bool = True):
-        for name, raw in self._call(
-            "walkdir", {"volume": volume, "base": base, "recursive": recursive}
-        ):
-            yield name, raw
+        """Streaming remote walk: entries decode incrementally from the
+        chunked response (storage-rest-client WalkDir role). Typed errors
+        (VolumeNotFound etc.) surface before the stream starts; transport
+        failures mid-stream re-raise as the typed wire error."""
+        url = f"/walkdirstream?disk={urllib.parse.quote(self.drive_path, safe='')}"
+        resp = self.client.call(
+            url,
+            {"volume": volume, "base": base, "recursive": recursive},
+            stream=True,
+        )
+        unpacker = msgpack.Unpacker(raw=False, max_buffer_size=1 << 30)
+        try:
+            with self.client.stream_guard():
+                for chunk in resp.iter_content(chunk_size=1 << 16):
+                    if chunk:
+                        unpacker.feed(chunk)
+                        for name, raw in unpacker:
+                            yield name, raw
+        finally:
+            resp.close()
 
     # integrity
     def verify_file(self, volume: str, path: str, fi: FileInfo) -> None:

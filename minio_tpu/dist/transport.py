@@ -81,9 +81,11 @@ class RestClient:
         body: bytes | None = None,
         raw_response: bool = False,
         timeout: float | None = None,
+        stream: bool = False,
     ):
         """POST base/path. args -> msgpack body (or query when body given).
-        Returns msgpack-decoded object, or raw bytes if raw_response."""
+        Returns the msgpack-decoded object, raw bytes if raw_response, or
+        the live response when stream=True (caller iterates + closes)."""
         url = self.base_url + path
         try:
             if body is not None:
@@ -92,6 +94,7 @@ class RestClient:
                     params={k: str(v) for k, v in (args or {}).items()},
                     data=body,
                     timeout=timeout or self.timeout,
+                    stream=stream,
                 )
             else:
                 r = self.session.post(
@@ -99,6 +102,7 @@ class RestClient:
                     data=msgpack.packb(args or {}, use_bin_type=True),
                     headers={"Content-Type": "application/x-msgpack"},
                     timeout=timeout or self.timeout,
+                    stream=stream,
                 )
         except requests.RequestException as e:
             self._mark(False)
@@ -106,9 +110,33 @@ class RestClient:
         self._mark(True)
         if r.status_code != 200:
             name = r.headers.get(ERROR_HEADER, "StorageError")
-            raise name_to_error(name, r.text[:200])
+            text = r.text[:200]
+            r.close()
+            raise name_to_error(name, text)
+        if stream:
+            return r
         if raw_response:
             return r.content
         if not r.content:
             return None
         return msgpack.unpackb(r.content, raw=False, strict_map_key=False)
+
+    def stream_guard(self):
+        """Context for consuming a streamed response body: translates
+        transport failures into the typed wire error and marks the peer
+        offline, matching call()'s contract."""
+        return _StreamGuard(self)
+
+
+class _StreamGuard:
+    def __init__(self, client: "RestClient"):
+        self._client = client
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None and isinstance(exc, requests.RequestException):
+            self._client._mark(False)
+            raise errors.DiskNotFound(f"stream aborted: {exc}") from exc
+        return False

@@ -255,3 +255,27 @@ class TestMultiPool:
         a = expand_ellipses("/data/p0/disk{1...4}")
         b = expand_ellipses("/data/p1/disk{1...4}")
         assert len(a) == 4 and len(b) == 4 and not set(a) & set(b)
+
+
+class TestWalkStream:
+    def test_remote_walk_streams(self, cluster):
+        """Remote WalkDir rides the streaming endpoint (metacache-walk.go
+        streaming discipline), entries identical to the buffered path."""
+        c0 = cluster["clients"][0]
+        c0.make_bucket("walkb")
+        for i in range(25):
+            c0.put_object("walkb", f"w/obj-{i:02d}", b"x")
+        node0 = cluster["nodes"][0]
+        remote = next(d for d in node0.drives if isinstance(d, RemoteDrive))
+
+        streamed = list(remote.walk_dir("walkb"))
+        assert [n for n, _ in streamed] == [f"w/obj-{i:02d}" for i in range(25)]
+        buffered = list(
+            remote._call("walkdir", {"volume": "walkb", "base": "", "recursive": True})
+        )
+        assert [[n, r] for n, r in streamed] == buffered
+
+        # Typed errors surface BEFORE the stream starts (lazy-generator
+        # VolumeNotFound must not become a mid-stream connection abort).
+        with pytest.raises(errors.VolumeNotFound):
+            list(remote.walk_dir("no-such-bucket-walk"))
