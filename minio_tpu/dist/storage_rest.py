@@ -11,6 +11,7 @@ and server here).
 
 from __future__ import annotations
 
+import itertools
 import urllib.parse
 
 import msgpack
@@ -21,7 +22,7 @@ from ..storage.local import LocalDrive
 from ..storage.types import DiskInfo, FileInfo, VolInfo
 from ..storage.xlmeta import XLMeta
 from ..utils import errors
-from .transport import ERROR_HEADER, TOKEN_HEADER, RestClient, error_to_name
+from .transport import ERROR_HEADER, TOKEN_HEADER, RestClient, error_to_name, name_to_error
 
 PREFIX = "/mtpu/storage/v1"
 
@@ -194,13 +195,7 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
         import asyncio
 
         def next_batch(it):
-            out = []
-            for _ in range(256):
-                try:
-                    out.append(next(it))
-                except StopIteration:
-                    break
-            return out
+            return list(itertools.islice(it, 256))
 
         try:
             drive = get_drive(request)
@@ -217,13 +212,27 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
         resp.content_type = "application/x-msgpack"
         await resp.prepare(request)
         batch = first
-        while batch:
+        try:
+            while batch:
+                await resp.write(
+                    b"".join(msgpack.packb([n, r], use_bin_type=True) for n, r in batch)
+                )
+                if len(batch) < 256:
+                    break
+                batch = await asyncio.to_thread(next_batch, it)
+        except (ConnectionError, asyncio.CancelledError):
+            raise  # client went away: nothing to tell it
+        except Exception as e:  # noqa: BLE001
+            # Headers already went out: carry the typed error IN-BAND as a
+            # dict frame (list frames are entries). Silent truncation would
+            # make an incomplete listing look complete; a bare connection
+            # abort would read as a dead peer instead of a storage error.
             await resp.write(
-                b"".join(msgpack.packb([n, r], use_bin_type=True) for n, r in batch)
+                msgpack.packb(
+                    {"__error__": error_to_name(e), "msg": str(e)[:200]},
+                    use_bin_type=True,
+                )
             )
-            if len(batch) < 256:
-                break
-            batch = await asyncio.to_thread(next_batch, it)
         await resp.write_eof()
         return resp
 
@@ -410,10 +419,16 @@ class RemoteDrive(StorageAPI):
         try:
             with self.client.stream_guard():
                 for chunk in resp.iter_content(chunk_size=1 << 16):
-                    if chunk:
-                        unpacker.feed(chunk)
-                        for name, raw in unpacker:
-                            yield name, raw
+                    if not chunk:
+                        continue
+                    unpacker.feed(chunk)
+                    for item in unpacker:
+                        if isinstance(item, dict):  # in-band typed error frame
+                            raise name_to_error(
+                                item.get("__error__", "StorageError"), item.get("msg", "")
+                            )
+                        name, raw = item
+                        yield name, raw
         finally:
             resp.close()
 

@@ -230,3 +230,13 @@ class TestSTS:
         assert temp.get_object("stsdata", "k").content == b"v"
         # Session policy narrows root: no bucket creation.
         assert temp.make_bucket("other-bkt").status_code == 403
+
+    def test_speedtest_autotune(self, srv):
+        r = srv["client"].request(
+            "POST", f"{ADMIN}/speedtest", body=b'{"size": 4096, "autotune": true}'
+        )
+        assert r.status_code == 200, r.text
+        doc = r.json()
+        assert doc["putSpeedBytesPerSec"] > 0 and doc["getSpeedBytesPerSec"] > 0
+        assert doc["concurrency"] >= 4
+        assert len(doc["ramp"]) >= 1
