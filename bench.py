@@ -144,9 +144,13 @@ def object_layer_metrics(use_device: bool) -> dict:
 
         rng = np.random.default_rng(3)
         body = rng.integers(0, 256, PUT_SIZE, dtype=np.uint8).tobytes()
-        # Warm the jit/codec path off the clock.
-        layer.put_object("bench", "warm", body[: 4 << 20])
+        # Warm the jit/codec paths off the clock: a 17 MiB put covers the
+        # full GROUP_BLOCKS bucket and the tail path, a 1 MiB put covers the
+        # single-block bucket used by the latency probe.
+        layer.put_object("bench", "warm", body[: 17 << 20])
+        layer.put_object("bench", "warm1", body[: 1 << 20])
         layer.delete_object("bench", "warm")
+        layer.delete_object("bench", "warm1")
 
         # --- BASELINE #4: serial PutObject (GiB/s + p50 latency) -----------
         lat = []
