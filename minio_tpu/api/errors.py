@@ -42,6 +42,7 @@ ERRORS = {
     "InvalidPart": APIError("InvalidPart", "One or more of the specified parts could not be found.", 400),
     "InvalidPartOrder": APIError("InvalidPartOrder", "The list of parts was not in ascending order.", 400),
     "InvalidRange": APIError("InvalidRange", "The requested range is not satisfiable.", 416),
+    "InvalidPartNumber": APIError("InvalidPartNumber", "The requested partnumber is not satisfiable.", 416),
     "InvalidRequest": APIError("InvalidRequest", "Invalid Request.", 400),
     "KeyTooLongError": APIError("KeyTooLongError", "Your key is too long.", 400),
     "MalformedXML": APIError("MalformedXML", "The XML you provided was not well-formed or did not validate against our published schema.", 400),

@@ -1623,17 +1623,58 @@ class S3Server:
             vid = ""
         opts = GetObjectOptions(version_id=vid)
         rng = request.headers.get("Range", "")
+        part_q = request.rel_url.query.get("partNumber", "")
+
+        def part_window(oi) -> tuple[int, int, int]:
+            """(offset, length, parts_count) of ?partNumber=N (GET/HEAD part
+            reads, the reference's opts.PartNumber path). Stored part sizes
+            only equal logical bytes for untransformed objects; transformed
+            and tiered payloads reject the parameter."""
+            if rng:
+                raise S3Error("InvalidArgument", "partNumber cannot combine with Range")
+            try:
+                pn = int(part_q)
+            except ValueError:
+                raise S3Error("InvalidArgument", "bad partNumber") from None
+            if self._is_transformed(oi) or (
+                self.tiering is not None and tiering_mod.is_transitioned(oi.internal)
+            ):
+                raise S3Error("NotImplemented", "partNumber on transformed object")
+            parts = oi.parts or []
+            if not parts:
+                # Layers without stored part records (FS/NAS gateway
+                # concatenate on complete): the object is one part.
+                if pn != 1:
+                    raise S3Error("InvalidPartNumber", resource=f"/{bucket}/{key}")
+                return 0, oi.size, 1
+            idx = next((i for i, p in enumerate(parts) if p.number == pn), None)
+            if idx is None:
+                raise S3Error("InvalidPartNumber", resource=f"/{bucket}/{key}")
+            return sum(p.size for p in parts[:idx]), parts[idx].size, len(parts)
+
         try:
             if head:
                 oi = self.layer.get_object_info(bucket, key, opts)
                 headers = self._object_headers(oi)
                 headers.update(self._sse_response_headers(oi))
+                if part_q:
+                    p_off, p_len, n_parts = part_window(oi)
+                    headers["Content-Length"] = str(p_len)
+                    headers["x-amz-mp-parts-count"] = str(n_parts)
+                    if p_len == 0:  # a 206 byte-range cannot describe 0 bytes
+                        return web.Response(status=200, headers=headers)
+                    headers["Content-Range"] = f"bytes {p_off}-{p_off + p_len - 1}/{oi.size}"
+                    return web.Response(status=206, headers=headers)
                 headers["Content-Length"] = str(self._logical_size(oi))
                 return web.Response(status=200, headers=headers)
             offset, length = 0, -1
             if rng:
                 offset, length, total_needed = _parse_range(rng)
             probe = self.layer.get_object_info(bucket, key, opts)
+            if part_q:
+                offset, length, n_parts = part_window(probe)
+                if length > 0:  # empty part: plain 200, no byte-range
+                    rng = f"part={part_q}"  # range semantics: 206 + Content-Range
             tiered = self.tiering is not None and tiering_mod.is_transitioned(probe.internal)
             if tiered or self._is_transformed(probe):
                 # Tiered and/or transformed payloads: fetch whole (from the
@@ -1662,8 +1703,10 @@ class S3Server:
                 if stream_fn is not None:
                     if rng and offset >= probe.size and probe.size > 0:
                         raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
+                    extra = {"x-amz-mp-parts-count": str(n_parts)} if part_q else None
                     return self._plan_stream(
-                        stream_fn, bucket, key, opts, request, rng, offset, length
+                        stream_fn, bucket, key, opts, request, rng, offset, length,
+                        extra_headers=extra,
                     )
                 oi, data = self.layer.get_object(bucket, key, opts, offset=offset, length=length)
             if rng and offset >= oi.size and oi.size > 0:
@@ -1689,7 +1732,8 @@ class S3Server:
             return web.Response(status=405, headers={"x-amz-delete-marker": "true"})
 
     def _plan_stream(
-        self, stream_fn, bucket, key, opts, request, rng, offset, length
+        self, stream_fn, bucket, key, opts, request, rng, offset, length,
+        extra_headers: dict | None = None,
     ) -> "web.Response | _StreamPlan":
         """Build the streaming GET plan: decoded blocks flow to the socket
         without materializing the object (the reference's writeDataBlocks ->
@@ -1703,6 +1747,8 @@ class S3Server:
             raise S3Error("PreconditionFailed", resource=f"/{bucket}/{key}")
         headers = self._object_headers(oi)
         headers.update(self._sse_response_headers(oi))
+        if extra_headers:
+            headers.update(extra_headers)
         end = oi.size if length < 0 else min(offset + length, oi.size)
         content_length = max(end - offset, 0)
         status = 200

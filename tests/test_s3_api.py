@@ -488,3 +488,62 @@ class TestEncodingType:
         import urllib.parse
 
         assert f"<Key>{urllib.parse.quote(weird, safe='/')}</Key>" in r.text
+
+
+class TestPartNumberGet:
+    def test_get_by_part_number(self, client):
+        b = _fresh_bucket(client, "pnget")
+        r = client.request("POST", f"/{b}/mp", query=[("uploads", "")])
+        uid = ET.fromstring(r.text).find(f"{NS}UploadId").text
+        import numpy as np
+
+        p1 = np.random.default_rng(5).integers(0, 256, 5 << 20, dtype=np.uint8).tobytes()
+        p2 = b"secondpart" * 100
+        etags = []
+        for n, body in ((1, p1), (2, p2)):
+            r = client.request(
+                "PUT", f"/{b}/mp", query=[("partNumber", str(n)), ("uploadId", uid)], body=body
+            )
+            etags.append(r.headers["ETag"].strip('"'))
+        complete = (
+            "<CompleteMultipartUpload>"
+            + "".join(
+                f"<Part><PartNumber>{n}</PartNumber><ETag>{e}</ETag></Part>"
+                for n, e in zip((1, 2), etags)
+            )
+            + "</CompleteMultipartUpload>"
+        )
+        assert client.request(
+            "POST", f"/{b}/mp", query=[("uploadId", uid)], body=complete.encode()
+        ).status_code == 200
+
+        r = client.get_object(b, "mp", query=[("partNumber", "2")])
+        assert r.status_code == 206, r.text
+        assert r.content == p2
+        assert r.headers["x-amz-mp-parts-count"] == "2"
+        assert r.headers["Content-Range"].startswith(f"bytes {len(p1)}-")
+
+        r = client.request("HEAD", f"/{b}/mp", query=[("partNumber", "1")])
+        assert r.status_code == 206
+        assert int(r.headers["Content-Length"]) == len(p1)
+        assert r.headers["x-amz-mp-parts-count"] == "2"
+
+        r = client.get_object(b, "mp", query=[("partNumber", "9")])
+        assert r.status_code == 416
+
+    def test_part_number_on_simple_object(self, client):
+        b = _fresh_bucket(client, "pnsimple")
+        client.put_object(b, "one", b"x" * 200_000)
+        r = client.get_object(b, "one", query=[("partNumber", "1")])
+        assert r.status_code == 206
+        assert len(r.content) == 200_000
+        assert r.headers["x-amz-mp-parts-count"] == "1"
+
+    def test_part_number_empty_object(self, client):
+        b = _fresh_bucket(client, "pnempty")
+        client.put_object(b, "empty", b"")
+        r = client.get_object(b, "empty", query=[("partNumber", "1")])
+        assert r.status_code == 200 and r.content == b""
+        r = client.request("HEAD", f"/{b}/empty", query=[("partNumber", "1")])
+        assert r.status_code == 200
+        assert "Content-Range" not in r.headers
