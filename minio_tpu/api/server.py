@@ -146,6 +146,52 @@ class _StreamPlan:
         self.content_length = content_length
 
 
+def _rfc7232_outcome(
+    headers, etag: str, mod_time: float, prefix: str = ""
+) -> str | None:
+    """Evaluate RFC 7232 preconditions: returns "match_failed" (-> 412),
+    "not_modified" (-> 304 on GET/HEAD, 412 on copy), or None.
+
+    Section 6 order: If-Match first (supersedes If-Unmodified-Since), then
+    If-None-Match (supersedes If-Modified-Since). HTTP dates compare at
+    second granularity. `prefix` selects the x-amz-copy-source-if-* family.
+    """
+    from email.utils import parsedate_to_datetime
+
+    def httpdate(name: str) -> float | None:
+        v = headers.get(name)
+        if not v:
+            return None
+        try:
+            return parsedate_to_datetime(v).timestamp()
+        except (TypeError, ValueError):
+            return None
+
+    def hdr(name: str) -> str | None:
+        return headers.get(prefix + name if prefix else name)
+
+    mod_s = int(mod_time)
+    im = hdr("If-Match" if not prefix else "match")
+    if im is not None:
+        if im.strip('"') != etag and im.strip() != "*":
+            return "match_failed"
+    else:
+        ius_name = (prefix + "unmodified-since") if prefix else "If-Unmodified-Since"
+        ius = httpdate(ius_name)
+        if ius is not None and mod_s > int(ius):
+            return "match_failed"
+    inm = hdr("If-None-Match" if not prefix else "none-match")
+    if inm is not None:
+        if inm.strip('"') == etag or inm.strip() == "*":
+            return "not_modified"
+    else:
+        ims_name = (prefix + "modified-since") if prefix else "If-Modified-Since"
+        ims = httpdate(ims_name)
+        if ims is not None and mod_s <= int(ims):
+            return "not_modified"
+    return None
+
+
 def _enc_key(name: str, url_encode: bool) -> str:
     """Key/prefix encoding for list responses: S3's encoding-type=url
     percent-encodes everything but unreserved chars and '/' (boto3 and mc
@@ -183,6 +229,14 @@ class S3Server:
         self.config = config
         self.bucket_meta = BucketMetadataSys(layer)
         self.verifier = SigV4Verifier(iam.lookup, region, check_skew)
+        import os as _os
+
+        self._cors_allow = _os.environ.get("MINIO_API_CORS_ALLOW_ORIGIN", "*")
+        self._cors_set = (
+            None
+            if self._cors_allow == "*"
+            else {a.strip() for a in self._cors_allow.split(",")}
+        )
         self.app = web.Application(client_max_size=MAX_OBJECT_SIZE)
         self.app.router.add_route("*", "/{tail:.*}", self._entry)
         # Hooks filled in by the control plane (events, metrics, trace).
@@ -196,6 +250,38 @@ class S3Server:
         self.tiering = None  # TierConfigMgr (tier.go / bucket-lifecycle.go role)
 
     # -- plumbing -------------------------------------------------------------
+
+    def _conditional_response(
+        self, request: web.Request, oi, bucket: str, key: str
+    ) -> web.Response | None:
+        """RFC 7232 conditionals for GET/HEAD: the 304 response when a
+        cache precondition holds, a 412 raise on failed match, else None."""
+        outcome = _rfc7232_outcome(request.headers, oi.etag, oi.mod_time)
+        if outcome == "match_failed":
+            raise S3Error("PreconditionFailed", resource=f"/{bucket}/{key}")
+        if outcome == "not_modified":
+            return web.Response(status=304, headers={"ETag": f'"{oi.etag}"'})
+        return None
+
+    # CORS (the reference's generic-handlers.go CorsHandler): permissive by
+    # default, restrictable via MINIO_API_CORS_ALLOW_ORIGIN (comma list).
+    def _cors_origin(self, request: web.Request) -> str | None:
+        origin = request.headers.get("Origin", "")
+        if not origin:
+            return None
+        if self._cors_set is None:
+            return "*"
+        return origin if origin in self._cors_set else None
+
+    def _cors_headers(self, request: web.Request) -> dict[str, str]:
+        origin = self._cors_origin(request)
+        if origin is None:
+            return {}
+        return {
+            "Access-Control-Allow-Origin": origin,
+            "Access-Control-Expose-Headers": "ETag, x-amz-request-id, x-amz-version-id",
+            "Vary": "Origin",
+        }
 
     async def _entry(self, request: web.Request) -> web.Response:
         request_id = secrets.token_hex(8).upper()
@@ -215,6 +301,8 @@ class S3Server:
         duration = _time.perf_counter() - t0
         if not resp.prepared:  # streamed responses already sent their headers
             resp.headers["x-amz-request-id"] = request_id
+            for hk, hv in self._cors_headers(request).items():
+                resp.headers.setdefault(hk, hv)
             resp.headers.setdefault("Server", "MinIO-TPU")
         if self.metrics is not None:
             self.metrics.record_http(request.method, resp.status)
@@ -373,6 +461,24 @@ class S3Server:
         raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
 
     async def _dispatch(self, request: web.Request, request_id: str) -> web.Response:
+        if request.method == "OPTIONS":
+            # CORS preflight (generic-handlers CorsHandler role): anonymous
+            # by design, but instrumented like every other request.
+            origin = self._cors_origin(request)
+            if origin is None:
+                return web.Response(status=403)
+            return web.Response(
+                status=200,
+                headers={
+                    "Access-Control-Allow-Origin": origin,
+                    "Access-Control-Allow-Methods": "GET, PUT, POST, DELETE, HEAD",
+                    "Access-Control-Allow-Headers": request.headers.get(
+                        "Access-Control-Request-Headers", "*"
+                    ),
+                    "Access-Control-Max-Age": "3600",
+                    "Vary": "Origin",
+                },
+            )
         if request.path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node"):
             if self.metrics is None:
                 raise S3Error("NotImplemented")
@@ -1380,8 +1486,6 @@ class S3Server:
         x-amz-copy-source-if-{match,none-match,modified-since,
         unmodified-since} conditions (the reference's
         checkCopyObjectPreconditions, cmd/object-handlers-common.go)."""
-        from email.utils import parsedate_to_datetime
-
         src = urllib.parse.unquote(request.headers["x-amz-copy-source"])
         if src.startswith("/"):
             src = src[1:]
@@ -1394,37 +1498,12 @@ class S3Server:
         src_oi, data = self.layer.get_object(src_bucket, src_key, GetObjectOptions(vid))
 
         h = request.headers
-
-        def httpdate(name: str) -> float | None:
-            v = h.get(name)
-            if not v:
-                return None
-            try:
-                return parsedate_to_datetime(v).timestamp()
-            except (TypeError, ValueError):
-                return None
-
-        # RFC 7232 precedence: a present If-Match supersedes
-        # If-Unmodified-Since, If-None-Match supersedes If-Modified-Since.
-        # Dates compare at SECOND granularity (HTTP dates carry no
-        # fractional part; echoing an object's own Last-Modified must pass).
-        mod_s = int(src_oi.mod_time)
-        im = h.get("x-amz-copy-source-if-match")
-        if im is not None:
-            if im.strip('"') != src_oi.etag:
-                raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
-        else:
-            ius = httpdate("x-amz-copy-source-if-unmodified-since")
-            if ius is not None and mod_s > int(ius):
-                raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
-        inm = h.get("x-amz-copy-source-if-none-match")
-        if inm is not None:
-            if inm.strip('"') == src_oi.etag:
-                raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
-        else:
-            ims = httpdate("x-amz-copy-source-if-modified-since")
-            if ims is not None and mod_s <= int(ims):
-                raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
+        # Copy preconditions: BOTH outcomes are 412 on CopyObject (there is
+        # no 304 for copies).
+        if _rfc7232_outcome(
+            h, src_oi.etag, src_oi.mod_time, prefix="x-amz-copy-source-if-"
+        ) is not None:
+            raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
         return src_oi, data
 
     def _copy_object(self, bucket: str, key: str, request: web.Request) -> web.Response:
@@ -1655,6 +1734,9 @@ class S3Server:
         try:
             if head:
                 oi = self.layer.get_object_info(bucket, key, opts)
+                cond = self._conditional_response(request, oi, bucket, key)
+                if cond is not None:
+                    return cond
                 headers = self._object_headers(oi)
                 headers.update(self._sse_response_headers(oi))
                 if part_q:
@@ -1671,6 +1753,9 @@ class S3Server:
             if rng:
                 offset, length, total_needed = _parse_range(rng)
             probe = self.layer.get_object_info(bucket, key, opts)
+            cond = self._conditional_response(request, probe, bucket, key)
+            if cond is not None:
+                return cond  # before any data IO / tier recall / transform
             if part_q:
                 offset, length, n_parts = part_window(probe)
                 if length > 0:  # empty part: plain 200, no byte-range
@@ -1719,13 +1804,6 @@ class S3Server:
                 end = offset + len(data) - 1
                 headers["Content-Range"] = f"bytes {offset}-{end}/{total}"
                 status = 206
-            # Conditional requests.
-            inm = request.headers.get("If-None-Match", "")
-            if inm and inm.strip('"') == oi.etag:
-                return web.Response(status=304, headers={"ETag": f'"{oi.etag}"'})
-            im = request.headers.get("If-Match", "")
-            if im and im.strip('"') != oi.etag:
-                raise S3Error("PreconditionFailed", resource=f"/{bucket}/{key}")
             return web.Response(status=status, body=data, headers=headers)
         except oerr.MethodNotAllowed:
             # GET on a delete marker by version id.
@@ -1739,12 +1817,6 @@ class S3Server:
         without materializing the object (the reference's writeDataBlocks ->
         ResponseWriter path, erasure-decode.go:206)."""
         oi, it = stream_fn(bucket, key, opts, offset=offset, length=length)
-        inm = request.headers.get("If-None-Match", "")
-        if inm and inm.strip('"') == oi.etag:
-            return web.Response(status=304, headers={"ETag": f'"{oi.etag}"'})
-        im = request.headers.get("If-Match", "")
-        if im and im.strip('"') != oi.etag:
-            raise S3Error("PreconditionFailed", resource=f"/{bucket}/{key}")
         headers = self._object_headers(oi)
         headers.update(self._sse_response_headers(oi))
         if extra_headers:
@@ -1759,6 +1831,10 @@ class S3Server:
 
     async def _send_stream(self, request: web.Request, plan: _StreamPlan) -> web.StreamResponse:
         resp = web.StreamResponse(status=plan.status, headers=plan.headers)
+        # Streamed responses send headers at prepare(): the post-dispatch
+        # header pass in _entry can't touch them, so CORS rides here.
+        for hk, hv in self._cors_headers(request).items():
+            resp.headers.setdefault(hk, hv)
         resp.content_length = plan.content_length
         await resp.prepare(request)
         it = plan.iterator

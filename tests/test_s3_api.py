@@ -547,3 +547,43 @@ class TestPartNumberGet:
         r = client.request("HEAD", f"/{b}/empty", query=[("partNumber", "1")])
         assert r.status_code == 200
         assert "Content-Range" not in r.headers
+
+
+class TestDateConditionalsAndCors:
+    def test_modified_since_conditionals(self, client):
+        b = _fresh_bucket(client, "dcond")
+        client.put_object(b, "k", b"dated")
+        lm = client.head_object(b, "k").headers["Last-Modified"]
+        r = client.get_object(b, "k", headers={"If-Modified-Since": lm})
+        assert r.status_code == 304
+        r = client.get_object(b, "k", headers={"If-Modified-Since": "Mon, 01 Jan 2001 00:00:00 GMT"})
+        assert r.status_code == 200
+        r = client.get_object(b, "k", headers={"If-Unmodified-Since": lm})
+        assert r.status_code == 200
+        r = client.get_object(b, "k", headers={"If-Unmodified-Since": "Mon, 01 Jan 2001 00:00:00 GMT"})
+        assert r.status_code == 412
+        # If-None-Match supersedes If-Modified-Since.
+        r = client.get_object(
+            b, "k", headers={"If-None-Match": '"nomatch"', "If-Modified-Since": lm}
+        )
+        assert r.status_code == 200
+        # HEAD honors the same conditionals.
+        r = client.request("HEAD", f"/{b}/k", headers={"If-Modified-Since": lm})
+        assert r.status_code == 304
+
+    def test_cors_preflight_and_echo(self, client, stack):
+        import requests as _rq
+
+        r = _rq.options(
+            f"{stack['endpoint']}/whatever/key",
+            headers={"Origin": "https://app.example", "Access-Control-Request-Method": "PUT"},
+            timeout=10,
+        )
+        assert r.status_code == 200
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+        assert "PUT" in r.headers["Access-Control-Allow-Methods"]
+
+        b = _fresh_bucket(client, "corsb")
+        client.put_object(b, "k", b"x")
+        r = client.get_object(b, "k", headers={"Origin": "https://app.example"})
+        assert r.headers.get("Access-Control-Allow-Origin") == "*"
