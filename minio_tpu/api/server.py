@@ -260,7 +260,9 @@ class S3Server:
         if outcome == "match_failed":
             raise S3Error("PreconditionFailed", resource=f"/{bucket}/{key}")
         if outcome == "not_modified":
-            return web.Response(status=304, headers={"ETag": f'"{oi.etag}"'})
+            # RFC 7232 §4.1: a 304 carries the headers a 200 would (metadata
+            # refresh for caches) minus any body-specific ones.
+            return web.Response(status=304, headers=self._object_headers(oi))
         return None
 
     # CORS (the reference's generic-handlers.go CorsHandler): permissive by
@@ -461,9 +463,14 @@ class S3Server:
         raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
 
     async def _dispatch(self, request: web.Request, request_id: str) -> web.Response:
-        if request.method == "OPTIONS":
-            # CORS preflight (generic-handlers CorsHandler role): anonymous
-            # by design, but instrumented like every other request.
+        if (
+            request.method == "OPTIONS"
+            and "Origin" in request.headers
+            and "Access-Control-Request-Method" in request.headers
+        ):
+            # A genuine CORS preflight (generic-handlers CorsHandler role):
+            # anonymous by design, instrumented like every other request.
+            # Non-CORS OPTIONS falls through to routing (MethodNotAllowed).
             origin = self._cors_origin(request)
             if origin is None:
                 return web.Response(status=403)
@@ -1753,11 +1760,15 @@ class S3Server:
             if rng:
                 offset, length, total_needed = _parse_range(rng)
             probe = self.layer.get_object_info(bucket, key, opts)
+            if part_q:
+                # Validate the part request BEFORE conditionals: a malformed
+                # partNumber must 400/416, not 304 (mirrors Range, which is
+                # parsed above).
+                offset, length, n_parts = part_window(probe)
             cond = self._conditional_response(request, probe, bucket, key)
             if cond is not None:
                 return cond  # before any data IO / tier recall / transform
             if part_q:
-                offset, length, n_parts = part_window(probe)
                 if length > 0:  # empty part: plain 200, no byte-range
                     rng = f"part={part_q}"  # range semantics: 206 + Content-Range
             tiered = self.tiering is not None and tiering_mod.is_transitioned(probe.internal)

@@ -33,6 +33,7 @@ class LifecycleRule:
     transition_days: int = -1  # -1 = no <Days> element (0 is valid: immediate)
     transition_date: float = 0.0
     transition_tier: str = ""
+    abort_mpu_days: int = 0  # AbortIncompleteMultipartUpload/DaysAfterInitiation
 
     def applies(self, object_name: str) -> bool:
         return self.status == "Enabled" and object_name.startswith(self.prefix)
@@ -73,6 +74,10 @@ class Lifecycle:
                     days = _text(c, "NoncurrentDays")
                     if days:
                         r.noncurrent_days = int(days)
+                elif t == "AbortIncompleteMultipartUpload":
+                    days = _text(c, "DaysAfterInitiation")
+                    if days:
+                        r.abort_mpu_days = int(days)
                 elif t == "Transition":
                     days = _text(c, "Days")
                     if days:
@@ -106,6 +111,16 @@ class Lifecycle:
                 if r.transition_date and now > r.transition_date:
                     return f"transition:{r.transition_tier}"
         return ""
+
+    def eval_abort_mpu(self, object_name: str, initiated: float) -> bool:
+        """Should an incomplete multipart upload be aborted?
+        (AbortIncompleteMultipartUpload, DaysAfterInitiation semantics.)"""
+        now = time.time()
+        for r in self.rules:
+            if r.applies(object_name) and r.abort_mpu_days:
+                if now - initiated > r.abort_mpu_days * 86400:
+                    return True
+        return False
 
     def eval_noncurrent(self, object_name: str, successor_mod_time: float) -> bool:
         now = time.time()

@@ -58,6 +58,7 @@ class DataScanner:
         self.cycles_completed = 0
         self.objects_healed = 0
         self.objects_expired = 0
+        self.uploads_aborted = 0
         self.objects_transitioned = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -120,6 +121,27 @@ class DataScanner:
                     if self._rng.randrange(self.heal_sample) == 0:
                         self._deep_check(bucket, name)
                     self._sleeper.sleep(time.perf_counter() - t0)
+            # Stale incomplete multipart uploads (the reference's scanner
+            # applies AbortIncompleteMultipartUpload rules per bucket).
+            # Capability is tested explicitly so a real AttributeError inside
+            # the listing code still surfaces instead of silently disabling
+            # the sweep.
+            list_mpu = getattr(self.layer, "list_multipart_uploads", None)
+            abort_mpu = getattr(self.layer, "abort_multipart_upload", None)
+            if lc is not None and list_mpu is not None and abort_mpu is not None:
+                try:
+                    uploads = list_mpu(bucket)
+                except errors.StorageError:
+                    uploads = []
+                for up in uploads:
+                    if lc.eval_abort_mpu(up["object"], up["initiated"]):
+                        t0 = time.perf_counter()
+                        try:
+                            abort_mpu(bucket, up["object"], up["upload_id"])
+                            self.uploads_aborted += 1
+                        except errors.StorageError:
+                            pass
+                        self._sleeper.sleep(time.perf_counter() - t0)
         fresh.finish()
         self.usage = fresh
         self.cycles_completed += 1
