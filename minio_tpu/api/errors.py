@@ -43,6 +43,7 @@ ERRORS = {
     "InvalidPartOrder": APIError("InvalidPartOrder", "The list of parts was not in ascending order.", 400),
     "InvalidRange": APIError("InvalidRange", "The requested range is not satisfiable.", 416),
     "InvalidPartNumber": APIError("InvalidPartNumber", "The requested partnumber is not satisfiable.", 416),
+    "InvalidStorageClass": APIError("InvalidStorageClass", "The storage class you specified is not valid.", 400),
     "InvalidRequest": APIError("InvalidRequest", "Invalid Request.", 400),
     "KeyTooLongError": APIError("KeyTooLongError", "Your key is too long.", 400),
     "MalformedXML": APIError("MalformedXML", "The XML you provided was not well-formed or did not validate against our published schema.", 400),

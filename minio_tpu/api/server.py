@@ -1296,10 +1296,14 @@ class S3Server:
             if hold not in ("ON", "OFF"):
                 raise S3Error("InvalidArgument", "bad legal hold status")
             user_defined[ol.META_LEGAL_HOLD] = hold
+        sc = request.headers.get("x-amz-storage-class", "").upper()
+        if sc and sc not in ("STANDARD", "REDUCED_REDUNDANCY"):
+            raise S3Error("InvalidStorageClass")
         opts = PutObjectOptions(
             user_defined=user_defined,
             versioned=meta.versioning_enabled(),
             content_type=request.headers.get("Content-Type", "application/octet-stream"),
+            storage_class=sc,
         )
         # Replica writes from a source cluster: preserve version identity and
         # mark REPLICA so this object is never re-replicated (the reference's
@@ -1560,6 +1564,8 @@ class S3Server:
             headers["x-amz-storage-class"] = oi.internal.get(
                 tiering_mod.META_TRANSITION_TIER, "GLACIER"
             )
+        elif oi.storage_class and oi.storage_class != "STANDARD":
+            headers["x-amz-storage-class"] = oi.storage_class
         return headers
 
     # -- zip extension (s3-zip-handlers.go role) ------------------------------

@@ -142,6 +142,10 @@ def serve(argv: list[str]) -> int:
         sc = os.environ.get("MINIO_STORAGE_CLASS_STANDARD", "")
         if sc.startswith("EC:"):
             parity = int(sc[3:])
+    rrs_parity = None
+    rrs = os.environ.get("MINIO_STORAGE_CLASS_RRS", "")
+    if rrs.startswith("EC:"):
+        rrs_parity = int(rrs[3:])
 
     if not a.no_selftest:
         t0 = time.perf_counter()
@@ -204,6 +208,7 @@ def serve(argv: list[str]) -> int:
         root_password=root_password,
         set_drive_count=set_count or None,
         parity=parity,
+        rrs_parity=rrs_parity,
         region=region,
     )
     app = node.make_app()

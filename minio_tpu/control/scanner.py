@@ -128,7 +128,12 @@ class DataScanner:
             # the sweep.
             list_mpu = getattr(self.layer, "list_multipart_uploads", None)
             abort_mpu = getattr(self.layer, "abort_multipart_upload", None)
-            if lc is not None and list_mpu is not None and abort_mpu is not None:
+            if (
+                lc is not None
+                and list_mpu is not None
+                and abort_mpu is not None
+                and any(r.abort_mpu_days for r in lc.rules)
+            ):
                 try:
                     uploads = list_mpu(bucket)
                 except errors.StorageError:

@@ -61,6 +61,7 @@ class Node:
         root_password: str = "minioadmin",
         set_drive_count: int | None = None,
         parity: int | None = None,
+        rrs_parity: int | None = None,
         region: str = "us-east-1",
         codec: codec_mod.BlockCodec | None = None,
         check_skew: bool = False,
@@ -114,6 +115,7 @@ class Node:
                     f"sets of {self.set_drive_count}"
                 )
         self.parity = parity
+        self.rrs_parity = rrs_parity
         # Leader = the node owning the first endpoint (server-main.go:507
         # "first local" orchestrates format).
         first = self.endpoints[0]
@@ -242,7 +244,7 @@ class Node:
             pool_sets.append(
                 ErasureSets.from_drives(
                     list(drives), quorum, parity=self.parity, codec=layer_codec,
-                    pool_index=pi,
+                    pool_index=pi, rrs_parity=self.rrs_parity,
                 )
             )
         self.pools = ServerPools(pool_sets)

@@ -262,11 +262,17 @@ class ErasureObjects:
         set_index: int = 0,
         pool_index: int = 0,
         ns_lock=None,
+        rrs_parity: int | None = None,
     ):
         self.disks = disks
         self.set_index = set_index
         self.pool_index = pool_index
         self.parity = default_parity(len(disks)) if parity is None else parity
+        # REDUCED_REDUNDANCY parity (storageclass RRS, default EC:2), never
+        # above the standard class.
+        self.rrs_parity = min(
+            self.parity, 2 if rrs_parity is None else rrs_parity
+        )
         # None = resolve the process-wide codec lazily per call, so a codec
         # installed at boot (runtime.install_data_plane_codec) serves layers
         # built before it landed.
@@ -407,6 +413,9 @@ class ErasureObjects:
 
         n = self.drive_count
         m = self.parity
+        if (opts.storage_class or "").upper() == "REDUCED_REDUNDANCY" and self.parity > 0:
+            m = max(self.rrs_parity, 1)
+            opts.user_defined = {**opts.user_defined, "x-internal-storage-class": "REDUCED_REDUNDANCY"}
         k = n - m
         distribution = hash_order(f"{bucket}/{object_name}", n)
         version_id = opts.version_id or (str(uuid.uuid4()) if opts.versioned else "")

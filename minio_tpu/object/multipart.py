@@ -54,6 +54,7 @@ class MultipartManager:
                 "content_type": opts.content_type,
                 "user_defined": opts.user_defined,
                 "versioned": opts.versioned,
+                "storage_class": opts.storage_class,
             }
         ).encode()
         path = _upload_dir(bucket, object_name, upload_id) + "/upload.json"
@@ -68,6 +69,15 @@ class MultipartManager:
         if n_ok < self.eo.drive_count // 2 + 1:
             raise errors.ErasureWriteQuorum(bucket, object_name, "initiate multipart")
         return upload_id
+
+    def _geometry(self, meta_doc: dict) -> tuple[int, int]:
+        """(k, m) for this upload, honoring its stored storage class (the
+        single-PUT path applies the same RRS parity, erasure.py)."""
+        n = self.eo.drive_count
+        m = self.eo.parity
+        if (meta_doc.get("storage_class") or "").upper() == "REDUCED_REDUNDANCY" and m > 0:
+            m = max(self.eo.rrs_parity, 1)
+        return n - m, m
 
     def _upload_meta(self, bucket: str, object_name: str, upload_id: str) -> dict:
         path = _upload_dir(bucket, object_name, upload_id) + "/upload.json"
@@ -97,11 +107,10 @@ class MultipartManager:
 
         if not (1 <= part_number <= MAX_PARTS):
             raise errors.InvalidArgument(bucket, object_name, "bad part number")
-        self._upload_meta(bucket, object_name, upload_id)
+        meta_doc = self._upload_meta(bucket, object_name, upload_id)
 
         n = self.eo.drive_count
-        m = self.eo.parity
-        k = n - m
+        k, m = self._geometry(meta_doc)
         distribution = hash_order(f"{bucket}/{object_name}", n)
         md5h = hashlib.md5()
         reader = _as_reader(data)
@@ -221,8 +230,7 @@ class MultipartManager:
             part_infos.append(got)
 
         n = self.eo.drive_count
-        m = self.eo.parity
-        k = n - m
+        k, m = self._geometry(meta_doc)
         distribution = hash_order(f"{bucket}/{object_name}", n)
         total_size = sum(p.size for p in part_infos)
         # S3 multipart etag: md5 of the concatenated binary part md5s + "-N".
@@ -238,6 +246,12 @@ class MultipartManager:
             "etag": etag,
             "content-type": meta_doc.get("content_type", "application/octet-stream"),
             **meta_doc.get("user_defined", {}),
+            **(
+                {"x-internal-storage-class": "REDUCED_REDUNDANCY"}
+                if (meta_doc.get("storage_class") or "").upper() == "REDUCED_REDUNDANCY"
+                and self.eo.parity > 0
+                else {}
+            ),
         }
 
         def commit(args):
@@ -288,6 +302,11 @@ class MultipartManager:
             etag=etag,
             version_id=version_id,
             content_type=base_meta["content-type"],
+            storage_class=(
+                "REDUCED_REDUNDANCY"
+                if base_meta.get("x-internal-storage-class") == "REDUCED_REDUNDANCY"
+                else "STANDARD"
+            ),
         )
         return oi
 

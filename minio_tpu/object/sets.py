@@ -46,6 +46,7 @@ class ErasureSets:
         parity: int | None = None,
         codec: codec_mod.BlockCodec | None = None,
         pool_index: int = 0,
+        rrs_parity: int | None = None,
     ):
         if len(disks) % set_drive_count:
             raise ValueError("drive count must be a multiple of set size")
@@ -57,7 +58,10 @@ class ErasureSets:
         for s in range(len(disks) // set_drive_count):
             sub = disks[s * set_drive_count : (s + 1) * set_drive_count]
             self.sets.append(
-                ErasureObjects(sub, parity=parity, codec=codec, set_index=s, pool_index=pool_index)
+                ErasureObjects(
+                    sub, parity=parity, codec=codec, set_index=s,
+                    pool_index=pool_index, rrs_parity=rrs_parity,
+                )
             )
         self.metacache = metacache_mod.MetacacheManager(
             self._walk_merged, persist=self._persist_cache, load=self._load_cache
@@ -71,6 +75,7 @@ class ErasureSets:
         parity: int | None = None,
         codec: codec_mod.BlockCodec | None = None,
         pool_index: int = 0,
+        rrs_parity: int | None = None,
     ) -> "ErasureSets":
         """Arrange drives according to a quorum format (newErasureSets,
         cmd/erasure-sets.go:353): position = where the drive's id appears."""
@@ -91,6 +96,7 @@ class ErasureSets:
             parity=parity,
             codec=codec,
             pool_index=pool_index,
+            rrs_parity=rrs_parity,
         )
         return obj
 

@@ -37,6 +37,7 @@ class ObjectInfo:
         content_type = meta.pop("content-type", "application/octet-stream")
         user = {k: v for k, v in meta.items() if not k.startswith("x-internal-")}
         internal = {k: v for k, v in meta.items() if k.startswith("x-internal-")}
+        storage_class = internal.get("x-internal-storage-class", "STANDARD")
         return cls(
             bucket=bucket,
             name=name,
@@ -51,6 +52,7 @@ class ObjectInfo:
             parts=list(fi.parts),
             num_versions=fi.num_versions,
             internal=internal,
+            storage_class=storage_class,
             inline=not fi.data_dir,
         )
 
@@ -90,6 +92,9 @@ class PutObjectOptions:
     # shard files hold raw bytes and one checksum per part lives in the
     # metadata (cmd/bitrot-whole.go). Empty = default interleaved streaming.
     bitrot_algorithm: str = ""
+    # "" | "STANDARD" | "REDUCED_REDUNDANCY": RRS writes with the reduced
+    # parity count (internal/config/storageclass RRS role, default EC:2).
+    storage_class: str = ""
 
 
 @dataclass

@@ -175,3 +175,24 @@ class TestMultipartAPI:
         assert r.status_code == 204
         r = client.request("GET", "/mpapi/ab", query=[("uploadId", uid)])
         assert r.status_code == 404
+
+
+def test_multipart_rrs_storage_class(tmp_path):
+    from minio_tpu.object.types import PutObjectOptions
+    from tests.harness import ErasureHarness
+
+    hz = ErasureHarness(tmp_path, n_disks=8)
+    hz.layer.make_bucket("mprrs")
+    mp = hz.layer.multipart
+    uid = mp.new_multipart_upload(
+        "mprrs", "obj", PutObjectOptions(storage_class="REDUCED_REDUNDANCY")
+    )
+    body = b"m" * (5 << 20)
+    p1 = mp.put_object_part("mprrs", "obj", uid, 1, body)
+    p2 = mp.put_object_part("mprrs", "obj", uid, 2, b"tail")
+    oi = mp.complete_multipart_upload("mprrs", "obj", uid, [(1, p1.etag), (2, p2.etag)])
+    assert oi.storage_class == "REDUCED_REDUNDANCY"
+    fi, _, _ = hz.layer._read_quorum_fi("mprrs", "obj", "")
+    assert fi.erasure.parity_blocks == 2 and fi.erasure.data_blocks == 6
+    _, got = hz.layer.get_object("mprrs", "obj")
+    assert got == body + b"tail"

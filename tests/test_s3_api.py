@@ -587,3 +587,27 @@ class TestDateConditionalsAndCors:
         client.put_object(b, "k", b"x")
         r = client.get_object(b, "k", headers={"Origin": "https://app.example"})
         assert r.headers.get("Access-Control-Allow-Origin") == "*"
+
+
+class TestStorageClass:
+    def test_rrs_reduced_parity(self, client, stack):
+        b = _fresh_bucket(client, "rrsb")
+        data = b"r" * 300_000
+        r = client.put_object(
+            b, "rrs-obj", data, headers={"x-amz-storage-class": "REDUCED_REDUNDANCY"}
+        )
+        assert r.status_code == 200, r.text
+        h = client.head_object(b, "rrs-obj")
+        assert h.headers.get("x-amz-storage-class") == "REDUCED_REDUNDANCY"
+        assert client.get_object(b, "rrs-obj").content == data
+        # The stored geometry really uses reduced parity (EC:2 on 8 drives).
+        eo = stack["layer"].pools[0].get_hashed_set("rrsb/rrs-obj")
+        fi, _, _ = eo._read_quorum_fi(b, "rrs-obj", "")
+        assert fi.erasure.parity_blocks == 2
+        assert fi.erasure.data_blocks == 6
+
+        client.put_object(b, "std-obj", data)
+        assert "x-amz-storage-class" not in client.head_object(b, "std-obj").headers
+        r = client.put_object(b, "bad", data, headers={"x-amz-storage-class": "GLACIER"})
+        assert r.status_code == 400
+        assert b"InvalidStorageClass" in r.content
