@@ -42,6 +42,9 @@ class LastMinuteLatency:
         return n, t
 
 
+HIST_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
 class MetricsSys:
     def __init__(self):
         self._lock = threading.Lock()
@@ -49,6 +52,12 @@ class MetricsSys:
         self.api_calls: dict[str, int] = defaultdict(int)
         self.api_errors: dict[str, int] = defaultdict(int)
         self.api_latency: dict[str, LastMinuteLatency] = defaultdict(LastMinuteLatency)
+        # Cumulative duration histogram per API (metrics-v2.go:977 TTFB
+        # distribution role): [bucket counts..., +Inf], plus sum.
+        self.api_hist: dict[str, list[int]] = defaultdict(
+            lambda: [0] * (len(HIST_BUCKETS) + 1)
+        )
+        self.api_hist_sum: dict[str, float] = defaultdict(float)
         self.bytes_received = 0
         self.bytes_sent = 0
         self.encode_batches = 0
@@ -70,6 +79,14 @@ class MetricsSys:
                 self.api_errors[api] += 1
             self.bytes_received += rx
             self.bytes_sent += tx
+            hist = self.api_hist[api]
+            for i, ub in enumerate(HIST_BUCKETS):
+                if seconds <= ub:
+                    hist[i] += 1
+                    break
+            else:
+                hist[-1] += 1
+            self.api_hist_sum[api] += seconds
         self.api_latency[api].add(seconds)
 
     def record_encode(self, blocks: int, device_ns: int) -> None:
@@ -122,6 +139,27 @@ class MetricsSys:
                     round(t / n, 6),
                     {"api": api},
                 )
+        lines.append(
+            "# HELP minio_tpu_s3_request_duration_seconds Request duration distribution."
+        )
+        lines.append("# TYPE minio_tpu_s3_request_duration_seconds histogram")
+        with self._lock:
+            hists = {k: (list(v), self.api_hist_sum[k]) for k, v in self.api_hist.items()}
+        for api, (buckets, total_s) in sorted(hists.items()):
+            cum = 0
+            for i, ub in enumerate(HIST_BUCKETS):
+                cum += buckets[i]
+                lines.append(
+                    f'minio_tpu_s3_request_duration_seconds_bucket{{api="{api}",le="{ub}"}} {cum}'
+                )
+            cum += buckets[-1]
+            lines.append(
+                f'minio_tpu_s3_request_duration_seconds_bucket{{api="{api}",le="+Inf"}} {cum}'
+            )
+            lines.append(
+                f'minio_tpu_s3_request_duration_seconds_sum{{api="{api}"}} {round(total_s, 6)}'
+            )
+            lines.append(f'minio_tpu_s3_request_duration_seconds_count{{api="{api}"}} {cum}')
         metric("minio_tpu_encode_batches_total", enc[0],
                help_="Device encode batches run.")
         metric("minio_tpu_encode_blocks_total", enc[1])

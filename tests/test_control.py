@@ -323,3 +323,17 @@ class TestAbortIncompleteMultipart:
         assert uid not in remaining  # stale/ upload aborted
         assert fresh_uid in remaining  # fresh/ prefix not covered by the rule
         assert sc.uploads_aborted >= 1
+
+
+def test_metrics_duration_histogram():
+    from minio_tpu.control.metrics import MetricsSys
+
+    m = MetricsSys()
+    m.record_api("GetObject", 0.003, True)
+    m.record_api("GetObject", 0.2, True)
+    m.record_api("GetObject", 42.0, False)
+    out = m.render()
+    assert 'minio_tpu_s3_request_duration_seconds_bucket{api="GetObject",le="0.005"} 1' in out
+    assert 'minio_tpu_s3_request_duration_seconds_bucket{api="GetObject",le="0.25"} 2' in out
+    assert 'minio_tpu_s3_request_duration_seconds_bucket{api="GetObject",le="+Inf"} 3' in out
+    assert 'minio_tpu_s3_request_duration_seconds_count{api="GetObject"} 3' in out
