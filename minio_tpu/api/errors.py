@@ -44,6 +44,7 @@ ERRORS = {
     "InvalidRange": APIError("InvalidRange", "The requested range is not satisfiable.", 416),
     "InvalidPartNumber": APIError("InvalidPartNumber", "The requested partnumber is not satisfiable.", 416),
     "InvalidStorageClass": APIError("InvalidStorageClass", "The storage class you specified is not valid.", 400),
+    "MalformedPolicy": APIError("MalformedPolicy", "Policy has an invalid condition.", 400),
     "InvalidRequest": APIError("InvalidRequest", "Invalid Request.", 400),
     "KeyTooLongError": APIError("KeyTooLongError", "Your key is too long.", 400),
     "MalformedXML": APIError("MalformedXML", "The XML you provided was not well-formed or did not validate against our published schema.", 400),

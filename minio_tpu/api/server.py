@@ -440,17 +440,41 @@ class S3Server:
         request["access_key"] = access_key
         q = request.rel_url.query
         action = policy_mod.s3_action("PUT", bucket, key, q)
-        await asyncio.to_thread(self._authorize, access_key, action, bucket, key)
+        await asyncio.to_thread(self._authorize, access_key, action, bucket, key, request)
         if "uploadId" in q and "partNumber" in q:
             return await asyncio.to_thread(
                 self._upload_part, bucket, key, q["uploadId"], int(q["partNumber"]), reader
             )
         return await asyncio.to_thread(self._put_object, bucket, key, reader, request)
 
-    def _authorize(self, access_key: str, action: str, bucket: str, key: str) -> None:
+    @staticmethod
+    def _policy_context(request: web.Request | None) -> dict:
+        """Condition keys for policy evaluation (the reference's
+        policy.Args: aws:SourceIp, aws:Referer, s3:prefix, ...)."""
+        if request is None:
+            return {}
+        q = request.rel_url.query
+        return {
+            "aws:SourceIp": request.remote or "",
+            "aws:Referer": request.headers.get("Referer", ""),
+            "aws:SecureTransport": "true" if request.secure else "false",
+            "s3:prefix": q.get("prefix", ""),
+            "s3:delimiter": q.get("delimiter", ""),
+            "s3:max-keys": q.get("max-keys", ""),
+        }
+
+    def _authorize(
+        self,
+        access_key: str,
+        action: str,
+        bucket: str,
+        key: str,
+        request: web.Request | None = None,
+    ) -> None:
+        context = self._policy_context(request)
         resource = policy_mod.resource_arn(bucket, key)
         if access_key:
-            if self.iam.is_allowed(access_key, action, resource):
+            if self.iam.is_allowed(access_key, action, resource, context):
                 return
             raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
         # Anonymous: only bucket policy can grant.
@@ -458,7 +482,7 @@ class S3Server:
             meta = self.bucket_meta.get(bucket)
             if meta.policy_json:
                 pol = policy_mod.Policy.from_json(meta.policy_json)
-                if pol.is_allowed(action, resource):
+                if pol.is_allowed(action, resource, context):
                     return
         raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
 
@@ -512,7 +536,9 @@ class S3Server:
             and request.method == "POST"
             and ctype.startswith("multipart/form-data")
         ):
-            return await asyncio.to_thread(self._post_policy_upload, bucket, body, ctype)
+            return await asyncio.to_thread(
+                self._post_policy_upload, bucket, body, ctype, request
+            )
         access_key, body = await asyncio.to_thread(self._authenticate, request, body)
         request["access_key"] = access_key
         q = request.rel_url.query
@@ -530,7 +556,7 @@ class S3Server:
                 )
 
         action = policy_mod.s3_action(request.method, bucket, key, q)
-        await asyncio.to_thread(self._authorize, access_key, action, bucket, key)
+        await asyncio.to_thread(self._authorize, access_key, action, bucket, key, request)
 
         if not bucket:
             if request.method == "GET":
@@ -654,7 +680,9 @@ class S3Server:
             raise S3Error("MethodNotAllowed")
         raise S3Error("MethodNotAllowed")
 
-    def _post_policy_upload(self, bucket: str, body: bytes, ctype: str) -> web.Response:
+    def _post_policy_upload(
+        self, bucket: str, body: bytes, ctype: str, request: web.Request | None = None
+    ) -> web.Response:
         """Browser POST upload with a signed policy document
         (PostPolicyBucketHandler, cmd/bucket-handlers.go equivalent)."""
         from . import postpolicy as pp
@@ -671,7 +699,7 @@ class S3Server:
             raise S3Error("MalformedPOSTRequest", "missing key field")
         filename = form.get("__filename__", b"upload").decode() or "upload"
         key = key.replace("${filename}", filename)
-        self._authorize(access_key, "s3:PutObject", bucket, key)
+        self._authorize(access_key, "s3:PutObject", bucket, key, request)
         meta = self.bucket_meta.get(bucket)
         user_defined = {
             k.lower(): v.decode("utf-8", "replace")
@@ -783,9 +811,13 @@ class S3Server:
     def _put_policy(self, bucket: str, body: bytes) -> web.Response:
         self.layer.get_bucket_info(bucket)
         try:
-            policy_mod.Policy.from_json(body)
+            pol = policy_mod.Policy.from_json(body)
         except Exception:
             raise S3Error("MalformedXML", "Policy is not valid JSON")
+        try:
+            pol.validate()  # unknown operators / bad CIDRs refuse at write
+        except ValueError as e:
+            raise S3Error("MalformedPolicy", str(e))
         self.bucket_meta.update(bucket, policy_json=body.decode())
         self._site_meta_sync(bucket)
         return web.Response(status=204)

@@ -204,8 +204,11 @@ class IAMSys:
             return self.custom_policies[name]
         return policy_mod.CANNED.get(name)
 
-    def is_allowed(self, access_key: str, action: str, resource: str) -> bool:
-        """Policy evaluation (IAMSys.IsAllowed equivalent)."""
+    def is_allowed(
+        self, access_key: str, action: str, resource: str, context: dict | None = None
+    ) -> bool:
+        """Policy evaluation (IAMSys.IsAllowed equivalent). `context` carries
+        request condition keys (aws:SourceIp, s3:prefix, ...)."""
         if access_key == self.root.access_key:
             return True  # root owner bypasses policy, as in the reference
         with self._lock:
@@ -225,22 +228,24 @@ class IAMSys:
                 if parent is None:
                     return False
                 names = list(parent.policies)
-                parent_allowed = self._eval(names, action, resource)
+                parent_allowed = self._eval(names, action, resource, context)
             if ident.session_policy is not None:
                 sp = policy_mod.Policy.from_dict(ident.session_policy)
-                return parent_allowed and sp.is_allowed(action, resource)
+                return parent_allowed and sp.is_allowed(action, resource, context)
             return parent_allowed
-        allowed = self._eval(names, action, resource)
+        allowed = self._eval(names, action, resource, context)
         # Federated STS identities (no parent user) carry mapped policies; a
         # session policy can only NARROW them, never broaden.
         if allowed and ident.session_policy is not None:
             sp = policy_mod.Policy.from_dict(ident.session_policy)
-            return sp.is_allowed(action, resource)
+            return sp.is_allowed(action, resource, context)
         return allowed
 
-    def _eval(self, names: list[str], action: str, resource: str) -> bool:
+    def _eval(
+        self, names: list[str], action: str, resource: str, context: dict | None = None
+    ) -> bool:
         for name in names:
             doc = self._policy_doc(name)
-            if doc and policy_mod.Policy.from_dict(doc).is_allowed(action, resource):
+            if doc and policy_mod.Policy.from_dict(doc).is_allowed(action, resource, context):
                 return True
         return False

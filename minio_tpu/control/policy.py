@@ -9,6 +9,7 @@ the implemented API; condition keys can layer on later.
 from __future__ import annotations
 
 import fnmatch
+import ipaddress
 import json
 from dataclasses import dataclass, field
 
@@ -64,6 +65,67 @@ class Statement:
             for pat in self.resources
         )
 
+    SUPPORTED_CONDITION_OPS = (
+        "StringEquals", "StringNotEquals", "StringLike", "StringNotLike",
+        "IpAddress", "NotIpAddress", "Bool",
+    )
+
+    def matches_conditions(self, context: dict | None, fail_closed: bool = False) -> bool:
+        """Evaluate the statement's Condition block against request context.
+
+        Supported operators: StringEquals/NotEquals/Like/NotLike,
+        IpAddress/NotIpAddress (CIDR), Bool; condition KEY names are
+        case-insensitive like AWS. An unmet condition means the statement
+        does not apply. An UNEVALUABLE condition (unknown operator,
+        malformed CIDR, empty value list — rejected at write time by
+        validate(), but stored policies may predate it) resolves to
+        `fail_closed`: Deny statements pass True so a broken Deny still
+        denies rather than failing open."""
+        if not self.conditions:
+            return True
+        ctx = {str(k).lower(): v for k, v in (context or {}).items()}
+        for op, kv in self.conditions.items():
+            if not isinstance(kv, dict):
+                return fail_closed
+            for key, want in kv.items():
+                vals = [str(v) for v in (want if isinstance(want, list) else [want])]
+                if not vals:
+                    return fail_closed
+                have = ctx.get(str(key).lower())
+                if op == "StringEquals":
+                    if have is None or str(have) not in vals:
+                        return False
+                elif op == "StringNotEquals":
+                    if have is not None and str(have) in vals:
+                        return False
+                elif op == "StringLike":
+                    if have is None or not any(
+                        fnmatch.fnmatchcase(str(have), v) for v in vals
+                    ):
+                        return False
+                elif op == "StringNotLike":
+                    if have is not None and any(
+                        fnmatch.fnmatchcase(str(have), v) for v in vals
+                    ):
+                        return False
+                elif op in ("IpAddress", "NotIpAddress"):
+                    try:
+                        addr = ipaddress.ip_address(str(have)) if have else None
+                        nets = [ipaddress.ip_network(v, strict=False) for v in vals]
+                    except ValueError:
+                        return fail_closed
+                    inside = addr is not None and any(addr in n for n in nets)
+                    if op == "IpAddress" and not inside:
+                        return False
+                    if op == "NotIpAddress" and inside:
+                        return False
+                elif op == "Bool":
+                    if have is None or str(have).lower() != vals[0].lower():
+                        return False
+                else:
+                    return fail_closed  # unknown operator
+        return True
+
 
 @dataclass
 class Policy:
@@ -96,15 +158,39 @@ class Policy:
     def from_json(cls, raw: str | bytes) -> "Policy":
         return cls.from_dict(json.loads(raw))
 
-    def is_allowed(self, action: str, resource: str) -> bool:
-        """Deny overrides allow; default deny."""
+    def is_allowed(self, action: str, resource: str, context: dict | None = None) -> bool:
+        """Deny overrides allow; default deny. Deny statements evaluate
+        their conditions fail-CLOSED (an unevaluable condition still
+        denies); Allow statements fail-open-to-deny."""
         allowed = False
         for s in self.statements:
             if s.matches_action(action) and s.matches_resource(resource):
                 if s.effect == "Deny":
-                    return False
-                allowed = True
+                    if s.matches_conditions(context, fail_closed=True):
+                        return False
+                elif s.matches_conditions(context, fail_closed=False):
+                    allowed = True
         return allowed
+
+    def validate(self) -> None:
+        """Reject policies AWS would refuse at write time: unknown condition
+        operators, empty value lists, malformed CIDRs."""
+        for s in self.statements:
+            for op, kv in s.conditions.items():
+                if op not in Statement.SUPPORTED_CONDITION_OPS:
+                    raise ValueError(f"unsupported condition operator {op!r}")
+                if not isinstance(kv, dict):
+                    raise ValueError(f"condition block for {op!r} must be an object")
+                for key, want in kv.items():
+                    vals = [str(v) for v in (want if isinstance(want, list) else [want])]
+                    if not vals:
+                        raise ValueError(f"empty value list for condition key {key!r}")
+                    if op in ("IpAddress", "NotIpAddress"):
+                        for v in vals:
+                            try:
+                                ipaddress.ip_network(v, strict=False)
+                            except ValueError:
+                                raise ValueError(f"bad CIDR {v!r} in {op}") from None
 
 
 def resource_arn(bucket: str, key: str = "") -> str:

@@ -611,3 +611,79 @@ class TestStorageClass:
         r = client.put_object(b, "bad", data, headers={"x-amz-storage-class": "GLACIER"})
         assert r.status_code == 400
         assert b"InvalidStorageClass" in r.content
+
+
+class TestPolicyConditions:
+    def test_source_ip_and_prefix_conditions(self, client, stack):
+        import json as _json
+
+        b = _fresh_bucket(client, "condpol")
+        client.put_object(b, "public/x", b"open")
+        client.put_object(b, "private/y", b"closed")
+
+        # Anonymous read allowed only from loopback and only under public/.
+        policy = _json.dumps({
+            "Version": "2012-10-17",
+            "Statement": [{
+                "Effect": "Allow",
+                "Principal": "*",
+                "Action": ["s3:GetObject"],
+                "Resource": [f"arn:aws:s3:::{b}/public/*"],
+                "Condition": {"IpAddress": {"aws:SourceIp": "127.0.0.0/8"}},
+            }],
+        })
+        r = client.request("PUT", f"/{b}", query=[("policy", "")], body=policy.encode())
+        assert r.status_code in (200, 204), r.text
+        r = client.request("GET", f"/{b}/public/x", anonymous=True)
+        assert r.status_code == 200 and r.content == b"open"
+        r = client.request("GET", f"/{b}/private/y", anonymous=True)
+        assert r.status_code == 403
+
+        # Same policy but a non-matching CIDR: denied despite the path.
+        policy = policy.replace("127.0.0.0/8", "10.9.8.0/24")
+        client.request("PUT", f"/{b}", query=[("policy", "")], body=policy.encode())
+        r = client.request("GET", f"/{b}/public/x", anonymous=True)
+        assert r.status_code == 403
+
+    def test_string_condition_on_listing(self, client):
+        import json as _json
+
+        b = _fresh_bucket(client, "condlist")
+        client.put_object(b, "team-a/doc", b"a")
+        policy = _json.dumps({
+            "Statement": [{
+                "Effect": "Allow",
+                "Principal": "*",
+                "Action": ["s3:ListBucket"],
+                "Resource": [f"arn:aws:s3:::{b}"],
+                "Condition": {"StringLike": {"s3:prefix": "team-a/*"}},
+            }],
+        })
+        client.request("PUT", f"/{b}", query=[("policy", "")], body=policy.encode())
+        r = client.request("GET", f"/{b}", query=[("prefix", "team-a/")], anonymous=True)
+        assert r.status_code == 200
+        r = client.request("GET", f"/{b}", query=[("prefix", "team-b/")], anonymous=True)
+        assert r.status_code == 403
+        r = client.request("GET", f"/{b}", anonymous=True)  # no prefix at all
+        assert r.status_code == 403
+
+    def test_invalid_condition_rejected_at_write(self, client):
+        import json as _json
+
+        b = _fresh_bucket(client, "condbad")
+        for bad in (
+            {"NumericLessThan": {"s3:max-keys": "10"}},      # unsupported op
+            {"IpAddress": {"aws:SourceIp": "10.0.0.0/33"}},  # bad CIDR
+            {"Bool": {"aws:SecureTransport": []}},           # empty values
+        ):
+            policy = _json.dumps({
+                "Statement": [{
+                    "Effect": "Deny", "Principal": "*",
+                    "Action": ["s3:GetObject"],
+                    "Resource": [f"arn:aws:s3:::{b}/*"],
+                    "Condition": bad,
+                }],
+            })
+            r = client.request("PUT", f"/{b}", query=[("policy", "")], body=policy.encode())
+            assert r.status_code == 400, (bad, r.text)
+            assert b"MalformedPolicy" in r.content
