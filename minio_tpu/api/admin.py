@@ -193,6 +193,12 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
 
     def h_put_policy(request, body):
         doc = json.loads(body)
+        from ..control import policy as policy_mod
+
+        try:
+            policy_mod.Policy.from_dict(doc).validate()
+        except ValueError as e:
+            raise S3Error("MalformedPolicy", str(e))
         ctx.iam.set_policy(request.match_info["name"], doc)
         _site_iam("policy", {"name": request.match_info["name"], "doc": doc})
         return {"ok": True}

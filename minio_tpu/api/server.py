@@ -1057,7 +1057,9 @@ class S3Server:
             if request is not None and bypass:
                 ak = request.get("access_key", "")
                 may_bypass = bool(ak) and self.iam.is_allowed(
-                    ak, "s3:BypassGovernanceRetention", policy_mod.resource_arn(bucket, "*")
+                    ak, "s3:BypassGovernanceRetention",
+                    policy_mod.resource_arn(bucket, "*"),
+                    self._policy_context(request),
                 )
             survivors = []
             for name, vid in objects:
@@ -1349,7 +1351,8 @@ class S3Server:
             # overwrite an arbitrary version id in place).
             ak = request.get("access_key", "")
             if not ak or not self.iam.is_allowed(
-                ak, "s3:ReplicateObject", policy_mod.resource_arn(bucket, key)
+                ak, "s3:ReplicateObject", policy_mod.resource_arn(bucket, key),
+                self._policy_context(request),
             ):
                 raise S3Error("AccessDenied", "replication permission required")
             user_defined[repl_mod.META_REPLICA_STATUS] = repl_mod.REPLICA
@@ -1979,7 +1982,8 @@ class S3Server:
         bypass = request.headers.get("x-amz-bypass-governance-retention", "").lower() == "true"
         ak = request.get("access_key", "")
         may_bypass = bool(ak) and self.iam.is_allowed(
-            ak, "s3:BypassGovernanceRetention", policy_mod.resource_arn(bucket, key)
+            ak, "s3:BypassGovernanceRetention", policy_mod.resource_arn(bucket, key),
+            self._policy_context(request),
         )
         ol.check_retention_tighten(old, mode, until, bypass, may_bypass)
         self.layer.put_object_metadata(
@@ -2097,6 +2101,7 @@ class S3Server:
                     may_bypass = bool(ak) and self.iam.is_allowed(
                         ak, "s3:BypassGovernanceRetention",
                         policy_mod.resource_arn(bucket, key),
+                        self._policy_context(request),
                     )
                 ol.check_delete_allowed(oi.user_defined, bypass, may_bypass)
         # Permanent deletes of transitioned versions journal the remote tier
@@ -2133,6 +2138,7 @@ class S3Server:
                 request.get("access_key", ""),
                 "s3:ReplicateObject",
                 policy_mod.resource_arn(bucket, key),
+                self._policy_context(request),
             )
         )
         self._emit("s3:ObjectRemoved:Delete", bucket, oi, replicate=not is_replica_op)

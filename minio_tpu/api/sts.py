@@ -76,9 +76,16 @@ def _duration(form: dict[str, str], default: int = 3600) -> int:
 def _session_policy(form: dict[str, str]) -> dict | None:
     if form.get("Policy"):
         try:
-            return json.loads(form["Policy"])
+            doc = json.loads(form["Policy"])
         except ValueError:
             raise S3Error("MalformedXML", "invalid session policy")
+        from ..control import policy as policy_mod
+
+        try:
+            policy_mod.Policy.from_dict(doc).validate()
+        except ValueError as e:
+            raise S3Error("MalformedXML", f"invalid session policy: {e}")
+        return doc
     return None
 
 

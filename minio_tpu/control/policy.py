@@ -83,6 +83,8 @@ class Statement:
         denies rather than failing open."""
         if not self.conditions:
             return True
+        if not isinstance(self.conditions, dict):
+            return fail_closed
         ctx = {str(k).lower(): v for k, v in (context or {}).items()}
         for op, kv in self.conditions.items():
             if not isinstance(kv, dict):
@@ -120,7 +122,7 @@ class Statement:
                     if op == "NotIpAddress" and inside:
                         return False
                 elif op == "Bool":
-                    if have is None or str(have).lower() != vals[0].lower():
+                    if have is None or str(have).lower() not in [v.lower() for v in vals]:
                         return False
                 else:
                     return fail_closed  # unknown operator
@@ -176,6 +178,8 @@ class Policy:
         """Reject policies AWS would refuse at write time: unknown condition
         operators, empty value lists, malformed CIDRs."""
         for s in self.statements:
+            if not isinstance(s.conditions, dict):
+                raise ValueError("Condition must be an object")
             for op, kv in s.conditions.items():
                 if op not in Statement.SUPPORTED_CONDITION_OPS:
                     raise ValueError(f"unsupported condition operator {op!r}")

@@ -217,6 +217,72 @@ class TestDegraded:
         assert r.content == data
         servers[0].stop()
 
+    def test_write_during_node_loss_then_heal_on_rejoin(self, tmp_path_factory):
+        """The verify-healing.sh scenario (buildscripts/verify-healing.sh:16):
+        a node dies, writes continue at quorum, the node rejoins, heal
+        restores its shards, and a clean re-heal reports nothing to do."""
+        # 3 nodes x 2 drives (set of 6, parity 3): one node's loss leaves 4
+        # drives = the k+1 write quorum, so writes continue — the same shape
+        # verify-healing.sh gets from 3 processes (losing half the drives
+        # would correctly block writes, hence not 2 nodes here).
+        tmp = tmp_path_factory.mktemp("healcycle")
+        ports = [_free_port(), _free_port(), _free_port()]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        endpoints = []
+        for ni in range(3):
+            for di in range(2):
+                endpoints.append(f"{urls[ni]}{tmp}/n{ni}d{di}")
+
+        def boot(ni, node):
+            srv = ThreadedServer(SimpleNamespace(app=node.make_app()), port=ports[ni])
+            srv.start()
+            return srv
+
+        nodes = [
+            Node(endpoints, url=urls[ni], root_user=ROOT, root_password=SECRET, set_drive_count=6)
+            for ni in range(3)
+        ]
+        servers = [boot(ni, nodes[ni]) for ni in range(3)]
+        ths = [threading.Thread(target=n.build) for n in nodes]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(60)
+        c0 = S3TestClient(urls[0], ROOT, SECRET)
+        c0.make_bucket("healcyc")
+
+        # Node 2 dies; a write lands at quorum (4 of 6 drives alive).
+        servers[2].stop()
+        time.sleep(0.2)
+        data = b"written-while-down" * 3000
+        r = c0.put_object("healcyc", "obj", data)
+        assert r.status_code == 200, r.text
+
+        # Node 2 rejoins (fresh process over the same drives).
+        node2b = Node(
+            endpoints, url=urls[2], root_user=ROOT, root_password=SECRET, set_drive_count=6
+        )
+        servers[2] = boot(2, node2b)
+        node2b.build()
+        # Node 0's REST clients hold a failure backoff (HEALTH_INTERVAL);
+        # wait until every remote drive answers again before healing.
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if all(d.is_online() and d.disk_id() for d in nodes[0].drives):
+                break
+            time.sleep(0.5)
+
+        healed = nodes[0].pools.heal_object("healcyc", "obj")
+        assert healed.disks_healed >= 1  # node 2's shard rows rebuilt
+        again = nodes[0].pools.heal_object("healcyc", "obj", dry_run=True)
+        assert again.disks_healed == 0  # clean after heal
+        assert c0.get_object("healcyc", "obj").content == data
+        # The healed copy is readable THROUGH the rejoined node too.
+        c2 = S3TestClient(urls[2], ROOT, SECRET)
+        assert c2.get_object("healcyc", "obj").content == data
+        for s in servers:
+            s.stop()
+
 
 class TestMultiPool:
     """Node-level multi-pool construction (round-3 weak #9): one node, two
