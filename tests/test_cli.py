@@ -115,3 +115,73 @@ def test_server_subprocess(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait()
+
+
+def _boot_server(tmp_path, port, env):
+    return subprocess.Popen(
+        [
+            sys.executable, "-m", "minio_tpu", "server",
+            "--address", f"127.0.0.1:{port}", "--json",
+            str(tmp_path) + "/disk{1...4}",
+        ],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _wait_up(client, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if client.request("GET", "/").status_code == 200:
+                return True
+        except Exception:
+            pass
+        time.sleep(0.3)
+    return False
+
+
+def test_server_restart_preserves_data(tmp_path):
+    """Durability across process restarts (the reference's upgrade/restart
+    verification): a second boot over the same drives serves the data the
+    first wrote, without reformatting."""
+    env = dict(
+        os.environ,
+        MINIO_ROOT_USER="cliroot01",
+        MINIO_ROOT_PASSWORD="cli-secret-key1",
+        MINIO_STORAGE_CLASS_STANDARD="EC:1",
+    )
+    port = _free_port()
+    client = S3TestClient(f"http://127.0.0.1:{port}", "cliroot01", "cli-secret-key1")
+
+    proc = _boot_server(tmp_path, port, env)
+    try:
+        assert _wait_up(client), "first boot did not come up"
+        client.make_bucket("persist")
+        client.put_object("persist", "keep/me", b"survives restart" * 100)
+        fmt = (tmp_path / "disk1" / ".minio_tpu.sys" / "format.json").read_text()
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+    port2 = _free_port()
+    client2 = S3TestClient(f"http://127.0.0.1:{port2}", "cliroot01", "cli-secret-key1")
+    proc = _boot_server(tmp_path, port2, env)
+    try:
+        assert _wait_up(client2), "restart did not come up"
+        r = client2.request("GET", "/persist/keep/me")
+        assert r.status_code == 200 and r.content == b"survives restart" * 100
+        # Same deployment: format untouched by the restart.
+        fmt2 = (tmp_path / "disk1" / ".minio_tpu.sys" / "format.json").read_text()
+        assert fmt == fmt2
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
