@@ -224,3 +224,33 @@ class TestMetacache:
         assert len(res.objects) == 10
         assert cold.metacache.walks == 0  # served from the persisted image
         assert cold.metacache.hits == 1
+
+
+class TestVersionPaging:
+    def test_version_listing_pages_without_loss_or_dupes(self, layer):
+        sets = layer.pools[0]
+        from minio_tpu.object.types import PutObjectOptions
+
+        # 4 objects x 3 versions = 12 version entries.
+        for i in range(4):
+            for v in range(3):
+                layer.put_object(
+                    "bucket", f"vp/obj-{i}", f"v{v}".encode(),
+                    PutObjectOptions(versioned=True),
+                )
+        seen: list[tuple[str, str]] = []
+        km, vm = "", ""
+        for _ in range(20):
+            res = sets.list_object_versions(
+                "bucket", prefix="vp/", key_marker=km, version_marker=vm, max_keys=5
+            )
+            seen.extend((o.name, o.version_id) for o in res.objects)
+            if not res.is_truncated:
+                break
+            km, vm = res.next_key_marker, res.next_version_marker
+        assert len(seen) == 12
+        assert len(set(seen)) == 12  # no duplicates
+        assert sorted({n for n, _ in seen}) == [f"vp/obj-{i}" for i in range(4)]
+        # Newest-first within each key.
+        full = sets.list_object_versions("bucket", prefix="vp/", max_keys=1000)
+        assert [(o.name, o.version_id) for o in full.objects] == seen
