@@ -60,6 +60,7 @@ ERRORS = {
     "ReplicationConfigurationNotFoundError": APIError("ReplicationConfigurationNotFoundError", "The replication configuration was not found.", 404),
     "ServerSideEncryptionConfigurationNotFoundError": APIError("ServerSideEncryptionConfigurationNotFoundError", "The server side encryption configuration was not found.", 404),
     "NoSuchCORSConfiguration": APIError("NoSuchCORSConfiguration", "The CORS configuration does not exist.", 404),
+    "NoSuchWebsiteConfiguration": APIError("NoSuchWebsiteConfiguration", "The specified bucket does not have a website configuration.", 404),
     "ObjectLockConfigurationNotFoundError": APIError("ObjectLockConfigurationNotFoundError", "Object Lock configuration does not exist for this bucket.", 404),
     "NoSuchObjectLockConfiguration": APIError("NoSuchObjectLockConfiguration", "The specified object does not have an ObjectLock configuration.", 404),
     "NotImplemented": APIError("NotImplemented", "A header you provided implies functionality that is not implemented.", 501),

@@ -18,8 +18,11 @@ import base64
 import contextlib
 import datetime
 import hashlib
+import json
+import queue as queue_mod
 import re
 import secrets
+import threading
 import time as _time
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -560,6 +563,10 @@ class S3Server:
 
         if not bucket:
             if request.method == "GET":
+                if "events" in q:
+                    # Cluster-wide live event stream (ListenNotificationHandler,
+                    # cmd/listen-notification-handlers.go:31, root-path route).
+                    return await self._listen_notification(request, "")
                 return await asyncio.to_thread(self._list_buckets)
             raise S3Error("MethodNotAllowed")
         if not key:
@@ -618,6 +625,8 @@ class S3Server:
                 return await asyncio.to_thread(self._put_object_lock_config, bucket, body)
             if "cors" in q:
                 return await asyncio.to_thread(self._put_bucket_config, bucket, "cors_xml", body)
+            if "acl" in q:
+                return await asyncio.to_thread(self._put_acl, bucket, request, body)
             return await asyncio.to_thread(self._make_bucket, bucket, request)
         if m == "GET":
             if "location" in q:
@@ -658,9 +667,35 @@ class S3Server:
                 return await asyncio.to_thread(
                     self._get_bucket_config, bucket, "cors_xml", "NoSuchCORSConfiguration"
                 )
+            if "events" in q:
+                # Live per-bucket event stream (mc watch;
+                # cmd/listen-notification-handlers.go:31).
+                await asyncio.to_thread(self.layer.get_bucket_info, bucket)
+                return await self._listen_notification(request, bucket)
+            if "policyStatus" in q:
+                return await asyncio.to_thread(self._get_policy_status, bucket)
             if "acl" in q:
                 await asyncio.to_thread(self.layer.get_bucket_info, bucket)
                 return _xml(self._acl_xml())
+            # AWS-compat fixed-config subresources (the reference serves
+            # constant defaults for these, cmd/dummy-handlers.go).
+            if "accelerate" in q:
+                await asyncio.to_thread(self.layer.get_bucket_info, bucket)
+                return _xml(f'<AccelerateConfiguration xmlns="{XML_NS}"/>')
+            if "requestPayment" in q:
+                await asyncio.to_thread(self.layer.get_bucket_info, bucket)
+                return _xml(
+                    f'<RequestPaymentConfiguration xmlns="{XML_NS}">'
+                    "<Payer>BucketOwner</Payer></RequestPaymentConfiguration>"
+                )
+            if "logging" in q:
+                await asyncio.to_thread(self.layer.get_bucket_info, bucket)
+                return _xml(f'<BucketLoggingStatus xmlns="{XML_NS}"/>')
+            if "website" in q:
+                await asyncio.to_thread(self.layer.get_bucket_info, bucket)
+                raise S3Error("NoSuchWebsiteConfiguration", resource=f"/{bucket}")
+            if "replication-metrics" in q:
+                return await asyncio.to_thread(self._replication_metrics, bucket)
             if "uploads" in q:
                 return await asyncio.to_thread(self._list_multipart_uploads, bucket, q)
             if "versions" in q:
@@ -673,6 +708,20 @@ class S3Server:
                 return await asyncio.to_thread(self._put_bucket_tagging, bucket, b"")
             if "lifecycle" in q:
                 return await asyncio.to_thread(self._put_bucket_config, bucket, "lifecycle_xml", b"")
+            if "encryption" in q:
+                # DeleteBucketEncryptionHandler role.
+                return await asyncio.to_thread(
+                    self._put_bucket_config, bucket, "encryption_xml", b""
+                )
+            if "replication" in q:
+                # DeleteBucketReplicationConfigHandler role.
+                return await asyncio.to_thread(
+                    self._put_bucket_config, bucket, "replication_xml", b""
+                )
+            if "website" in q:
+                # Dummy delete (cmd/dummy-handlers.go:165): succeed, no-op.
+                await asyncio.to_thread(self.layer.get_bucket_info, bucket)
+                return web.Response(status=200)
             return await asyncio.to_thread(self._delete_bucket, bucket)
         if m == "POST":
             if "delete" in q:
@@ -915,6 +964,168 @@ class S3Server:
             "<Permission>FULL_CONTROL</Permission>"
             "</Grant></AccessControlList></AccessControlPolicy>"
         )
+
+    def _head_for_acl(self, bucket: str, key: str) -> None:
+        """Object-ACL subresources 404 like the object APIs do."""
+        self.layer.get_bucket_info(bucket)
+        self.layer.get_object_info(bucket, key)
+
+    def _put_acl(
+        self, bucket: str, request: web.Request, body: bytes, key: str = ""
+    ) -> web.Response:
+        """Put{Bucket,Object}ACLHandler role: buckets/objects are always
+        owner-FULL_CONTROL; only the private canned ACL (or an ACL document
+        granting exactly that) is accepted, anything else is NotImplemented
+        (access control is IAM/bucket-policy driven, as in the reference)."""
+        self.layer.get_bucket_info(bucket)
+        if key:
+            self.layer.get_object_info(bucket, key)
+        canned = request.headers.get("x-amz-acl", "")
+        if canned and canned != "private":
+            raise S3Error("NotImplemented")
+        if not canned and body:
+            try:
+                root = ET.fromstring(body)
+            except ET.ParseError:
+                raise S3Error("MalformedXML")
+            grants = [g for g in root.iter() if g.tag.endswith("Grant")]
+            perms = [
+                (p.text or "") for g in grants for p in g.iter() if p.tag.endswith("Permission")
+            ]
+            if perms != ["FULL_CONTROL"]:
+                raise S3Error("NotImplemented")
+        return web.Response(status=200)
+
+    def _get_policy_status(self, bucket: str) -> web.Response:
+        """GetBucketPolicyStatusHandler: IsPublic = the stored bucket policy
+        grants anonymous access (bucket policies are principal-* grants here,
+        evaluated through the real engine so Deny/Condition nullification
+        reports private)."""
+        self.layer.get_bucket_info(bucket)
+        meta = self.bucket_meta.get(bucket)
+        public = False
+        if meta.policy_json:
+            try:
+                pol = policy_mod.Policy.from_json(meta.policy_json)
+                # Evaluate representative anonymous requests through the real
+                # engine (deny-overrides + conditions), not a bare
+                # any-Allow-statement scan -- a policy whose Allow is nullified
+                # by a Deny or an unsatisfiable Condition is not public.
+                public = any(
+                    pol.is_allowed(action, resource)
+                    for action, resource in (
+                        ("s3:GetObject", f"arn:aws:s3:::{bucket}/*"),
+                        ("s3:PutObject", f"arn:aws:s3:::{bucket}/*"),
+                        ("s3:ListBucket", f"arn:aws:s3:::{bucket}"),
+                    )
+                )
+            except Exception:  # noqa: BLE001 - malformed stored policy is not public
+                public = False
+        return _xml(
+            f'<PolicyStatus xmlns="{XML_NS}">'
+            f"<IsPublic>{'TRUE' if public else 'FALSE'}</IsPublic></PolicyStatus>"
+        )
+
+    def _replication_metrics(self, bucket: str) -> web.Response:
+        """GetBucketReplicationMetricsHandler role: live counters from the
+        replication workers (bucket-replication.go stats)."""
+        self.layer.get_bucket_info(bucket)
+        if self.replication is None:
+            raise S3Error("ReplicationConfigurationNotFoundError", resource=f"/{bucket}")
+        st = self.replication.stats
+        return web.json_response(
+            {
+                "completed": st.completed,
+                "failed": st.failed,
+                "replicated_bytes": st.replicated_bytes,
+                "pending": self.replication.pending(),
+            }
+        )
+
+    async def _listen_notification(self, request: web.Request, bucket: str) -> web.StreamResponse:
+        """Live NDJSON event stream (ListenNotificationHandler,
+        cmd/listen-notification-handlers.go:31): subscribes to the notifier's
+        listen hub, filters by bucket / prefix / suffix / event-name patterns,
+        and writes one JSON record per event until the client disconnects.
+        Slow consumers drop events rather than block publishers (the
+        reference's non-blocking send into a bounded channel)."""
+        if self.notifier is None:
+            raise S3Error("NotImplemented")
+        from ..control.events import Rule
+
+        q = request.rel_url.query
+        names = [v for v in q.getall("events", []) if v] or ["s3:*"]
+        rule = Rule(events=names, prefix=q.get("prefix", ""), suffix=q.get("suffix", ""))
+        # Subscribe BEFORE the client can see the 200: an event emitted
+        # right after the response headers land must not be lost.
+        sub = self.notifier.listen_hub.subscribe()
+        # Bridge the blocking hub queue into asyncio with ONE dedicated
+        # thread per watcher (the reference holds a goroutine per listen
+        # stream): blocking in the shared to_thread executor instead would
+        # let a handful of idle watchers starve every other request.
+        loop = asyncio.get_running_loop()
+        # Bounded, drop-on-full: a stalled client must cost at most one
+        # queue of buffered events, not unbounded memory (same semantics as
+        # PubSub.publish into the hub queue).
+        aq: asyncio.Queue = asyncio.Queue(maxsize=10_000)
+        stop = threading.Event()
+
+        def offer(item):
+            try:
+                aq.put_nowait(item)
+            except asyncio.QueueFull:
+                pass  # slow watcher drops events, never grows memory
+
+        def pump():
+            while not stop.is_set():
+                try:
+                    item = sub.get(True, 0.5)
+                except queue_mod.Empty:
+                    continue
+                loop.call_soon_threadsafe(offer, item)
+
+        pump_t = threading.Thread(target=pump, daemon=True, name="listen-pump")
+        try:
+            resp = web.StreamResponse()
+            resp.content_type = "application/json"
+            resp.headers["Connection"] = "close"
+            await resp.prepare(request)
+            pump_t.start()
+            # Disconnects surface only through failed writes, so a write must
+            # happen at least every ~1s of wall clock even when the cluster is
+            # busy and this watcher's filter drops every event -- otherwise a
+            # dead narrowly-filtered watcher leaks its thread + subscription
+            # forever on a busy cluster.
+            last_write = _time.monotonic()
+            while True:
+                if _time.monotonic() - last_write > 1.0:
+                    try:
+                        await resp.write(b" ")  # keep-alive, as the reference sends
+                        last_write = _time.monotonic()
+                    except (ConnectionResetError, RuntimeError):
+                        break
+                try:
+                    record = await asyncio.wait_for(aq.get(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    continue
+                recs = record.get("Records") or [{}]
+                s3info = recs[0].get("s3", {})
+                ev_bucket = s3info.get("bucket", {}).get("name", "")
+                ev_key = s3info.get("object", {}).get("key", "")
+                ev_name = record.get("EventName", "")
+                if bucket and ev_bucket and ev_bucket != bucket:
+                    continue
+                if not rule.matches(ev_name, ev_key):
+                    continue
+                try:
+                    await resp.write((json.dumps(record) + "\n").encode())
+                    last_write = _time.monotonic()
+                except (ConnectionResetError, RuntimeError):
+                    break
+        finally:
+            stop.set()
+            self.notifier.listen_hub.unsubscribe(sub)
+        return resp
 
     def _list_multipart_uploads(self, bucket: str, q) -> web.Response:
         uploads = self.layer.list_multipart_uploads(bucket, q.get("prefix", ""))
@@ -1165,9 +1376,15 @@ class S3Server:
                 return await asyncio.to_thread(
                     self._upload_part, bucket, key, q["uploadId"], int(q["partNumber"]), body
                 )
+            if "acl" in q:
+                # PutObjectACLHandler role: only the private default sticks.
+                return await asyncio.to_thread(self._put_acl, bucket, request, body, key)
             if "x-amz-copy-source" in request.headers:
                 return await asyncio.to_thread(self._copy_object, bucket, key, request)
             return await asyncio.to_thread(self._put_object, bucket, key, body, request)
+        if m == "GET" and "acl" in q:
+            await asyncio.to_thread(self._head_for_acl, bucket, key)
+            return _xml(self._acl_xml())
         if m == "GET" and "uploadId" in q:
             return await asyncio.to_thread(self._list_parts, bucket, key, q)
         if m == "GET" and "tagging" in q:

@@ -204,6 +204,8 @@ def resource_arn(bucket: str, key: str = "") -> str:
 # HTTP method+query -> s3 action mapping used by the API layer.
 def s3_action(method: str, bucket: str, key: str, query: dict[str, str]) -> str:
     if not bucket:
+        if "events" in query:
+            return "s3:ListenNotification"
         return "s3:ListAllMyBuckets"
     if key:
         if method in ("GET", "HEAD"):
@@ -213,6 +215,8 @@ def s3_action(method: str, bucket: str, key: str, query: dict[str, str]) -> str:
                 return "s3:GetObjectRetention"
             if "legal-hold" in query:
                 return "s3:GetObjectLegalHold"
+            if "acl" in query:
+                return "s3:GetObjectAcl"
             return "s3:GetObject"
         if method == "PUT":
             if "tagging" in query:
@@ -221,6 +225,8 @@ def s3_action(method: str, bucket: str, key: str, query: dict[str, str]) -> str:
                 return "s3:PutObjectRetention"
             if "legal-hold" in query:
                 return "s3:PutObjectLegalHold"
+            if "acl" in query:
+                return "s3:PutObjectAcl"
             return "s3:PutObject"
         if method == "DELETE":
             if "tagging" in query:
@@ -234,16 +240,64 @@ def s3_action(method: str, bucket: str, key: str, query: dict[str, str]) -> str:
         if method == "GET" or method == "HEAD":
             if "versions" in query:
                 return "s3:ListBucketVersions"
+            if "events" in query:
+                return "s3:ListenBucketNotification"
+            if "policyStatus" in query:
+                return "s3:GetBucketPolicyStatus"
+            if "policy" in query:
+                return "s3:GetBucketPolicy"
+            if "lifecycle" in query:
+                return "s3:GetLifecycleConfiguration"
+            if "encryption" in query:
+                return "s3:GetEncryptionConfiguration"
+            if "replication" in query or "replication-metrics" in query:
+                return "s3:GetReplicationConfiguration"
+            if "notification" in query:
+                return "s3:GetBucketNotification"
+            if "tagging" in query:
+                return "s3:GetBucketTagging"
+            if "object-lock" in query:
+                return "s3:GetBucketObjectLockConfiguration"
+            if "acl" in query:
+                return "s3:GetBucketAcl"
             return "s3:ListBucket"
         if method == "PUT":
             if "policy" in query:
                 return "s3:PutBucketPolicy"
             if "versioning" in query:
                 return "s3:PutBucketVersioning"
+            if "lifecycle" in query:
+                return "s3:PutLifecycleConfiguration"
+            if "encryption" in query:
+                return "s3:PutEncryptionConfiguration"
+            if "replication" in query:
+                return "s3:PutReplicationConfiguration"
+            if "notification" in query:
+                return "s3:PutBucketNotification"
+            if "tagging" in query:
+                return "s3:PutBucketTagging"
+            if "object-lock" in query:
+                return "s3:PutBucketObjectLockConfiguration"
+            if "acl" in query:
+                return "s3:PutBucketAcl"
             return "s3:CreateBucket"
         if method == "DELETE":
             if "policy" in query:
                 return "s3:DeleteBucketPolicy"
+            # Config deletes require the matching Put* permission, as in the
+            # reference (DeleteBucketEncryption/ReplicationConfig handlers
+            # check the Put*Action) -- plain s3:DeleteBucket must not be able
+            # to strip replication/encryption config.
+            if "lifecycle" in query:
+                return "s3:PutLifecycleConfiguration"
+            if "encryption" in query:
+                return "s3:PutEncryptionConfiguration"
+            if "replication" in query:
+                return "s3:PutReplicationConfiguration"
+            if "tagging" in query:
+                return "s3:PutBucketTagging"
+            if "website" in query:
+                return "s3:DeleteBucketWebsite"
             return "s3:DeleteBucket"
         if method == "POST" and "delete" in query:
             return "s3:DeleteObject"

@@ -30,6 +30,7 @@ class S3TestClient:
         body: bytes = b"",
         headers: dict[str, str] | None = None,
         anonymous: bool = False,
+        stream: bool = False,
     ) -> requests.Response:
         query = query or []
         headers = dict(headers or {})
@@ -42,7 +43,7 @@ class S3TestClient:
                 self.creds, method, path, query, headers, body, region=self.region
             )
             headers.pop("host")
-        return self.session.request(method, url, data=body, headers=headers)
+        return self.session.request(method, url, data=body, headers=headers, stream=stream)
 
     # Convenience wrappers -----------------------------------------------
 

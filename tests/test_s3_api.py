@@ -687,3 +687,152 @@ class TestPolicyConditions:
             r = client.request("PUT", f"/{b}", query=[("policy", "")], body=policy.encode())
             assert r.status_code == 400, (bad, r.text)
             assert b"MalformedPolicy" in r.content
+
+
+class TestCompatSubresources:
+    """AWS-compat fixed-config subresources + ACL endpoints
+    (cmd/dummy-handlers.go, PutBucketACL/PutObjectACL handlers)."""
+
+    def test_dummy_bucket_configs(self, client):
+        b = _fresh_bucket(client, "compat")
+        r = client.request("GET", f"/{b}", query=[("accelerate", "")])
+        assert r.status_code == 200 and b"AccelerateConfiguration" in r.content
+        r = client.request("GET", f"/{b}", query=[("requestPayment", "")])
+        assert r.status_code == 200 and b"BucketOwner" in r.content
+        r = client.request("GET", f"/{b}", query=[("logging", "")])
+        assert r.status_code == 200 and b"BucketLoggingStatus" in r.content
+        r = client.request("GET", f"/{b}", query=[("website", "")])
+        assert r.status_code == 404 and b"NoSuchWebsiteConfiguration" in r.content
+        # Dummy DELETE website succeeds without doing anything.
+        assert client.request("DELETE", f"/{b}", query=[("website", "")]).status_code == 200
+        # Unknown bucket still 404s through the dummy paths.
+        r = client.request("GET", "/no-such-bkt", query=[("accelerate", "")])
+        assert r.status_code == 404
+
+    def test_policy_status(self, client):
+        import json as _json
+
+        b = _fresh_bucket(client, "polstatus")
+        r = client.request("GET", f"/{b}", query=[("policyStatus", "")])
+        assert r.status_code == 200 and b"<IsPublic>FALSE</IsPublic>" in r.content
+        policy = _json.dumps({
+            "Statement": [{
+                "Effect": "Allow", "Principal": "*",
+                "Action": ["s3:GetObject"],
+                "Resource": [f"arn:aws:s3:::{b}/*"],
+            }],
+        })
+        assert (
+            client.request("PUT", f"/{b}", query=[("policy", "")], body=policy.encode()).status_code
+            == 204
+        )
+        r = client.request("GET", f"/{b}", query=[("policyStatus", "")])
+        assert r.status_code == 200 and b"<IsPublic>TRUE</IsPublic>" in r.content
+
+    def test_policy_status_deny_overrides(self, client):
+        import json as _json
+
+        b = _fresh_bucket(client, "polstatus2")
+        policy = _json.dumps({
+            "Statement": [
+                {"Effect": "Allow", "Principal": "*",
+                 "Action": ["s3:GetObject"],
+                 "Resource": [f"arn:aws:s3:::{b}/*"]},
+                {"Effect": "Deny", "Principal": "*",
+                 "Action": ["s3:*"],
+                 "Resource": [f"arn:aws:s3:::{b}", f"arn:aws:s3:::{b}/*"]},
+            ],
+        })
+        assert (
+            client.request("PUT", f"/{b}", query=[("policy", "")], body=policy.encode()).status_code
+            == 204
+        )
+        # The Allow is nullified by the blanket Deny: not public.
+        r = client.request("GET", f"/{b}", query=[("policyStatus", "")])
+        assert r.status_code == 200 and b"<IsPublic>FALSE</IsPublic>" in r.content
+
+    def test_acl_endpoints(self, client):
+        b = _fresh_bucket(client, "aclbkt")
+        client.put_object(b, "k", b"v")
+        # GET bucket/object ACL: canned owner FULL_CONTROL document.
+        for path, query in ((f"/{b}", [("acl", "")]), (f"/{b}/k", [("acl", "")])):
+            r = client.request("GET", path, query=query)
+            assert r.status_code == 200 and b"FULL_CONTROL" in r.content, path
+        # PUT private canned ACL is accepted; anything else is NotImplemented.
+        for path in (f"/{b}", f"/{b}/k"):
+            assert (
+                client.request(
+                    "PUT", path, query=[("acl", "")], headers={"x-amz-acl": "private"}
+                ).status_code
+                == 200
+            )
+            r = client.request(
+                "PUT", path, query=[("acl", "")], headers={"x-amz-acl": "public-read"}
+            )
+            assert r.status_code == 501, path
+        # ACL on a missing object 404s.
+        assert client.request("GET", f"/{b}/gone", query=[("acl", "")]).status_code == 404
+
+    def test_delete_encryption_and_replication_config(self, client):
+        b = _fresh_bucket(client, "delcfg")
+        sse = (
+            '<ServerSideEncryptionConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            "<Rule><ApplyServerSideEncryptionByDefault><SSEAlgorithm>AES256</SSEAlgorithm>"
+            "</ApplyServerSideEncryptionByDefault></Rule></ServerSideEncryptionConfiguration>"
+        )
+        assert client.request("PUT", f"/{b}", query=[("encryption", "")], body=sse.encode()).status_code in (200, 204)
+        assert client.request("GET", f"/{b}", query=[("encryption", "")]).status_code == 200
+        assert client.request("DELETE", f"/{b}", query=[("encryption", "")]).status_code in (200, 204)
+        r = client.request("GET", f"/{b}", query=[("encryption", "")])
+        assert r.status_code == 404 and b"ServerSideEncryptionConfigurationNotFoundError" in r.content
+
+
+class TestListenNotification:
+    """Live event stream (ListenNotificationHandler,
+    cmd/listen-notification-handlers.go:31)."""
+
+    def test_listen_receives_put_event(self, stack, client):
+        import json as _json
+        import threading
+
+        from minio_tpu.control.events import EventNotifier
+
+        srv = stack["server"]
+        old = srv.notifier
+        srv.notifier = EventNotifier()
+        try:
+            b = _fresh_bucket(client, "watchbkt")
+            got: list[dict] = []
+            ready = threading.Event()
+            done = threading.Event()
+
+            def listen():
+                r = client.request(
+                    "GET",
+                    f"/{b}",
+                    query=[("events", "s3:ObjectCreated:*"), ("prefix", "pfx/")],
+                    stream=True,
+                )
+                assert r.status_code == 200
+                ready.set()
+                for line in r.iter_lines():
+                    if line.strip():
+                        got.append(_json.loads(line))
+                        break
+                r.close()
+                done.set()
+
+            t = threading.Thread(target=listen, daemon=True)
+            t.start()
+            assert ready.wait(10)
+            # Non-matching prefix is filtered out; matching one arrives.
+            client.put_object(b, "other/x", b"nope")
+            client.put_object(b, "pfx/hit", b"data")
+            assert done.wait(15), "no event arrived on the listen stream"
+            rec = got[0]
+            assert rec["EventName"].startswith("s3:ObjectCreated")
+            key = rec["Records"][0]["s3"]["object"]["key"]
+            assert key == "pfx/hit"
+            assert rec["Records"][0]["s3"]["bucket"]["name"] == b
+        finally:
+            srv.notifier = old
