@@ -39,8 +39,18 @@ except Exception:  # pragma: no cover - jax internals moved; cpu config still se
 # groups by runtime.probe_device, so anything left is a real leak.
 
 import subprocess  # noqa: E402
+import sys  # noqa: E402
 
 import pytest  # noqa: E402
+
+# -- race-stress mode (the `buildscripts/race.sh` analogue, tools/race_gate.py):
+# MINIO_TPU_RACE=1 shrinks the interpreter's thread switch interval ~1000x so
+# the scheduler interleaves threads at nearly every bytecode boundary. Latent
+# check-then-act races in the quorum writers, batching queues, lock refresh
+# loops, and pubsub hubs become orders of magnitude more likely to fire while
+# the assertions stay exactly the same.
+if os.environ.get("MINIO_TPU_RACE") == "1":
+    sys.setswitchinterval(2e-6)
 
 
 def _child_pids() -> set[int]:

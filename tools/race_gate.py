@@ -1,0 +1,82 @@
+"""Race gate: the `-race` story for this codebase (buildscripts/race.sh role).
+
+Python has no ThreadSanitizer, but the same class of bug -- check-then-act
+races between the quorum writers, the batching codec's worker threads, dsync
+refresh loops, replication workers, and pubsub hubs -- surfaces reliably
+under adversarial thread scheduling. This gate reruns the concurrency-
+sensitive slice of the suite with:
+
+  * sys.setswitchinterval(2e-6) (via MINIO_TPU_RACE=1 in tests/conftest.py),
+    forcing a potential thread switch at nearly every bytecode boundary
+    (~1000x the default 5 ms), and
+  * several repetitions, since schedule-dependent bugs are probabilistic,
+  * a per-run deadlock watchdog: pytest's faulthandler plugin dumps all
+    thread stacks from INSIDE the hung process (faulthandler_timeout) well
+    before the outer subprocess timeout SIGKILLs it, so a deadlock produces
+    stacks, not a hung CI job.
+
+The reference runs its entire suite under the Go race detector
+(/root/reference/buildscripts/race.sh); here the full suite runs once in
+normal mode (pytest) and this gate stresses the files where threads
+actually interleave.
+
+    python tools/race_gate.py [repeats]
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+
+# The concurrency-bearing slice: files whose tests run multiple threads
+# against shared object-layer / locking / batching / event state.
+RACE_TESTS = [
+    "tests/test_concurrency_stress.py",
+    "tests/test_batching.py",
+    "tests/test_dist.py",
+    "tests/test_healing_tracker.py",
+    "tests/test_replication.py",
+]
+
+TIMEOUT_S = int(os.environ.get("RACE_GATE_TIMEOUT_S", "1200"))
+
+
+def main() -> int:
+    repeats = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, MINIO_TPU_RACE="1")
+    failures = 0
+    for i in range(repeats):
+        t0 = time.time()
+        try:
+            proc = subprocess.run(
+                [
+                    sys.executable,
+                    "-m",
+                    "pytest",
+                    "-q",
+                    "-x",
+                    # In-process stack dump fires before the outer SIGKILL,
+                    # so a wedged run leaves evidence.
+                    "-o",
+                    f"faulthandler_timeout={max(60, TIMEOUT_S - 120)}",
+                    *RACE_TESTS,
+                ],
+                cwd=root,
+                env=env,
+                timeout=TIMEOUT_S,
+            )
+            status = "ok" if proc.returncode == 0 else f"rc={proc.returncode}"
+            failures += proc.returncode != 0
+        except subprocess.TimeoutExpired:
+            status = f"DEADLOCK? timed out after {TIMEOUT_S}s"
+            failures += 1
+        print(f"[race-gate] round {i + 1}/{repeats}: {status} ({time.time() - t0:.0f}s)")
+    print(f"[race-gate] {'PASS' if not failures else 'FAIL'} ({repeats} rounds)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
