@@ -76,13 +76,15 @@ class BlockCodec(abc.ABC):
 
         return bitrot.digests_of_batch(chunks)
 
-    def encode_frames(self, blocks: list[bytes], k: int, m: int) -> list[bytes]:
+    def encode_frames(self, blocks: list[bytes], k: int, m: int) -> "list[bytes | memoryview]":
         """Per shard ROW: concatenated H(chunk)||chunk frames across blocks.
 
         This is the byte image appended to each drive's staged shard file
         (streaming-bitrot layout, cmd/bitrot-streaming.go:43-65). The default
         builds frames from encode()'s chunks+digests; HostCodec overrides
-        with a single C hash+frame call per row."""
+        with a single C hash+frame call per row. Rows are bytes-LIKE
+        (buffer protocol): consumers write them to files/HTTP bodies and
+        must not assume hashability or msgpack support."""
         encoded = self.encode(blocks, k, m)
         rows: list[bytes] = []
         for row in range(k + m):
@@ -134,21 +136,29 @@ class HostCodec(BlockCodec):
         return out
 
     def encode_frames(self, blocks, k, m):
-        """Uniform block groups: one rs_encode C call per block + ONE
-        hh256_frame C call per shard row (hash + interleave in native code,
-        no per-shard Python loop -- native/minio_native.cpp:232)."""
-        if self._native is None or not blocks or len({len(b) for b in blocks}) != 1:
+        """Uniform block groups: split + parity are written straight into one
+        [G, K+M, S] buffer (rs_encode's `out` view), then ONE strided
+        hh256_frame C call per shard row hashes + interleaves in native code
+        (native/minio_native.cpp:326) -- no per-shard Python loop, no
+        np.stack / per-row ascontiguousarray copies of the group. Rows come
+        back as memoryviews (buffer-protocol consumers only: drive appends /
+        HTTP bodies)."""
+        if (
+            self._native is None
+            or not blocks
+            or len({len(b) for b in blocks}) != 1
+            or len(blocks[0]) == 0  # split() rejects empty -- keep paths identical
+        ):
             return super().encode_frames(blocks, k, m)
-        pm = rs_matrix.parity_matrix(k, m)
-        per_block = []
-        for block in blocks:
-            sh = _split_block(block, k)
-            per_block.append(np.concatenate([sh, self._native.rs_encode(sh, pm)], axis=0))
-        stacked = np.stack(per_block)  # [G, K+M, S]
-        return [
-            self._native.hh256_frame(np.ascontiguousarray(stacked[:, row, :]), hh.MAGIC_KEY)
-            for row in range(k + m)
-        ]
+        pm = np.ascontiguousarray(rs_matrix.parity_matrix(k, m))
+        s = rs_matrix.shard_size(len(blocks[0]), k)
+        stacked = np.empty((len(blocks), k + m, s), dtype=np.uint8)
+        for i, block in enumerate(blocks):
+            flat = stacked[i, :k].reshape(-1)
+            flat[: len(block)] = np.frombuffer(block, dtype=np.uint8)
+            flat[len(block):] = 0  # zero-pad the tail shard (Split semantics)
+            self._native.rs_encode(stacked[i, :k], pm, out=stacked[i, k:])
+        return self._native.hh256_frame_rows(stacked, hh.MAGIC_KEY)
 
     def reconstruct(self, shards, k, m, want):
         arrs: list[np.ndarray | None] = [

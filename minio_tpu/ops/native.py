@@ -89,15 +89,23 @@ def _ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 
 
-def rs_encode(data: np.ndarray, matrix: np.ndarray) -> np.ndarray:
-    """data [K, S] u8, matrix [M, K] u8 -> parity [M, S] u8."""
+def rs_encode(
+    data: np.ndarray, matrix: np.ndarray, out: np.ndarray | None = None
+) -> np.ndarray:
+    """data [K, S] u8, matrix [M, K] u8 -> parity [M, S] u8.
+
+    `out` (contiguous [M, S] view) writes parity in place -- callers
+    assembling a [G, K+M, S] frame buffer skip a copy per block."""
     lib = load()
     assert lib is not None
     k, s = data.shape
     m = matrix.shape[0]
     data = np.ascontiguousarray(data)
     matrix = np.ascontiguousarray(matrix)
-    out = np.empty((m, s), dtype=np.uint8)
+    if out is None:
+        out = np.empty((m, s), dtype=np.uint8)
+    else:
+        assert out.shape == (m, s) and out.flags.c_contiguous
     lib.rs_encode(k, m, _ptr(matrix), _ptr(data), _ptr(out), s)
     return out
 
@@ -140,6 +148,33 @@ def hh256_frame(data: np.ndarray, key: bytes) -> bytes:
     out = np.empty(n * (32 + length), dtype=np.uint8)
     lib.hh256_frame(_ptr(keya), _ptr(data), length, length, n, _ptr(out))
     return out.tobytes()
+
+
+def hh256_frame_rows(stacked: np.ndarray, key: bytes) -> "list[memoryview]":
+    """[G, T, S] C-contiguous shard groups -> T per-row frame streams,
+    returned as memoryviews (buffer protocol, NOT bytes -- fine for file
+    writes and HTTP bodies, not hashable/msgpack-able).
+
+    One strided C call per shard row: the kernel walks row r's chunks at
+    stride T*S directly inside the group buffer, so framing a whole encode
+    group costs zero numpy row copies (the `ascontiguousarray` per row that
+    a [G, S] slice would need)."""
+    lib = load()
+    assert lib is not None
+    assert stacked.flags.c_contiguous and stacked.dtype == np.uint8
+    g, t, s = stacked.shape
+    keya = np.frombuffer(key, dtype=np.uint8)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    rows: list[memoryview] = []
+    for row in range(t):
+        out = np.empty(g * (32 + s), dtype=np.uint8)
+        base = ctypes.cast(stacked.ctypes.data + row * s, u8p)
+        lib.hh256_frame(_ptr(keya), base, t * s, s, g, _ptr(out))
+        # memoryview, not tobytes(): the caller appends these to drive files /
+        # HTTP bodies, both buffer-protocol consumers -- skipping the copy
+        # saves G x S bytes of memcpy per row.
+        rows.append(out.data)
+    return rows
 
 
 # -- native IO (O_DIRECT aligned file path; xl-storage.go CreateFile role) ---

@@ -163,6 +163,74 @@ static void hh_update_packet(hh_state* s, const uint8_t* p) {
     hh_update(s, lanes);
 }
 
+#ifdef __AVX2__
+// AVX2 packet chain: the whole 4-lane state rides one ymm per variable, the
+// zipper merge is a single PSHUFB whose byte map is derived from (and pinned
+// against) the scalar zipper_merge_add above. Remainder + finalization stay
+// scalar -- they are O(10) updates vs O(len/32) in the chain.
+typedef struct {
+    __m256i v0, v1, mul0, mul1;
+} hh_state_avx;
+
+static inline __m256i hh_zipper_avx(__m256i v) {
+    // Per 128-bit lane-pair: out bytes [0..7] = src [3,12,2,5,14,1,15,0],
+    // out [8..15] = src [11,4,10,13,9,6,8,7] (LSB-first, == scalar masks).
+    const __m256i zmask = _mm256_set_epi64x(
+        0x070806090D0A040BULL, 0x000F010E05020C03ULL,
+        0x070806090D0A040BULL, 0x000F010E05020C03ULL);
+    return _mm256_shuffle_epi8(v, zmask);
+}
+
+static inline void hh_update_avx(hh_state_avx* s, __m256i lanes) {
+    s->v1 = _mm256_add_epi64(s->v1, _mm256_add_epi64(s->mul0, lanes));
+    s->mul0 = _mm256_xor_si256(
+        s->mul0, _mm256_mul_epu32(s->v1, _mm256_srli_epi64(s->v0, 32)));
+    s->v0 = _mm256_add_epi64(s->v0, s->mul1);
+    s->mul1 = _mm256_xor_si256(
+        s->mul1, _mm256_mul_epu32(s->v0, _mm256_srli_epi64(s->v1, 32)));
+    s->v0 = _mm256_add_epi64(s->v0, hh_zipper_avx(s->v1));
+    s->v1 = _mm256_add_epi64(s->v1, hh_zipper_avx(s->v0));
+}
+
+static inline hh_state_avx hh_load_avx(const hh_state* s) {
+    hh_state_avx a;
+    a.v0 = _mm256_loadu_si256((const __m256i*)s->v0);
+    a.v1 = _mm256_loadu_si256((const __m256i*)s->v1);
+    a.mul0 = _mm256_loadu_si256((const __m256i*)s->mul0);
+    a.mul1 = _mm256_loadu_si256((const __m256i*)s->mul1);
+    return a;
+}
+
+static inline void hh_store_avx(const hh_state_avx* a, hh_state* s) {
+    _mm256_storeu_si256((__m256i*)s->v0, a->v0);
+    _mm256_storeu_si256((__m256i*)s->v1, a->v1);
+    _mm256_storeu_si256((__m256i*)s->mul0, a->mul0);
+    _mm256_storeu_si256((__m256i*)s->mul1, a->mul1);
+}
+
+// Run the full-packet chain for one stream on the vector unit.
+static void hh_chain_avx(hh_state* s, const uint8_t* data, size_t n_packets) {
+    hh_state_avx a = hh_load_avx(s);
+    for (size_t i = 0; i < n_packets; i++)
+        hh_update_avx(&a, _mm256_loadu_si256((const __m256i*)(data + i * 32)));
+    hh_store_avx(&a, s);
+}
+
+// Two independent streams interleaved: each update is a serial dependency
+// chain, so a second in-flight state nearly doubles throughput (ILP), the
+// same per-shard parallelism the batched device hash exploits.
+static void hh_chain_avx2x(hh_state* s0, const uint8_t* d0, hh_state* s1,
+                           const uint8_t* d1, size_t n_packets) {
+    hh_state_avx a0 = hh_load_avx(s0), a1 = hh_load_avx(s1);
+    for (size_t i = 0; i < n_packets; i++) {
+        hh_update_avx(&a0, _mm256_loadu_si256((const __m256i*)(d0 + i * 32)));
+        hh_update_avx(&a1, _mm256_loadu_si256((const __m256i*)(d1 + i * 32)));
+    }
+    hh_store_avx(&a0, s0);
+    hh_store_avx(&a1, s1);
+}
+#endif  // __AVX2__
+
 static void hh_permute_update(hh_state* s) {
     uint64_t p[4] = {rot32(s->v0[2]), rot32(s->v0[3]), rot32(s->v0[0]),
                      rot32(s->v0[1])};
@@ -203,38 +271,83 @@ static void hh_modular_reduction(uint64_t a3u, uint64_t a2, uint64_t a1,
     *m0 = a0 ^ (a2 << 1) ^ (a2 << 2);
 }
 
+// Remainder + 10 permute rounds + modular reduction (scalar; O(10) updates).
+static void hh_finalize(hh_state* s, const uint8_t* tail, size_t r,
+                        uint8_t* out32) {
+    if (r) hh_remainder(s, tail, r);
+    for (int i = 0; i < 10; i++) hh_permute_update(s);
+    uint64_t h[4];
+    hh_modular_reduction(s->v1[1] + s->mul1[1], s->v1[0] + s->mul1[0],
+                         s->v0[1] + s->mul0[1], s->v0[0] + s->mul0[0], &h[1],
+                         &h[0]);
+    hh_modular_reduction(s->v1[3] + s->mul1[3], s->v1[2] + s->mul1[2],
+                         s->v0[3] + s->mul0[3], s->v0[2] + s->mul0[2], &h[3],
+                         &h[2]);
+    memcpy(out32, h, 32);
+}
+
 void hh256(const uint8_t* key32, const uint8_t* data, size_t len,
            uint8_t* out32) {
     hh_state s;
     hh_reset(&s, key32);
     size_t n_full = len / 32;
+#ifdef __AVX2__
+    hh_chain_avx(&s, data, n_full);
+#else
     for (size_t i = 0; i < n_full; i++) hh_update_packet(&s, data + i * 32);
-    size_t r = len - n_full * 32;
-    if (r) hh_remainder(&s, data + n_full * 32, r);
-    for (int i = 0; i < 10; i++) hh_permute_update(&s);
-    uint64_t h[4];
-    hh_modular_reduction(s.v1[1] + s.mul1[1], s.v1[0] + s.mul1[0],
-                         s.v0[1] + s.mul0[1], s.v0[0] + s.mul0[0], &h[1], &h[0]);
-    hh_modular_reduction(s.v1[3] + s.mul1[3], s.v1[2] + s.mul1[2],
-                         s.v0[3] + s.mul0[3], s.v0[2] + s.mul0[2], &h[3], &h[2]);
-    memcpy(out32, h, 32);
+#endif
+    hh_finalize(&s, data + n_full * 32, len - n_full * 32, out32);
 }
 
 // Hash n equal-length streams laid out contiguously: data[i] at i*stride.
+// Streams are independent, so pairs run interleaved to break the per-packet
+// dependency chain (the scalar/AVX2 analogue of the device batch axis).
 void hh256_batch(const uint8_t* key32, const uint8_t* data, size_t stride,
                  size_t len, size_t n, uint8_t* out) {
-    for (size_t i = 0; i < n; i++)
-        hh256(key32, data + i * stride, len, out + i * 32);
+    size_t i = 0;
+#ifdef __AVX2__
+    size_t n_full = len / 32, r = len - n_full * 32;
+    for (; i + 2 <= n; i += 2) {
+        hh_state s0, s1;
+        hh_reset(&s0, key32);
+        hh_reset(&s1, key32);
+        const uint8_t* d0 = data + i * stride;
+        const uint8_t* d1 = data + (i + 1) * stride;
+        hh_chain_avx2x(&s0, d0, &s1, d1, n_full);
+        hh_finalize(&s0, d0 + n_full * 32, r, out + i * 32);
+        hh_finalize(&s1, d1 + n_full * 32, r, out + (i + 1) * 32);
+    }
+#endif
+    for (; i < n; i++) hh256(key32, data + i * stride, len, out + i * 32);
 }
 
 // Interleaved bitrot framing in one pass: for each of n chunks of chunk_len
 // bytes (stride apart), write H(chunk) || chunk into dst.
 void hh256_frame(const uint8_t* key32, const uint8_t* data, size_t stride,
                  size_t chunk_len, size_t n, uint8_t* dst) {
-    for (size_t i = 0; i < n; i++) {
-        hh256(key32, data + i * stride, chunk_len, dst);
-        memcpy(dst + 32, data + i * stride, chunk_len);
-        dst += 32 + chunk_len;
+    size_t i = 0;
+    const size_t frame = 32 + chunk_len;
+#ifdef __AVX2__
+    size_t n_full = chunk_len / 32, r = chunk_len - n_full * 32;
+    for (; i + 2 <= n; i += 2) {
+        hh_state s0, s1;
+        hh_reset(&s0, key32);
+        hh_reset(&s1, key32);
+        const uint8_t* d0 = data + i * stride;
+        const uint8_t* d1 = data + (i + 1) * stride;
+        hh_chain_avx2x(&s0, d0, &s1, d1, n_full);
+        uint8_t* f0 = dst + i * frame;
+        uint8_t* f1 = f0 + frame;
+        hh_finalize(&s0, d0 + n_full * 32, r, f0);
+        hh_finalize(&s1, d1 + n_full * 32, r, f1);
+        memcpy(f0 + 32, d0, chunk_len);
+        memcpy(f1 + 32, d1, chunk_len);
+    }
+#endif
+    for (; i < n; i++) {
+        uint8_t* f = dst + i * frame;
+        hh256(key32, data + i * stride, chunk_len, f);
+        memcpy(f + 32, data + i * stride, chunk_len);
     }
 }
 
