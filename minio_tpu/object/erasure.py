@@ -227,17 +227,47 @@ def _frame_shard(chunks: list[bytes], digests: list[bytes]) -> bytes:
 
 
 def _parse_frames(blob: bytes, chunk_sizes: list[int]) -> list[tuple[bytes, bytes]]:
-    """Split a shard file image back into (digest, chunk) frames."""
+    """Split a shard file image back into (digest, chunk) frames.
+
+    Frames are zero-copy memoryview slices of the blob -- a GET window
+    used to copy every digest+chunk out of the image before verifying;
+    consumers (join / np.frombuffer / == bytes) all take buffers."""
     out = []
     pos = 0
+    mv = memoryview(blob)
     for sz in chunk_sizes:
-        d = blob[pos : pos + DIGEST_LEN]
-        c = blob[pos + DIGEST_LEN : pos + DIGEST_LEN + sz]
+        d = mv[pos : pos + DIGEST_LEN]
+        c = mv[pos + DIGEST_LEN : pos + DIGEST_LEN + sz]
         if len(d) != DIGEST_LEN or len(c) != sz:
             raise errors.FileCorrupt("short shard file")
         out.append((d, c))
         pos += DIGEST_LEN + sz
     return out
+
+
+def _verify_frames(blob, chunk_sizes: list[int], parsed) -> list[bool]:
+    """Bitrot-verify every frame of one shard row window.
+
+    The uniform-size prefix (all blocks except a possible short tail) is ONE
+    native C call straight over the raw image -- no Python slicing, pairs of
+    chunks interleaved on the vector unit (native/minio_native.cpp
+    hh256_verify_frames); the tail and the no-native fallback verify via the
+    batched digest path."""
+    from ..ops import native
+    from ..ops.highwayhash import MAGIC_KEY
+
+    n = len(chunk_sizes)
+    if n == 0:
+        return []
+    same = n if n < 2 or chunk_sizes[-1] == chunk_sizes[0] else n - 1
+    if native.verify_frames_available():
+        flags = list(native.hh256_verify_frames(blob, chunk_sizes[0], same, MAGIC_KEY) != 0)
+        for i in range(same, n):
+            d, c = parsed[i]
+            flags.append(bitrot_mod.digest_of(bytes(c)) == d)
+        return flags
+    digs = bitrot_mod.digests_of_batch([bytes(c) for _, c in parsed])
+    return [digs[i] == parsed[i][0] for i in range(n)]
 
 
 def _shard_chunk_sizes(total_size: int, k: int) -> list[int]:
@@ -848,7 +878,9 @@ class ErasureObjects:
             file_off = g0 * frame_full
             file_len = sum(DIGEST_LEN + s for s in window_sizes)
 
-            def read_window(j: int) -> list[tuple[bytes, bytes]] | None:
+            def read_window(
+                j: int,
+            ) -> tuple[list[tuple[bytes, bytes]], list[bool]] | None:
                 disk = by_shard[j]
                 try:
                     if inline:
@@ -866,7 +898,10 @@ class ErasureObjects:
                             file_off,
                             file_len,
                         )
-                    return _parse_frames(blob, window_sizes)
+                    parsed = _parse_frames(blob, window_sizes)
+                    # Verify here, in the parallel read thread: the native
+                    # verifier releases the GIL, so rows verify concurrently.
+                    return parsed, _verify_frames(blob, window_sizes, parsed)
                 except (errors.DiskError, errors.FileCorrupt):
                     return None
 
@@ -883,10 +918,15 @@ class ErasureObjects:
             # Data rows first; parity pulled lazily on any failure (the
             # lazy-spare parallelReader discipline, erasure-decode.go:119).
             frames: list[list[tuple[bytes, bytes]] | None] = [None] * (k + mth)
+            oks: list[list[bool] | None] = [None] * (k + mth)
             loaded = [False] * (k + mth)
-            for j in range(k):
-                frames[j] = futures[j].result()[0]
+
+            def install(j: int, result) -> None:
+                frames[j], oks[j] = result if result is not None else (None, None)
                 loaded[j] = True
+
+            for j in range(k):
+                install(j, futures[j].result()[0])
 
             def load_spares() -> None:
                 spare = [j for j in range(k + mth) if not loaded[j]]
@@ -894,22 +934,21 @@ class ErasureObjects:
                     return
                 spare_results = meta_mod.parallel_map(read_window, spare)
                 for idx, j in enumerate(spare):
-                    frames[j] = spare_results[idx][0]
-                    loaded[j] = True
+                    install(j, spare_results[idx][0])
 
             if any(frames[j] is None for j in range(k)):
                 load_spares()
 
             def valid_rows(w: int) -> list[bytes | None]:
+                # Frames were bitrot-verified at read time (one native call
+                # per row window); a failed frame drops its whole shard, as
+                # the reference's bitrot readers do.
                 rows: list[bytes | None] = [None] * (k + mth)
-                present_j = [j for j in range(k + mth) if frames[j] is not None]
-                # One native C call verifies the whole row set (equal-length
-                # chunks within a block) instead of a per-shard Python loop.
-                digs = bitrot_mod.digests_of_batch([frames[j][w][1] for j in present_j])
-                for idx, j in enumerate(present_j):
-                    digest, chunk = frames[j][w]
-                    if digs[idx] == digest:
-                        rows[j] = chunk
+                for j in range(k + mth):
+                    if frames[j] is None:
+                        continue
+                    if oks[j][w]:
+                        rows[j] = frames[j][w][1]
                     else:
                         frames[j] = None  # corrupt: drop the shard
                 return rows

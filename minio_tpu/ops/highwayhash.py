@@ -149,9 +149,11 @@ def _modular_reduction(a3u: np.ndarray, a2: np.ndarray, a1: np.ndarray, a0: np.n
     return m0, m1
 
 
-def hash256(data: bytes | np.ndarray, key: bytes = MAGIC_KEY) -> bytes:
-    """One-shot HighwayHash-256 of a single byte string."""
-    arr = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray)) else data
+def hash256(data: "bytes | memoryview | np.ndarray", key: bytes = MAGIC_KEY) -> bytes:
+    """One-shot HighwayHash-256 of a single byte buffer."""
+    # Any buffer (bytes, memoryview from zero-copy frame parsing) normalizes
+    # through frombuffer; a memoryview would crash on the [None, :] below.
+    arr = data if isinstance(data, np.ndarray) else np.frombuffer(data, dtype=np.uint8)
     return hash256_batch(arr[None, :], key)[0].tobytes()
 
 

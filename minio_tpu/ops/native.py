@@ -63,6 +63,12 @@ def load() -> ctypes.CDLL | None:
             u8p, u8p, ctypes.c_size_t, ctypes.c_size_t, ctypes.c_size_t, u8p,
         ]
         lib.hh256_frame.argtypes = lib.hh256_batch.argtypes
+        try:
+            lib.hh256_verify_frames.argtypes = [
+                u8p, u8p, ctypes.c_size_t, ctypes.c_size_t, u8p,
+            ]
+        except AttributeError:  # stale prebuilt .so without the verifier
+            pass
         # IO layer (native/minio_io.cpp); absent in stale prebuilt libraries.
         try:
             lib.mt_write_file.argtypes = [
@@ -148,6 +154,26 @@ def hh256_frame(data: np.ndarray, key: bytes) -> bytes:
     out = np.empty(n * (32 + length), dtype=np.uint8)
     lib.hh256_frame(_ptr(keya), _ptr(data), length, length, n, _ptr(out))
     return out.tobytes()
+
+
+def verify_frames_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "hh256_verify_frames")
+
+
+def hh256_verify_frames(blob, chunk_len: int, n: int, key: bytes) -> np.ndarray:
+    """Verify n uniform H(chunk)||chunk frames inside a raw shard-file image
+    without slicing a single chunk in Python: [n] u8 flags (1 = digest ok).
+
+    `blob` is any C-contiguous buffer (bytes / memoryview) whose first
+    n*(32+chunk_len) bytes are the frames (the read side of hh256_frame)."""
+    lib = load()
+    assert lib is not None
+    arr = np.frombuffer(blob, dtype=np.uint8, count=n * (32 + chunk_len))
+    keya = np.frombuffer(key, dtype=np.uint8)
+    ok = np.empty(n, dtype=np.uint8)
+    lib.hh256_verify_frames(_ptr(keya), _ptr(arr), chunk_len, n, _ptr(ok))
+    return ok
 
 
 def hh256_frame_rows(stacked: np.ndarray, key: bytes) -> "list[memoryview]":

@@ -321,6 +321,38 @@ void hh256_batch(const uint8_t* key32, const uint8_t* data, size_t stride,
     for (; i < n; i++) hh256(key32, data + i * stride, len, out + i * 32);
 }
 
+// Verify n interleaved H(chunk)||chunk frames in place (the GET/deep-scan
+// read side of hh256_frame): data holds n frames of (32 + chunk_len) bytes;
+// ok_out[i] = 1 when the stored digest matches the recomputed one. Streams
+// are independent, so pairs run interleaved like the write side.
+void hh256_verify_frames(const uint8_t* key32, const uint8_t* data,
+                         size_t chunk_len, size_t n, uint8_t* ok_out) {
+    const size_t frame = 32 + chunk_len;
+    size_t i = 0;
+    uint8_t sum[32];
+#ifdef __AVX2__
+    size_t n_full = chunk_len / 32, r = chunk_len - n_full * 32;
+    uint8_t sum2[32];
+    for (; i + 2 <= n; i += 2) {
+        const uint8_t* f0 = data + i * frame;
+        const uint8_t* f1 = f0 + frame;
+        hh_state s0, s1;
+        hh_reset(&s0, key32);
+        hh_reset(&s1, key32);
+        hh_chain_avx2x(&s0, f0 + 32, &s1, f1 + 32, n_full);
+        hh_finalize(&s0, f0 + 32 + n_full * 32, r, sum);
+        hh_finalize(&s1, f1 + 32 + n_full * 32, r, sum2);
+        ok_out[i] = memcmp(sum, f0, 32) == 0;
+        ok_out[i + 1] = memcmp(sum2, f1, 32) == 0;
+    }
+#endif
+    for (; i < n; i++) {
+        const uint8_t* f = data + i * frame;
+        hh256(key32, f + 32, chunk_len, sum);
+        ok_out[i] = memcmp(sum, f, 32) == 0;
+    }
+}
+
 // Interleaved bitrot framing in one pass: for each of n chunks of chunk_len
 // bytes (stride apart), write H(chunk) || chunk into dst.
 void hh256_frame(const uint8_t* key32, const uint8_t* data, size_t stride,
