@@ -175,6 +175,24 @@ def object_layer_metrics(use_device: bool) -> dict:
         for i in range(50):
             layer.delete_object("bench", f"s-{i}")
 
+        # --- GetObject throughput (the speedtest GET side, cmd/utils.go:976) -
+        layer.put_object("bench", "getobj", body)
+        def read_once():
+            _, it = layer.get_object_stream("bench", "getobj")
+            n = 0
+            for c in it:
+                n += len(c)
+            return n
+        assert read_once() == PUT_SIZE
+        t0 = time.perf_counter()
+        get_iters = 4
+        for _ in range(get_iters):
+            read_once()
+        out["getobject_gibs"] = round(
+            get_iters * PUT_SIZE / (time.perf_counter() - t0) / (1 << 30), 3
+        )
+        layer.delete_object("bench", "getobj")
+
         # --- 8-concurrent-PUT aggregate (batching fan-in under load) -------
         cbody = body[:CONCURRENT_SIZE]
         rounds = 4

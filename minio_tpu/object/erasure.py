@@ -226,7 +226,9 @@ def _frame_shard(chunks: list[bytes], digests: list[bytes]) -> bytes:
     return b"".join(parts)
 
 
-def _parse_frames(blob: bytes, chunk_sizes: list[int]) -> list[tuple[bytes, bytes]]:
+def _parse_frames(
+    blob: bytes, chunk_sizes: list[int]
+) -> list[tuple[memoryview, memoryview]]:
     """Split a shard file image back into (digest, chunk) frames.
 
     Frames are zero-copy memoryview slices of the blob -- a GET window
@@ -880,7 +882,7 @@ class ErasureObjects:
 
             def read_window(
                 j: int,
-            ) -> tuple[list[tuple[bytes, bytes]], list[bool]] | None:
+            ) -> tuple[list[tuple[memoryview, memoryview]], list[bool]] | None:
                 disk = by_shard[j]
                 try:
                     if inline:
@@ -917,7 +919,7 @@ class ErasureObjects:
 
             # Data rows first; parity pulled lazily on any failure (the
             # lazy-spare parallelReader discipline, erasure-decode.go:119).
-            frames: list[list[tuple[bytes, bytes]] | None] = [None] * (k + mth)
+            frames: list[list[tuple[memoryview, memoryview]] | None] = [None] * (k + mth)
             oks: list[list[bool] | None] = [None] * (k + mth)
             loaded = [False] * (k + mth)
 

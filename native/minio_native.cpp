@@ -327,30 +327,18 @@ void hh256_batch(const uint8_t* key32, const uint8_t* data, size_t stride,
 // are independent, so pairs run interleaved like the write side.
 void hh256_verify_frames(const uint8_t* key32, const uint8_t* data,
                          size_t chunk_len, size_t n, uint8_t* ok_out) {
+    // One batched hash over the chunks (stride = whole frame, so the stored
+    // digests are skipped), then a memcmp per frame -- reuses hh256_batch's
+    // interleaved SIMD loop instead of carrying a third copy of it.
     const size_t frame = 32 + chunk_len;
-    size_t i = 0;
-    uint8_t sum[32];
-#ifdef __AVX2__
-    size_t n_full = chunk_len / 32, r = chunk_len - n_full * 32;
-    uint8_t sum2[32];
-    for (; i + 2 <= n; i += 2) {
-        const uint8_t* f0 = data + i * frame;
-        const uint8_t* f1 = f0 + frame;
-        hh_state s0, s1;
-        hh_reset(&s0, key32);
-        hh_reset(&s1, key32);
-        hh_chain_avx2x(&s0, f0 + 32, &s1, f1 + 32, n_full);
-        hh_finalize(&s0, f0 + 32 + n_full * 32, r, sum);
-        hh_finalize(&s1, f1 + 32 + n_full * 32, r, sum2);
-        ok_out[i] = memcmp(sum, f0, 32) == 0;
-        ok_out[i + 1] = memcmp(sum2, f1, 32) == 0;
-    }
-#endif
-    for (; i < n; i++) {
-        const uint8_t* f = data + i * frame;
-        hh256(key32, f + 32, chunk_len, sum);
-        ok_out[i] = memcmp(sum, f, 32) == 0;
-    }
+    uint8_t sums_stack[64 * 32];
+    uint8_t* sums = sums_stack;
+    uint8_t* heap = nullptr;
+    if (n > 64) sums = heap = new uint8_t[n * 32];
+    hh256_batch(key32, data + 32, frame, chunk_len, n, sums);
+    for (size_t i = 0; i < n; i++)
+        ok_out[i] = memcmp(sums + i * 32, data + i * frame, 32) == 0;
+    delete[] heap;
 }
 
 // Interleaved bitrot framing in one pass: for each of n chunks of chunk_len
