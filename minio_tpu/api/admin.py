@@ -497,26 +497,26 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     # -- trace streaming (admin-handlers.go:1103 role) -----------------------
 
     async def h_trace(request: web.Request, body):
+        """Cluster-wide trace stream: local hub merged with every peer's
+        /trace stream (admin-handlers.go:1103-1166 + peer-rest-server.go:985
+        behavior), on a dedicated bridge thread per watcher instead of
+        parking a shared executor worker."""
         if ctx.trace is None:
             raise S3Error("NotImplemented")
-        resp = web.StreamResponse()
-        resp.content_type = "application/x-ndjson"
-        await resp.prepare(request)
-        sub = ctx.trace.subscribe()
-        try:
-            while True:
-                try:
-                    item = await asyncio.to_thread(sub.get, True, 1.0)
-                except Exception:  # queue.Empty
-                    try:
-                        await resp.write(b"")  # liveness check
-                    except (ConnectionResetError, RuntimeError):
-                        break
-                    continue
-                await resp.write((json.dumps(item) + "\n").encode())
-        finally:
-            ctx.trace.unsubscribe(sub)
-        return resp
+        from .streams import stream_hub_response
+
+        peers = getattr(ctx, "notification", None)
+        return await stream_hub_response(
+            request,
+            ctx.trace.hub,
+            json.dumps,
+            peer_streams=(
+                [p.trace_stream for p in peers.peers]
+                if peers is not None and getattr(peers, "peers", None)
+                else None
+            ),
+            content_type="application/x-ndjson",
+        )
 
     app.router.add_post("/site-replication/add", handler(h_sr_add))
     app.router.add_get("/site-replication/info", handler(h_sr_info))

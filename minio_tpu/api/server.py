@@ -19,7 +19,6 @@ import contextlib
 import datetime
 import hashlib
 import json
-import queue as queue_mod
 import re
 import secrets
 import threading
@@ -249,6 +248,7 @@ class S3Server:
         self.notifier = None
         self.logger = None
         self.replication = None  # ReplicationSys (bucket-replication.go role)
+        self.peer_notification = None  # NotificationSys: peer listen/trace merge
         self.site_repl = None  # SiteReplicationSys (site-replication.go role)
         self.tiering = None  # TierConfigMgr (tier.go / bucket-lifecycle.go role)
 
@@ -1044,88 +1044,42 @@ class S3Server:
 
     async def _listen_notification(self, request: web.Request, bucket: str) -> web.StreamResponse:
         """Live NDJSON event stream (ListenNotificationHandler,
-        cmd/listen-notification-handlers.go:31): subscribes to the notifier's
-        listen hub, filters by bucket / prefix / suffix / event-name patterns,
-        and writes one JSON record per event until the client disconnects.
-        Slow consumers drop events rather than block publishers (the
-        reference's non-blocking send into a bounded channel)."""
+        cmd/listen-notification-handlers.go:31): merges the local listen hub
+        with every peer's /listen stream (the reference subscribes peers via
+        peer REST), filters by bucket / prefix / suffix / event-name
+        patterns, and writes one JSON record per event until the client
+        disconnects. Slow consumers drop events rather than block publishers
+        (the reference's non-blocking send into a bounded channel)."""
         if self.notifier is None:
             raise S3Error("NotImplemented")
         from ..control.events import Rule
+        from .streams import stream_hub_response
 
         q = request.rel_url.query
         names = [v for v in q.getall("events", []) if v] or ["s3:*"]
         rule = Rule(events=names, prefix=q.get("prefix", ""), suffix=q.get("suffix", ""))
-        # Subscribe BEFORE the client can see the 200: an event emitted
-        # right after the response headers land must not be lost.
-        sub = self.notifier.listen_hub.subscribe()
-        # Bridge the blocking hub queue into asyncio with ONE dedicated
-        # thread per watcher (the reference holds a goroutine per listen
-        # stream): blocking in the shared to_thread executor instead would
-        # let a handful of idle watchers starve every other request.
-        loop = asyncio.get_running_loop()
-        # Bounded, drop-on-full: a stalled client must cost at most one
-        # queue of buffered events, not unbounded memory (same semantics as
-        # PubSub.publish into the hub queue).
-        aq: asyncio.Queue = asyncio.Queue(maxsize=10_000)
-        stop = threading.Event()
 
-        def offer(item):
-            try:
-                aq.put_nowait(item)
-            except asyncio.QueueFull:
-                pass  # slow watcher drops events, never grows memory
+        def to_line(record) -> str | None:
+            recs = record.get("Records") or [{}]
+            s3info = recs[0].get("s3", {})
+            ev_bucket = s3info.get("bucket", {}).get("name", "")
+            ev_key = s3info.get("object", {}).get("key", "")
+            ev_name = record.get("EventName", "")
+            if bucket and ev_bucket and ev_bucket != bucket:
+                return None
+            if not rule.matches(ev_name, ev_key):
+                return None
+            return json.dumps(record)
 
-        def pump():
-            while not stop.is_set():
-                try:
-                    item = sub.get(True, 0.5)
-                except queue_mod.Empty:
-                    continue
-                loop.call_soon_threadsafe(offer, item)
-
-        pump_t = threading.Thread(target=pump, daemon=True, name="listen-pump")
-        try:
-            resp = web.StreamResponse()
-            resp.content_type = "application/json"
-            resp.headers["Connection"] = "close"
-            await resp.prepare(request)
-            pump_t.start()
-            # Disconnects surface only through failed writes, so a write must
-            # happen at least every ~1s of wall clock even when the cluster is
-            # busy and this watcher's filter drops every event -- otherwise a
-            # dead narrowly-filtered watcher leaks its thread + subscription
-            # forever on a busy cluster.
-            last_write = _time.monotonic()
-            while True:
-                if _time.monotonic() - last_write > 1.0:
-                    try:
-                        await resp.write(b" ")  # keep-alive, as the reference sends
-                        last_write = _time.monotonic()
-                    except (ConnectionResetError, RuntimeError):
-                        break
-                try:
-                    record = await asyncio.wait_for(aq.get(), timeout=1.0)
-                except asyncio.TimeoutError:
-                    continue
-                recs = record.get("Records") or [{}]
-                s3info = recs[0].get("s3", {})
-                ev_bucket = s3info.get("bucket", {}).get("name", "")
-                ev_key = s3info.get("object", {}).get("key", "")
-                ev_name = record.get("EventName", "")
-                if bucket and ev_bucket and ev_bucket != bucket:
-                    continue
-                if not rule.matches(ev_name, ev_key):
-                    continue
-                try:
-                    await resp.write((json.dumps(record) + "\n").encode())
-                    last_write = _time.monotonic()
-                except (ConnectionResetError, RuntimeError):
-                    break
-        finally:
-            stop.set()
-            self.notifier.listen_hub.unsubscribe(sub)
-        return resp
+        peers = self.peer_notification
+        return await stream_hub_response(
+            request,
+            self.notifier.listen_hub,
+            to_line,
+            peer_streams=(
+                [p.listen_stream for p in peers.peers] if peers is not None else None
+            ),
+        )
 
     def _list_multipart_uploads(self, bucket: str, q) -> web.Response:
         uploads = self.layer.list_multipart_uploads(bucket, q.get("prefix", ""))

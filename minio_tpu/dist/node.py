@@ -337,6 +337,9 @@ class Node:
         self.s3.trace = self.trace
         self.s3.logger = self.logger
         self.s3.notifier = self.notifier
+        # Cluster-wide watcher streams: listen/trace responses merge every
+        # peer's records (ListenNotification + admin trace peer subscription).
+        self.s3.peer_notification = self.notification
         from ..control.replication import BucketTargetSys, ReplicationSys
 
         self.replication = ReplicationSys(

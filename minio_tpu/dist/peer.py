@@ -105,6 +105,34 @@ def make_peer_app(node, token: str) -> web.Application:
             "get_bytes_per_s": size * count / get_t if get_t else 0,
         }
 
+    # Streaming endpoints: this node's live event / trace records as NDJSON
+    # (peer-rest-server.go:985 role) -- the serving node merges these into
+    # its watcher responses so `mc watch` / `mc admin trace` see the whole
+    # cluster, not one node.
+    async def h_listen_stream(request: web.Request):
+        if request.headers.get(TOKEN_HEADER) != token:
+            return web.Response(status=403)
+        notifier = getattr(node, "notifier", None)
+        if notifier is None:
+            return web.Response(status=501)
+        import json as _json
+
+        from ..api.streams import stream_hub_response
+
+        return await stream_hub_response(request, notifier.listen_hub, _json.dumps)
+
+    async def h_trace_stream(request: web.Request):
+        if request.headers.get(TOKEN_HEADER) != token:
+            return web.Response(status=403)
+        trace = getattr(node, "trace", None)
+        if trace is None:
+            return web.Response(status=501)
+        import json as _json
+
+        from ..api.streams import stream_hub_response
+
+        return await stream_hub_response(request, trace.hub, _json.dumps)
+
     for name, fn in {
         "ping": h_ping,
         "serverinfo": h_server_info,
@@ -114,6 +142,8 @@ def make_peer_app(node, token: str) -> web.Application:
         "speedtest": h_speedtest,
     }.items():
         app.router.add_post(f"/{name}", handler(fn))
+    app.router.add_post("/listen", h_listen_stream)
+    app.router.add_post("/trace", h_trace_stream)
     return app
 
 
@@ -143,6 +173,15 @@ class PeerClient:
 
     def speedtest(self, size: int = 1 << 20, count: int = 4) -> dict:
         return self.client.call("/speedtest", {"size": size, "count": count}, timeout=120.0)
+
+    def listen_stream(self):
+        """Live event stream from this peer (caller iterates lines + closes).
+        Long timeout: the peer writes keep-alives every ~1s."""
+        return self.client.call("/listen", {}, stream=True, timeout=30.0)
+
+    def trace_stream(self):
+        """Live trace stream from this peer."""
+        return self.client.call("/trace", {}, stream=True, timeout=30.0)
 
 
 class NotificationSys:

@@ -345,3 +345,41 @@ class TestWalkStream:
         # VolumeNotFound must not become a mid-stream connection abort).
         with pytest.raises(errors.VolumeNotFound):
             list(remote.walk_dir("no-such-bucket-walk"))
+
+
+class TestCrossNodeListen:
+    """ListenNotification merges peer event streams: a watcher on node A
+    sees puts served by node B (cmd/listen-notification-handlers.go:31 +
+    peer-rest-server.go:985 peer subscription)."""
+
+    def test_watch_on_a_sees_put_on_b(self, cluster):
+        import json as _json
+
+        c0, c1 = cluster["clients"]
+        assert c0.make_bucket("watchd").status_code in (200, 409)
+        got: list[dict] = []
+        ready = threading.Event()
+        done = threading.Event()
+
+        def listen():
+            r = c0.request(
+                "GET", "/watchd", query=[("events", "s3:ObjectCreated:*")], stream=True
+            )
+            assert r.status_code == 200
+            ready.set()
+            for line in r.iter_lines():
+                if line.strip():
+                    got.append(_json.loads(line))
+                    break
+            r.close()
+            done.set()
+
+        t = threading.Thread(target=listen, daemon=True)
+        t.start()
+        assert ready.wait(10)
+        time.sleep(0.8)  # let the peer pump attach to node B's stream
+        # PUT through node B (the other node's S3 endpoint).
+        assert c1.put_object("watchd", "from-b", b"payload").status_code == 200
+        assert done.wait(15), "peer event never reached node A's watcher"
+        rec = got[0]
+        assert rec["Records"][0]["s3"]["object"]["key"] == "from-b"
