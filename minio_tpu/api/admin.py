@@ -40,6 +40,7 @@ class AdminContext:
     replication: object | None = None  # ReplicationSys (bucket-replication.go)
     tiering: object | None = None  # TierConfigMgr (tier.go)
     site_repl: object | None = None  # SiteReplicationSys (site-replication.go)
+    bucket_meta: object | None = None  # BucketMetadataSys (quota config)
 
 
 def make_admin_app(ctx: AdminContext) -> web.Application:
@@ -129,6 +130,37 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         if ctx.scanner is None:
             return {}
         return ctx.scanner.usage.summary()
+
+    # -- bucket quota (Put/GetBucketQuotaConfigHandler,
+    # cmd/admin-bucket-handlers.go:43,83) ------------------------------------
+
+    def h_get_quota(request, body):
+        bucket = request.rel_url.query.get("bucket", "")
+        if not bucket or ctx.bucket_meta is None:
+            raise S3Error("InvalidRequest")
+        ctx.layer.get_bucket_info(bucket)
+        q = ctx.bucket_meta.get(bucket).quota
+        return {"quota": q, "quotatype": "hard" if q > 0 else ""}
+
+    def h_set_quota(request, body):
+        bucket = request.rel_url.query.get("bucket", "")
+        if not bucket or ctx.bucket_meta is None:
+            raise S3Error("InvalidRequest")
+        ctx.layer.get_bucket_info(bucket)
+        try:
+            cfg = json.loads(body) if body else {}
+            quota = int(cfg.get("quota", 0))
+        except (ValueError, TypeError, AttributeError):  # non-object JSON too
+            raise S3Error("InvalidRequest", "invalid quota config")
+        if quota < 0 or cfg.get("quotatype", "hard") not in ("", "hard"):
+            # FIFO quota is deprecated in the reference too; hard-only.
+            raise S3Error("InvalidRequest", "only hard quotas are supported")
+        ctx.bucket_meta.update(bucket, quota=quota)
+        if ctx.notification is not None:
+            # Peers cache bucket metadata; a quota change must reach every
+            # node's enforcement path, not just this one's.
+            ctx.notification.reload_bucket_meta_all(bucket)
+        return {"ok": True}
 
     # -- config --------------------------------------------------------------
 
@@ -528,6 +560,8 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_get("/info", handler(h_info))
     app.router.add_get("/healthinfo", handler(h_healthinfo))
     app.router.add_get("/datausage", handler(h_datausage))
+    app.router.add_get("/quota", handler(h_get_quota))
+    app.router.add_put("/quota", handler(h_set_quota))
     app.router.add_get("/config", handler(h_get_config))
     app.router.add_put("/config", handler(h_set_config))
     app.router.add_get("/users", handler(h_list_users))

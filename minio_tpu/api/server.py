@@ -249,6 +249,7 @@ class S3Server:
         self.logger = None
         self.replication = None  # ReplicationSys (bucket-replication.go role)
         self.peer_notification = None  # NotificationSys: peer listen/trace merge
+        self.quota_usage = None  # callable(bucket) -> used bytes | None (quota checks)
         self.site_repl = None  # SiteReplicationSys (site-replication.go role)
         self.tiering = None  # TierConfigMgr (tier.go / bucket-lifecycle.go role)
 
@@ -444,6 +445,13 @@ class S3Server:
         q = request.rel_url.query
         action = policy_mod.s3_action("PUT", bucket, key, q)
         await asyncio.to_thread(self._authorize, access_key, action, bucket, key, request)
+        # Quota for streaming bodies: the payload size is the DECODED length
+        # (aws-chunked framing inflates Content-Length); chunked transfers
+        # without either header check with 0, like the reference's unknown-
+        # size path.
+        decoded = request.headers.get("x-amz-decoded-content-length", "")
+        size = int(decoded) if decoded.isdigit() else (request.content_length or 0)
+        await asyncio.to_thread(self._check_quota, bucket, size)
         if "uploadId" in q and "partNumber" in q:
             return await asyncio.to_thread(
                 self._upload_part, bucket, key, q["uploadId"], int(q["partNumber"]), reader
@@ -749,6 +757,7 @@ class S3Server:
         filename = form.get("__filename__", b"upload").decode() or "upload"
         key = key.replace("${filename}", filename)
         self._authorize(access_key, "s3:PutObject", bucket, key, request)
+        self._check_quota(bucket, len(data))  # after auth: no quota-state leak
         meta = self.bucket_meta.get(bucket)
         user_defined = {
             k.lower(): v.decode("utf-8", "replace")
@@ -1378,6 +1387,8 @@ class S3Server:
     def _upload_part(
         self, bucket: str, key: str, upload_id: str, part_number: int, body: bytes
     ) -> web.Response:
+        if isinstance(body, (bytes, bytearray)):
+            self._check_quota(bucket, len(body))
         part = self.layer.put_object_part(bucket, key, upload_id, part_number, body)
         return web.Response(status=200, headers={"ETag": f'"{part.etag}"'})
 
@@ -1400,6 +1411,7 @@ class S3Server:
             if lo > hi or hi >= len(data):
                 raise S3Error("InvalidRange", resource=f"/{bucket}/{key}")
             data = data[lo : hi + 1]
+        self._check_quota(bucket, len(data))
         part = self.layer.put_object_part(bucket, key, upload_id, part_number, data)
         return _xml(
             f'<CopyPartResult xmlns="{XML_NS}">'
@@ -1663,12 +1675,35 @@ class S3Server:
                 compression_on = False
         return compression_on and compress_mod.is_compressible(key, opts.content_type)
 
+    def _check_quota(self, bucket: str, incoming: int) -> None:
+        """Hard bucket quota (enforceBucketQuota, cmd/bucket-quota.go:112):
+        enforced only when the bucket has a quota set AND a usage source is
+        wired. The source returns the bucket's scanned usage in bytes, or
+        None when NO usage information exists yet (no scan has completed
+        cluster-wide) -- in that case enforcement is skipped, as the
+        reference does when the bucket has no usage entry."""
+        meta = self.bucket_meta.get(bucket)
+        if meta.quota <= 0 or self.quota_usage is None:
+            return
+        try:
+            used = self.quota_usage(bucket)
+        except Exception:  # noqa: BLE001 - usage source down != reject writes
+            return
+        if used is None:
+            return
+        if used + incoming >= meta.quota:
+            raise S3Error("XMinioAdminBucketQuotaExceeded", resource=f"/{bucket}")
+
     def _put_object(self, bucket: str, key: str, data, request: web.Request) -> web.Response:
         """data: a verified streaming reader (dispatch) or bytes (legacy).
 
         Untransformed payloads stream straight into the erasure pipeline;
         SSE/compression still buffer (streaming transforms are the remaining
         gap vs the reference's fully piped chain)."""
+        if isinstance(data, (bytes, bytearray)):
+            self._check_quota(bucket, len(data))
+        # (streaming readers were quota-checked at dispatch with the decoded
+        # content length, _streaming_put_entry)
         opts = self._put_opts(bucket, request, key)
         body: bytes | None = None
         if isinstance(data, (bytes, bytearray)):
@@ -1725,6 +1760,7 @@ class S3Server:
 
     def _copy_object(self, bucket: str, key: str, request: web.Request) -> web.Response:
         src_oi, data = self._resolve_copy_source(request)
+        self._check_quota(bucket, len(data))
         opts = self._put_opts(bucket, request, key)
         if request.headers.get("x-amz-metadata-directive", "COPY") == "COPY":
             opts.user_defined = dict(src_oi.user_defined)

@@ -127,6 +127,8 @@ class Node:
         self.pools: ServerPools | None = None
         self.ns_lock: NamespaceLock | None = None
         self.notification: NotificationSys | None = None
+        self._quota_cache = None  # leader-persisted usage tree (non-leaders)
+        self._quota_cache_ts = 0.0
 
     # -- format consensus ----------------------------------------------------
 
@@ -340,6 +342,9 @@ class Node:
         # Cluster-wide watcher streams: listen/trace responses merge every
         # peer's records (ListenNotification + admin trace peer subscription).
         self.s3.peer_notification = self.notification
+        # Hard bucket quotas read the scanner's usage tree
+        # (enforceBucketQuota, cmd/bucket-quota.go:112).
+        self.s3.quota_usage = self._quota_usage
         from ..control.replication import BucketTargetSys, ReplicationSys
 
         self.replication = ReplicationSys(
@@ -363,6 +368,38 @@ class Node:
         )
         self.s3.site_repl = self.site_repl
         return self
+
+    def _quota_usage(self, bucket: str) -> int | None:
+        """Bucket usage bytes for quota enforcement, or None when unknown.
+
+        Only the scan leader populates its in-memory tree; every other node
+        reads the tree the leader persists (scanner/data-usage.json),
+        TTL-cached ~1s like the reference's bucketStorageCache
+        (cmd/bucket-quota.go:72-78). No tree anywhere -> None (enforcement
+        skipped until a first scan completes)."""
+        sc = self.scanner
+        if sc is not None and sc.usage.last_update:
+            return sc.usage.bucket_usage(bucket).size
+        import time as _t
+
+        now = _t.monotonic()
+        if now - self._quota_cache_ts > 1.0:
+            self._quota_cache_ts = now
+            self._quota_cache = None
+            store = getattr(sc, "store", None)
+            if store is not None:
+                try:
+                    raw = store.get("scanner/data-usage.json")
+                    if raw:
+                        from ..control.usage import DataUsageCache
+
+                        self._quota_cache = DataUsageCache.from_bytes(raw)
+                except Exception:  # noqa: BLE001 - unreadable tree = unknown
+                    self._quota_cache = None
+        cache = self._quota_cache
+        if cache is None or not cache.last_update:
+            return None
+        return cache.bucket_usage(bucket).size
 
     def make_app(self) -> web.Application:
         """One aiohttp app: internode routers first, S3 catch-all last
@@ -453,6 +490,11 @@ class _LazyAdminContext:
     @property
     def site_repl(self):
         return getattr(self._node, "site_repl", None)
+
+    @property
+    def bucket_meta(self):
+        s3 = self._node.s3
+        return s3.bucket_meta if s3 is not None else None
 
 
 def _default_set_count(n: int) -> int:

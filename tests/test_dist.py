@@ -383,3 +383,31 @@ class TestCrossNodeListen:
         assert done.wait(15), "peer event never reached node A's watcher"
         rec = got[0]
         assert rec["Records"][0]["s3"]["object"]["key"] == "from-b"
+
+
+class TestClusterQuota:
+    """Quota set through node A is enforced by node B, whose scanner never
+    ran: B reads the leader-persisted usage tree and A's quota write
+    invalidates B's bucket-meta cache (cmd/bucket-quota.go:72-112)."""
+
+    def test_quota_enforced_on_non_leader(self, cluster):
+        import json as _json
+
+        c0, c1 = cluster["clients"]
+        n0, n1 = cluster["nodes"]
+        assert c0.make_bucket("qbkt").status_code in (200, 409)
+        assert c0.put_object("qbkt", "seed", b"x" * 65536).status_code == 200
+        # Warm B's bucket-meta cache so the invalidation matters.
+        c1.get_object("qbkt", "seed")
+        n0.scanner.scan_cycle()  # the leader persists the usage tree
+        r = c0.request(
+            "PUT",
+            "/mtpu/admin/v1/quota",
+            query=[("bucket", "qbkt")],
+            body=_json.dumps({"quota": 70000, "quotatype": "hard"}).encode(),
+        )
+        assert r.status_code == 200, r.text
+        assert n1.scanner.usage.last_update == 0  # B never scanned
+        r = c1.put_object("qbkt", "big", b"y" * 8192)
+        assert r.status_code == 400 and b"XMinioAdminBucketQuotaExceeded" in r.content
+        assert c1.put_object("qbkt", "small", b"z" * 1024).status_code == 200
