@@ -41,6 +41,7 @@ class AdminContext:
     tiering: object | None = None  # TierConfigMgr (tier.go)
     site_repl: object | None = None  # SiteReplicationSys (site-replication.go)
     bucket_meta: object | None = None  # BucketMetadataSys (quota config)
+    kms: object | None = None  # KMS (kms status / key checks)
 
 
 def make_admin_app(ctx: AdminContext) -> web.Application:
@@ -130,6 +131,80 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         if ctx.scanner is None:
             return {}
         return ctx.scanner.usage.summary()
+
+    # -- KMS status (KMSStatusHandler / KMSKeyStatusHandler,
+    # cmd/admin-handlers.go:1267,1305): report the backend and prove the
+    # key works with an encrypt/decrypt roundtrip, as the reference does.
+
+    def _kms_key_check(key_id: str) -> dict:
+        out = {"key-id": key_id or "default"}
+        try:
+            dk = ctx.kms.generate_key(key_id)
+            plain = ctx.kms.decrypt_key(dk.key_id, dk.ciphertext)
+            ok = plain == dk.plaintext
+            out["encryption-err"] = "" if ok else "roundtrip mismatch"
+            out["decryption-err"] = "" if ok else "roundtrip mismatch"
+        except Exception as e:  # noqa: BLE001 - report, never 500
+            out["encryption-err"] = str(e)
+            out["decryption-err"] = ""  # both keys always present (mc parses both)
+        return out
+
+    def h_kms_status(request, body):
+        if ctx.kms is None:
+            raise S3Error("NotImplemented", "no KMS configured")
+        return {**ctx.kms.stat(), "key-check": _kms_key_check("")}
+
+    def h_kms_key_status(request, body):
+        if ctx.kms is None:
+            raise S3Error("NotImplemented", "no KMS configured")
+        return _kms_key_check(request.rel_url.query.get("key-id", ""))
+
+    # -- inspect raw storage files (InspectDataHandler,
+    # cmd/admin-handlers.go:2198): the same file from EVERY drive, zipped,
+    # so operators can diff xl.meta copies across the set. ------------------
+
+    def h_inspect(request, body):
+        import io
+        import zipfile
+
+        q = request.rel_url.query
+        volume, fname = q.get("volume", ""), q.get("file", "")
+        if not volume:
+            raise S3Error("InvalidBucketName")
+        if not fname:
+            raise S3Error("InvalidRequest", "file is required")
+        # Bounded per-copy read: inspect targets metadata-sized files
+        # (xl.meta); a multi-GiB shard file must not be buffered whole from
+        # 16 drives at once. Oversized copies are truncated and marked.
+        CAP = 32 << 20
+        buf = io.BytesIO()
+        found = 0
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+            for pi, pool in enumerate(ctx.layer.pools):
+                for di, d in enumerate(pool.disks):
+                    if d is None:
+                        continue
+                    try:
+                        size = d.stat_file(volume, fname)
+                        raw = (
+                            d.read_all(volume, fname)
+                            if size <= CAP
+                            else d.read_file(volume, fname, 0, CAP)
+                        )
+                    except oerr.StorageError:
+                        continue
+                    found += 1
+                    name = f"pool{pi}/disk{di}/{volume}/{fname}"
+                    if size > CAP:
+                        name += ".truncated"
+                    z.writestr(name, raw)
+        if not found:
+            raise S3Error("NoSuchKey", resource=f"/{volume}/{fname}")
+        return web.Response(
+            body=buf.getvalue(),
+            content_type="application/zip",
+            headers={"Content-Disposition": 'attachment; filename="inspect.zip"'},
+        )
 
     # -- bucket quota (Put/GetBucketQuotaConfigHandler,
     # cmd/admin-bucket-handlers.go:43,83) ------------------------------------
@@ -562,6 +637,9 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_get("/datausage", handler(h_datausage))
     app.router.add_get("/quota", handler(h_get_quota))
     app.router.add_put("/quota", handler(h_set_quota))
+    app.router.add_get("/kms/status", handler(h_kms_status))
+    app.router.add_get("/kms/key/status", handler(h_kms_key_status))
+    app.router.add_get("/inspect", handler(h_inspect))
     app.router.add_get("/config", handler(h_get_config))
     app.router.add_put("/config", handler(h_set_config))
     app.router.add_get("/users", handler(h_list_users))

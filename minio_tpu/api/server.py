@@ -445,12 +445,20 @@ class S3Server:
         q = request.rel_url.query
         action = policy_mod.s3_action("PUT", bucket, key, q)
         await asyncio.to_thread(self._authorize, access_key, action, bucket, key, request)
-        # Quota for streaming bodies: the payload size is the DECODED length
-        # (aws-chunked framing inflates Content-Length); chunked transfers
-        # without either header check with 0, like the reference's unknown-
-        # size path.
+        # Quota for streaming bodies. aws-chunked requests declare the
+        # payload size in x-amz-decoded-content-length (a SIGNED header --
+        # Content-Length includes chunk framing); the header is honored only
+        # for actually-streaming-signed requests so a plain PUT cannot
+        # smuggle a small declared size past the check. Chunked transfers
+        # without a usable size check with 0, like the reference's
+        # unknown-size path.
+        from . import streaming as streaming_mod
+
         decoded = request.headers.get("x-amz-decoded-content-length", "")
-        size = int(decoded) if decoded.isdigit() else (request.content_length or 0)
+        if streaming_mod.is_streaming_request(dict(request.headers)) and decoded.isdigit():
+            size = int(decoded)
+        else:
+            size = request.content_length or 0
         await asyncio.to_thread(self._check_quota, bucket, size)
         if "uploadId" in q and "partNumber" in q:
             return await asyncio.to_thread(
@@ -1685,6 +1693,10 @@ class S3Server:
         meta = self.bucket_meta.get(bucket)
         if meta.quota <= 0 or self.quota_usage is None:
             return
+        # An object at least quota-sized can never fit regardless of how
+        # much is already used -- reject it even before any scan has run.
+        if incoming >= meta.quota:
+            raise S3Error("XMinioAdminBucketQuotaExceeded", resource=f"/{bucket}")
         try:
             used = self.quota_usage(bucket)
         except Exception:  # noqa: BLE001 - usage source down != reject writes

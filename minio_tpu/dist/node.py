@@ -496,6 +496,10 @@ class _LazyAdminContext:
         s3 = self._node.s3
         return s3.bucket_meta if s3 is not None else None
 
+    @property
+    def kms(self):
+        return getattr(self._node, "kms", None)
+
 
 def _default_set_count(n: int) -> int:
     """Largest set size in [4..16] dividing n; else n itself (small rigs).

@@ -287,3 +287,41 @@ class TestBucketQuota:
             body=json.dumps({"quota": 1000, "quotatype": "fifo"}).encode(),
         )
         assert r.status_code == 400
+
+
+class TestKmsAndInspect:
+    """KMS status roundtrip checks + raw-file inspect zip
+    (cmd/admin-handlers.go:1267,1305,2198)."""
+
+    def test_kms_status(self, srv):
+        c = srv["client"]
+        r = c.request("GET", f"{ADMIN}/kms/status")
+        assert r.status_code == 200, r.text
+        st = r.json()
+        assert st["key-check"]["encryption-err"] == ""
+        r = c.request("GET", f"{ADMIN}/kms/key/status", query=[("key-id", "default-key")])
+        assert r.status_code == 200 and r.json()["encryption-err"] == ""
+
+    def test_inspect_xlmeta_from_all_drives(self, srv):
+        import io
+        import zipfile
+
+        c = srv["client"]
+        assert c.make_bucket("insp").status_code in (200, 409)
+        assert c.put_object("insp", "obj", b"inspect-me" * 100).status_code == 200
+        r = c.request(
+            "GET",
+            f"{ADMIN}/inspect",
+            query=[("volume", "insp"), ("file", "obj/xl.meta")],
+        )
+        assert r.status_code == 200, r.text
+        z = zipfile.ZipFile(io.BytesIO(r.content))
+        names = z.namelist()
+        # Every online drive holds a copy of the object's xl.meta.
+        assert len(names) == 4 and all(n.endswith("obj/xl.meta") for n in names)
+        assert all(len(z.read(n)) > 0 for n in names)
+        # Missing files 404.
+        r = c.request(
+            "GET", f"{ADMIN}/inspect", query=[("volume", "insp"), ("file", "nope")]
+        )
+        assert r.status_code == 404
