@@ -137,16 +137,20 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     # key works with an encrypt/decrypt roundtrip, as the reference does.
 
     def _kms_key_check(key_id: str) -> dict:
-        out = {"key-id": key_id or "default"}
+        """Both err fields are always present and name the stage that
+        actually failed (generate/encrypt vs decrypt)."""
+        out = {"key-id": key_id or "default", "encryption-err": "", "decryption-err": ""}
         try:
             dk = ctx.kms.generate_key(key_id)
-            plain = ctx.kms.decrypt_key(dk.key_id, dk.ciphertext)
-            ok = plain == dk.plaintext
-            out["encryption-err"] = "" if ok else "roundtrip mismatch"
-            out["decryption-err"] = "" if ok else "roundtrip mismatch"
         except Exception as e:  # noqa: BLE001 - report, never 500
             out["encryption-err"] = str(e)
-            out["decryption-err"] = ""  # both keys always present (mc parses both)
+            return out
+        try:
+            plain = ctx.kms.decrypt_key(dk.key_id, dk.ciphertext)
+            if plain != dk.plaintext:
+                out["decryption-err"] = "roundtrip mismatch"
+        except Exception as e:  # noqa: BLE001
+            out["decryption-err"] = str(e)
         return out
 
     def h_kms_status(request, body):
@@ -435,31 +439,64 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             "ramp": ramp,
         }
 
-    # -- profiling (admin-handlers.go:511 role, via cProfile) ----------------
+    # -- profiling (admin-handlers.go:511-716 role): start broadcasts to
+    # every peer; stop collects one dump per node -- plain text single-node,
+    # a zip with per-node entries in a cluster. The profiler samples
+    # sys._current_frames() from its own thread (control/profiler.py):
+    # cProfile's per-thread hook enabled inside a request handler would
+    # profile nothing but that handler's executor thread. -------------------
 
     _profiler: dict = {}
 
+    def _peer_clients():
+        n = getattr(ctx, "notification", None)
+        return list(getattr(n, "peers", []) or [])
+
     def h_profile_start(request, body):
-        import cProfile
+        from ..control.profiler import SamplingProfiler
 
         if "p" in _profiler:
             raise S3Error("InvalidArgument", "profiling already running")
-        p = cProfile.Profile()
-        p.enable()
+        p = SamplingProfiler()
+        p.start()
         _profiler["p"] = p
-        return {"ok": True}
+        started = ["local"]
+        for peer in _peer_clients():
+            try:
+                if peer.profile_start().get("ok"):
+                    started.append(peer.url)
+            except oerr.StorageError:
+                continue
+        return {"ok": True, "nodes": started}
 
     def h_profile_stop(request, body):
         import io
-        import pstats
 
         p = _profiler.pop("p", None)
         if p is None:
             raise S3Error("InvalidArgument", "profiling not running")
-        p.disable()
-        buf = io.StringIO()
-        pstats.Stats(p, stream=buf).sort_stats("cumulative").print_stats(50)
-        return web.Response(text=buf.getvalue(), content_type="text/plain")
+        p.stop()
+        text = p.report()
+        peers = _peer_clients()
+        if not peers:
+            return web.Response(text=text, content_type="text/plain")
+        import zipfile
+
+        zbuf = io.BytesIO()
+        with zipfile.ZipFile(zbuf, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("local/profile.txt", text)
+            for peer in peers:
+                try:
+                    peer_text = peer.profile_stop().get("text", "")
+                except oerr.StorageError:
+                    peer_text = ""
+                safe = peer.url.replace("://", "_").replace(":", "_").replace("/", "_")
+                z.writestr(f"{safe}/profile.txt", peer_text)
+        return web.Response(
+            body=zbuf.getvalue(),
+            content_type="application/zip",
+            headers={"Content-Disposition": 'attachment; filename="profiles.zip"'},
+        )
 
     # -- replication remote targets (bucket-targets.go admin surface) --------
 

@@ -1,0 +1,82 @@
+"""Whole-process sampling profiler for the admin profiling API.
+
+cProfile installs a per-thread tracing hook: enabled inside a request
+handler it observes only that one executor thread, so a server profile
+comes back empty. This sampler instead walks ``sys._current_frames()``
+from a dedicated thread at a fixed interval and aggregates collapsed call
+stacks across EVERY thread (event loop, executor workers, erasure I/O,
+batching codec, scanner) -- the role of the reference's pprof CPU profile
+(cmd/admin-handlers.go:511-716), with py-spy-style output.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import Counter
+
+
+class SamplingProfiler:
+    """Start/stop sampler; report() returns a text summary."""
+
+    def __init__(self, interval_s: float = 0.005):
+        self.interval_s = interval_s
+        self._stacks: Counter[str] = Counter()
+        self._samples = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._t0 = 0.0
+        self._elapsed = 0.0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise ValueError("profiler already running")
+        self._stop.clear()
+        self._t0 = time.monotonic()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="prof-sampler")
+        self._thread.start()
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        names = {}
+        while not self._stop.is_set():
+            names.clear()
+            for t in threading.enumerate():
+                names[t.ident] = t.name
+            for tid, frame in sys._current_frames().items():
+                if tid == me:
+                    continue
+                parts = []
+                f = frame
+                depth = 0
+                while f is not None and depth < 48:
+                    code = f.f_code
+                    parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}:{code.co_name}")
+                    f = f.f_back
+                    depth += 1
+                parts.reverse()
+                stack = ";".join(parts)
+                self._stacks[f"[{names.get(tid, tid)}] {stack}"] += 1
+            self._samples += 1
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self._thread = None
+        self._elapsed = time.monotonic() - self._t0
+
+    def report(self, top: int = 60) -> str:
+        lines = [
+            f"sampling profile: {self._samples} samples over "
+            f"{self._elapsed:.1f}s (interval {self.interval_s * 1000:.0f} ms), "
+            "cumulative per-thread collapsed stacks",
+            "",
+        ]
+        for stack, n in self._stacks.most_common(top):
+            pct = 100.0 * n / max(1, self._samples)
+            lines.append(f"{n:7d} {pct:5.1f}%  {stack}")
+        return "\n".join(lines) + "\n"

@@ -105,6 +105,27 @@ def make_peer_app(node, token: str) -> web.Application:
             "get_bytes_per_s": size * count / get_t if get_t else 0,
         }
 
+    # Per-node profiling (peer side of the admin start/download broadcast,
+    # cmd/admin-handlers.go:511-716: every node profiles itself with a
+    # whole-process sampler; the admin node collects one dump per node).
+    def h_profile_start(a):
+        from ..control.profiler import SamplingProfiler
+
+        if getattr(node, "_peer_profiler", None) is not None:
+            return {"ok": False, "error": "already running"}
+        p = SamplingProfiler()
+        p.start()
+        node._peer_profiler = p
+        return {"ok": True}
+
+    def h_profile_stop(a):
+        p = getattr(node, "_peer_profiler", None)
+        node._peer_profiler = None
+        if p is None:
+            return {"text": ""}
+        p.stop()
+        return {"text": p.report()}
+
     # Streaming endpoints: this node's live event / trace records as NDJSON
     # (peer-rest-server.go:985 role) -- the serving node merges these into
     # its watcher responses so `mc watch` / `mc admin trace` see the whole
@@ -140,6 +161,8 @@ def make_peer_app(node, token: str) -> web.Application:
         "reloadbucketmeta": h_reload_bucket_meta,
         "toplocks": h_top_locks,
         "speedtest": h_speedtest,
+        "profilestart": h_profile_start,
+        "profilestop": h_profile_stop,
     }.items():
         app.router.add_post(f"/{name}", handler(fn))
     app.router.add_post("/listen", h_listen_stream)
@@ -173,6 +196,12 @@ class PeerClient:
 
     def speedtest(self, size: int = 1 << 20, count: int = 4) -> dict:
         return self.client.call("/speedtest", {"size": size, "count": count}, timeout=120.0)
+
+    def profile_start(self) -> dict:
+        return self.client.call("/profilestart", {})
+
+    def profile_stop(self) -> dict:
+        return self.client.call("/profilestop", {}, timeout=60.0)
 
     def listen_stream(self):
         """Live event stream from this peer (caller iterates lines + closes).
