@@ -512,8 +512,40 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             doc["accessKey"],
             doc["secretKey"],
             doc.get("region", "us-east-1"),
+            bandwidth=int(doc.get("bandwidth", 0)),
         )
         return {"arn": arn}
+
+    def h_bandwidth(request, body):
+        """Cluster-wide per-target replication bandwidth limits + observed
+        rates (admin-handlers.go:1935 BandwidthMonitor aggregates across
+        nodes): every node throttles its own replica traffic, so rates sum
+        and limits merge across peer reports."""
+        repl = ctx.replication
+        if repl is None:
+            raise S3Error("NotImplemented")
+        bucket = request.rel_url.query.get("bucket", "")
+        merged = repl.bandwidth.report(bucket)
+        for peer in _peer_clients():
+            try:
+                rep = peer.bandwidth(bucket)
+            except oerr.StorageError:
+                continue
+            for b, targets in rep.items():
+                for arn, row in targets.items():
+                    dst = merged.setdefault(b, {}).setdefault(
+                        arn,
+                        {"limitInBytesPerSecond": 0, "currentBandwidthInBytesPerSecond": 0.0},
+                    )
+                    dst["limitInBytesPerSecond"] = max(
+                        dst["limitInBytesPerSecond"], row.get("limitInBytesPerSecond", 0)
+                    )
+                    dst["currentBandwidthInBytesPerSecond"] = round(
+                        dst["currentBandwidthInBytesPerSecond"]
+                        + row.get("currentBandwidthInBytesPerSecond", 0.0),
+                        1,
+                    )
+        return merged
 
     def h_list_targets(request, body):
         repl = ctx.replication
@@ -533,6 +565,8 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             raise S3Error("NotImplemented")
         doc = json.loads(body)
         repl.targets.remove_target(doc["bucket"], doc["arn"])
+        # The bandwidth report must not list the removed target forever.
+        repl.bandwidth.drop(doc["bucket"], doc["arn"])
         return {}
 
     def h_repl_status(request, body):
@@ -674,6 +708,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_get("/datausage", handler(h_datausage))
     app.router.add_get("/quota", handler(h_get_quota))
     app.router.add_put("/quota", handler(h_set_quota))
+    app.router.add_get("/bandwidth", handler(h_bandwidth))
     app.router.add_get("/kms/status", handler(h_kms_status))
     app.router.add_get("/kms/key/status", handler(h_kms_key_status))
     app.router.add_get("/inspect", handler(h_inspect))

@@ -20,8 +20,11 @@ from collections import Counter
 class SamplingProfiler:
     """Start/stop sampler; report() returns a text summary."""
 
-    def __init__(self, interval_s: float = 0.005):
+    def __init__(self, interval_s: float = 0.005, max_duration_s: float = 900.0):
         self.interval_s = interval_s
+        # Safety valve: an orchestration failure (peer stop call lost) must
+        # not leave a sampler walking every thread's frames forever.
+        self.max_duration_s = max_duration_s
         self._stacks: Counter[str] = Counter()
         self._samples = 0
         self._stop = threading.Event()
@@ -41,6 +44,8 @@ class SamplingProfiler:
         me = threading.get_ident()
         names = {}
         while not self._stop.is_set():
+            if time.monotonic() - self._t0 > self.max_duration_s:
+                break
             names.clear()
             for t in threading.enumerate():
                 names[t.ident] = t.name

@@ -60,6 +60,7 @@ class BucketTarget:
     access_key: str
     secret_key: str
     region: str = "us-east-1"
+    bandwidth: int = 0  # replica bytes/s cap, 0 = unlimited (BandwidthLimit)
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -169,6 +170,7 @@ class BucketTargetSys:
         access_key: str,
         secret_key: str,
         region: str = "us-east-1",
+        bandwidth: int = 0,
     ) -> str:
         # Re-registering the same endpoint+bucket (e.g. credential rotation)
         # keeps the existing ARN so replication rules referencing it stay
@@ -191,6 +193,7 @@ class BucketTargetSys:
             access_key=access_key,
             secret_key=secret_key,
             region=region,
+            bandwidth=bandwidth,
         )
         kept.append(t)
         self._store(bucket, kept)
@@ -200,6 +203,20 @@ class BucketTargetSys:
 
     def list_targets(self, bucket: str) -> list[BucketTarget]:
         return self._load(bucket)
+
+    def bandwidth_of(self, bucket: str, arn: str) -> int:
+        """Configured replica bandwidth cap for one target, WITHOUT
+        unsealing secrets -- this sits on the replication worker hot path
+        (per replica PUT), where a KMS decrypt per object would be both
+        slow and a new failure mode."""
+        raw = getattr(self.bucket_meta.get(bucket), "targets_json", "") or "[]"
+        try:
+            for d in json.loads(raw):
+                if d.get("arn") == arn:
+                    return int(d.get("bandwidth", 0) or 0)
+        except (ValueError, TypeError):
+            pass
+        return 0
 
     def remove_target(self, bucket: str, arn: str) -> None:
         self._store(bucket, [t for t in self._load(bucket) if t.arn != arn])
@@ -313,11 +330,16 @@ class ReplicationSys:
     pool draining a task queue, plus an MRF-style retry list for failures."""
 
     def __init__(self, layer, bucket_meta, targets: BucketTargetSys, kms=None, workers: int = 4):
+        from .bandwidth import BandwidthMonitor
+
         self.layer = layer
         self.bucket_meta = bucket_meta
         self.targets = targets
         self.kms = kms
         self.stats = ReplStats()
+        # Per-(bucket, target) replica bandwidth limits + observed rates
+        # (internal/bucket/bandwidth role; limits from BucketTarget.bandwidth).
+        self.bandwidth = BandwidthMonitor()
         self._q: queue.Queue[ReplTask | None] = queue.Queue(maxsize=100_000)
         self._retry: list[ReplTask] = []
         self._retry_lock = threading.Lock()
@@ -601,10 +623,19 @@ class ReplicationSys:
         raw_tags = oi.internal.get("x-internal-tags", "")
         if raw_tags:
             headers["x-amz-tagging"] = raw_tags
+        # Throttle replica traffic against the target's bandwidth limit and
+        # feed the live monitor (internal/bucket/bandwidth role). The limit
+        # is re-read per task (cached bucket meta, no KMS unseal) so an
+        # admin update applies to in-flight queues.
+        self.bandwidth.set_limit(
+            task.bucket, rule.dest_arn, self.targets.bandwidth_of(task.bucket, rule.dest_arn)
+        )
+        self.bandwidth.throttle(task.bucket, rule.dest_arn, len(data))
         r = client.put_object(task.object_name, data, headers)
         ok = r.status_code == 200
         if ok:
             self.stats.add(replicated_bytes=len(data))
+            self.bandwidth.record(task.bucket, rule.dest_arn, len(data))
         return ok
 
     def _set_status(self, task: ReplTask, status: str) -> None:

@@ -111,8 +111,11 @@ def make_peer_app(node, token: str) -> web.Application:
     def h_profile_start(a):
         from ..control.profiler import SamplingProfiler
 
-        if getattr(node, "_peer_profiler", None) is not None:
-            return {"ok": False, "error": "already running"}
+        old = getattr(node, "_peer_profiler", None)
+        if old is not None:
+            # A lost stop call (peer timeout, admin crash) must not wedge
+            # profiling forever: discard the orphan and start fresh.
+            old.stop()
         p = SamplingProfiler()
         p.start()
         node._peer_profiler = p
@@ -125,6 +128,14 @@ def make_peer_app(node, token: str) -> web.Application:
             return {"text": ""}
         p.stop()
         return {"text": p.report()}
+
+    def h_bandwidth(a):
+        """This node's replication bandwidth monitor (merged cluster-wide by
+        the admin endpoint; each node throttles its own replica traffic)."""
+        repl = getattr(node, "replication", None)
+        if repl is None:
+            return {}
+        return repl.bandwidth.report(a.get("bucket", ""))
 
     # Streaming endpoints: this node's live event / trace records as NDJSON
     # (peer-rest-server.go:985 role) -- the serving node merges these into
@@ -163,6 +174,7 @@ def make_peer_app(node, token: str) -> web.Application:
         "speedtest": h_speedtest,
         "profilestart": h_profile_start,
         "profilestop": h_profile_stop,
+        "bandwidth": h_bandwidth,
     }.items():
         app.router.add_post(f"/{name}", handler(fn))
     app.router.add_post("/listen", h_listen_stream)
@@ -196,6 +208,9 @@ class PeerClient:
 
     def speedtest(self, size: int = 1 << 20, count: int = 4) -> dict:
         return self.client.call("/speedtest", {"size": size, "count": count}, timeout=120.0)
+
+    def bandwidth(self, bucket: str = "") -> dict:
+        return self.client.call("/bandwidth", {"bucket": bucket})
 
     def profile_start(self) -> dict:
         return self.client.call("/profilestart", {})

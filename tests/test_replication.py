@@ -363,3 +363,84 @@ class TestReplication:
         assert r.status_code == 200
         r = src["client"].request("GET", f"{ADMIN}/replication/target", query=[("bucket", "pfx")])
         assert r.json() == []
+
+
+class TestBandwidth:
+    """Replication bandwidth limits + monitoring
+    (internal/bucket/bandwidth role, admin-handlers.go:1935)."""
+
+    def test_token_bucket_and_monitor(self):
+        import time as _t
+
+        from minio_tpu.control.bandwidth import BandwidthMonitor, _TokenBucket
+
+        tb = _TokenBucket(100_000)  # 100 KB/s, 100 KB burst
+        assert tb.consume(50_000) == 0.0  # rides the burst
+        t0 = _t.monotonic()
+        tb.consume(100_000)  # must wait for ~50 KB of refill
+        assert _t.monotonic() - t0 >= 0.3
+
+        mon = BandwidthMonitor()
+        mon.set_limit("b", "arn:x", 1_000_000)
+        mon.record("b", "arn:x", 500_000)
+        rep = mon.report()
+        assert rep["b"]["arn:x"]["limitInBytesPerSecond"] == 1_000_000
+        assert rep["b"]["arn:x"]["currentBandwidthInBytesPerSecond"] > 0
+        mon.set_limit("b", "arn:x", 0)  # unlimited clears the throttle
+        assert mon.throttle("b", "arn:x", 10_000_000) == 0.0
+
+    def test_throttled_replication_and_admin_report(self, pair):
+        import time as _t
+
+        src, dst = pair
+        for c in (src["client"], dst["client"]):
+            assert c.make_bucket("bwbkt").status_code in (200, 409)
+        _enable_versioning(src["client"], "bwbkt")
+        _enable_versioning(dst["client"], "bwbkt")
+        # Target with a 64 KB/s cap.
+        r = src["client"].request(
+            "POST",
+            f"{ADMIN}/replication/target",
+            body=json.dumps(
+                {
+                    "bucket": "bwbkt",
+                    "endpoint": dst["url"],
+                    "targetBucket": "bwbkt",
+                    "accessKey": ROOT,
+                    "secretKey": SECRET,
+                    "bandwidth": 64_000,
+                }
+            ).encode(),
+        )
+        assert r.status_code == 200, r.text
+        arn = r.json()["arn"]
+        xml = (
+            '<ReplicationConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+            "<Role></Role><Rule><ID>bw</ID><Status>Enabled</Status><Priority>1</Priority>"
+            "<DeleteMarkerReplication><Status>Enabled</Status></DeleteMarkerReplication>"
+            "<Filter><Prefix></Prefix></Filter>"
+            f"<Destination><Bucket>{arn}</Bucket></Destination></Rule>"
+            "</ReplicationConfiguration>"
+        )
+        assert (
+            src["client"]
+            .request("PUT", "/bwbkt", query=[("replication", "")], body=xml.encode())
+            .status_code
+            == 200
+        )
+        # 192 KB at 64 KB/s with a 64 KB burst: >= ~1.5s of throttle.
+        t0 = _t.monotonic()
+        assert src["client"].put_object("bwbkt", "big", b"z" * 192_000).status_code == 200
+        deadline = _t.monotonic() + 20
+        while _t.monotonic() < deadline:
+            if dst["client"].get_object("bwbkt", "big").status_code == 200:
+                break
+            _t.sleep(0.25)
+        assert dst["client"].get_object("bwbkt", "big").content == b"z" * 192_000
+        assert _t.monotonic() - t0 >= 1.0  # the cap actually delayed the replica
+        # Admin bandwidth report shows the limit and a nonzero observed rate.
+        r = src["client"].request("GET", f"{ADMIN}/bandwidth", query=[("bucket", "bwbkt")])
+        assert r.status_code == 200, r.text
+        rep = r.json()["bwbkt"][arn]
+        assert rep["limitInBytesPerSecond"] == 64_000
+        assert rep["currentBandwidthInBytesPerSecond"] > 0
