@@ -43,9 +43,59 @@ def name_to_error(name: str, msg: str = "") -> Exception:
     return errors.StorageError(f"{name}: {msg}")
 
 
+class DynamicTimeout:
+    """Self-tuning per-channel timeout (cmd/dynamic-timeouts.go:36).
+
+    Every 16 outcomes: >33% failures -> grow the timeout 25%; <10%
+    failures -> shrink 50% of the way toward 1.25x the slowest observed
+    success, floored at `minimum`. Healthy fast channels converge to
+    tight timeouts (peers drop quickly), congested ones back off instead
+    of flapping."""
+
+    LOG_SIZE = 16
+    MAX_TIMEOUT = 24 * 3600.0
+    _FAILURE = float("inf")
+
+    def __init__(self, timeout: float, minimum: float):
+        self._timeout = timeout
+        self.minimum = min(minimum, timeout)
+        self._log: list[float] = []
+        self._lock = threading.Lock()
+
+    def timeout(self) -> float:
+        return self._timeout
+
+    def log_success(self, duration: float) -> None:
+        self._entry(duration)
+
+    def log_failure(self) -> None:
+        self._entry(self._FAILURE)
+
+    def _entry(self, duration: float) -> None:
+        # The whole read-adjust-write runs under the lock: two windows
+        # completing concurrently must not lose an adjustment.
+        with self._lock:
+            self._log.append(duration)
+            if len(self._log) < self.LOG_SIZE:
+                return
+            entries, self._log = self._log, []
+            failures = sum(1 for d in entries if d == self._FAILURE)
+            slowest = max((d for d in entries if d != self._FAILURE), default=0.0)
+            fail_pct = failures / len(entries)
+            t = self._timeout
+            if fail_pct > 0.33:
+                t = min(t * 1.25, self.MAX_TIMEOUT)
+            elif fail_pct < 0.10:
+                target = slowest * 1.25
+                if target < t:
+                    t = max((target + t) / 2, self.minimum)
+            self._timeout = t
+
+
 class RestClient:
-    """HTTP client to one peer with connection reuse, failure tracking and
-    periodic reconnect probing (internal/rest/client.go behavior)."""
+    """HTTP client to one peer with connection reuse, failure tracking,
+    periodic reconnect probing, and a self-tuning default timeout
+    (internal/rest/client.go + dynamic-timeouts.go behavior)."""
 
     HEALTH_INTERVAL = 3.0
 
@@ -53,6 +103,13 @@ class RestClient:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        # One tuner PER ENDPOINT PATH: a ping and a bulk shard read must
+        # not share a timeout (the reference keeps separate dynamicTimeouts
+        # per operation class for the same reason). Floor at 5s so fast
+        # metadata traffic can't shrink an op class under what a loaded
+        # server legitimately needs.
+        self._tuners: dict[str, DynamicTimeout] = {}
+        self._tuners_lock = threading.Lock()
         self.session = requests.Session()
         self.session.headers[TOKEN_HEADER] = token
         self._online = True
@@ -87,13 +144,28 @@ class RestClient:
         Returns the msgpack-decoded object, raw bytes if raw_response, or
         the live response when stream=True (caller iterates + closes)."""
         url = self.base_url + path
+        # Explicit timeouts win; plain calls ride the endpoint's self-tuned
+        # timeout. Streams are long-lived by design and excluded from tuning.
+        tune = timeout is None and not stream
+        dt: DynamicTimeout | None = None
+        if tune:
+            with self._tuners_lock:
+                dt = self._tuners.get(path)
+                if dt is None:
+                    dt = self._tuners[path] = DynamicTimeout(
+                        self.timeout, minimum=min(5.0, self.timeout)
+                    )
+        effective = timeout if timeout is not None else (
+            self.timeout if stream else dt.timeout()
+        )
+        t0 = time.monotonic()
         try:
             if body is not None:
                 r = self.session.post(
                     url,
                     params={k: str(v) for k, v in (args or {}).items()},
                     data=body,
-                    timeout=timeout or self.timeout,
+                    timeout=effective,
                     stream=stream,
                 )
             else:
@@ -101,13 +173,20 @@ class RestClient:
                     url,
                     data=msgpack.packb(args or {}, use_bin_type=True),
                     headers={"Content-Type": "application/x-msgpack"},
-                    timeout=timeout or self.timeout,
+                    timeout=effective,
                     stream=stream,
                 )
         except requests.RequestException as e:
             self._mark(False)
+            # Only TIMEOUTS are evidence the timeout is too small; an
+            # instant connection-refused from a down peer says nothing
+            # about sizing and must not ratchet the timeout up.
+            if dt is not None and isinstance(e, requests.Timeout):
+                dt.log_failure()
             raise errors.DiskNotFound(f"{url}: {e}")
         self._mark(True)
+        if dt is not None:
+            dt.log_success(time.monotonic() - t0)
         if r.status_code != 200:
             name = r.headers.get(ERROR_HEADER, "StorageError")
             text = r.text[:200]

@@ -438,3 +438,37 @@ class TestClusterProfiling:
         names = z.namelist()
         assert len(names) == 2 and any(n.startswith("local/") for n in names)
         assert "cumulative" in z.read([n for n in names if n.startswith("local/")][0]).decode()
+
+
+class TestDynamicTimeout:
+    """Self-tuning channel timeout (cmd/dynamic-timeouts.go:36 semantics)."""
+
+    def test_adjusts_both_ways(self):
+        from minio_tpu.dist.transport import DynamicTimeout
+
+        dt = DynamicTimeout(30.0, minimum=1.0)
+        # 16 fast successes: shrinks halfway toward 1.25x the slowest.
+        for _ in range(16):
+            dt.log_success(0.08)
+        assert dt.timeout() == pytest.approx((30.0 + 0.1) / 2)
+        # Sustained failures (> 33%): grows 25% per window.
+        before = dt.timeout()
+        for _ in range(16):
+            dt.log_failure()
+        assert dt.timeout() == pytest.approx(before * 1.25)
+        # Mixed window under the failure threshold keeps shrinking, floored
+        # at the minimum.
+        for _ in range(200):
+            dt.log_success(0.01)
+        assert dt.timeout() >= 1.0
+
+    def test_rest_client_uses_tuned_timeout(self, cluster):
+        node0 = cluster["nodes"][0]
+        peer = PeerClient(cluster["urls"][1], node0.token)
+        for _ in range(20):
+            assert peer.ping()
+        # 16+ fast pings tuned the /ping endpoint's own timeout downward;
+        # other endpoints are untouched (per-endpoint tuners).
+        tuner = peer.client._tuners["/ping"]
+        assert tuner.timeout() < peer.client.timeout
+        assert "/serverinfo" not in peer.client._tuners
