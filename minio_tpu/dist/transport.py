@@ -178,10 +178,15 @@ class RestClient:
                 )
         except requests.RequestException as e:
             self._mark(False)
-            # Only TIMEOUTS are evidence the timeout is too small; an
-            # instant connection-refused from a down peer says nothing
-            # about sizing and must not ratchet the timeout up.
-            if dt is not None and isinstance(e, requests.Timeout):
+            # Only READ timeouts are evidence the timeout is too small; a
+            # down peer (connection-refused = ConnectionError, blackholed =
+            # ConnectTimeout) says nothing about sizing and must not
+            # ratchet the timeout toward the cap during an outage.
+            if (
+                dt is not None
+                and isinstance(e, requests.Timeout)
+                and not isinstance(e, requests.ConnectTimeout)
+            ):
                 dt.log_failure()
             raise errors.DiskNotFound(f"{url}: {e}")
         self._mark(True)

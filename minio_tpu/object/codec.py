@@ -283,9 +283,10 @@ class DeviceCodec(BlockCodec):
             s = sizes[i]
             shards_i = all_shards[i, :, :s]
             # Padded-batch digests are only valid when every block shares the
-            # padded length; hash at true length instead (host-vectorized
-            # when lengths are uniform this never triggers; see batching).
-            digests = hh.hash256_batch(np.ascontiguousarray(shards_i))
+            # padded length; hash at true length instead, via the host
+            # codec's kernel (AVX2 when built -- the numpy oracle here would
+            # silently cost ~10x on every mixed-size device batch).
+            digests = self._host._digests(np.ascontiguousarray(shards_i))
             out.append(
                 (
                     [shards_i[j].tobytes() for j in range(k + m)],
