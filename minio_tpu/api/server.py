@@ -629,6 +629,11 @@ class S3Server:
                 return await asyncio.to_thread(
                     self._put_bucket_config, bucket, "encryption_xml", body
                 )
+            if "replication-reset" in q:
+                # ResetBucketReplicationState (MinIO extension,
+                # api-router.go:420): requeue existing objects for
+                # replication to the configured targets.
+                return await asyncio.to_thread(self._replication_reset, bucket)
             if "replication" in q:
                 return await asyncio.to_thread(
                     self._put_bucket_config, bucket, "replication_xml", body
@@ -1042,6 +1047,20 @@ class S3Server:
             f'<PolicyStatus xmlns="{XML_NS}">'
             f"<IsPublic>{'TRUE' if public else 'FALSE'}</IsPublic></PolicyStatus>"
         )
+
+    def _replication_reset(self, bucket: str) -> web.Response:
+        """ResetBucketReplicationStateHandler role: resync existing objects
+        to every rule-enabled target (bucket-replication.go resync). A
+        bucket with no replication config errors rather than silently
+        queueing nothing, as the reference does."""
+        self.layer.get_bucket_info(bucket)
+        if self.replication is None:
+            raise S3Error("NotImplemented")
+        meta = self.bucket_meta.get(bucket)
+        if not meta.replication_xml:
+            raise S3Error("ReplicationConfigurationNotFoundError", resource=f"/{bucket}")
+        n = self.replication.resync(bucket)
+        return web.json_response({"queued": n})
 
     def _replication_metrics(self, bucket: str) -> web.Response:
         """GetBucketReplicationMetricsHandler role: live counters from the

@@ -270,7 +270,7 @@ def s3_action(method: str, bucket: str, key: str, query: dict[str, str]) -> str:
                 return "s3:PutLifecycleConfiguration"
             if "encryption" in query:
                 return "s3:PutEncryptionConfiguration"
-            if "replication" in query:
+            if "replication" in query or "replication-reset" in query:
                 return "s3:PutReplicationConfiguration"
             if "notification" in query:
                 return "s3:PutBucketNotification"

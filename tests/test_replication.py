@@ -444,3 +444,45 @@ class TestBandwidth:
         rep = r.json()["bwbkt"][arn]
         assert rep["limitInBytesPerSecond"] == 64_000
         assert rep["currentBandwidthInBytesPerSecond"] > 0
+
+
+class TestReplicationReset:
+    """PUT ?replication-reset resyncs existing objects
+    (ResetBucketReplicationStateHandler, api-router.go:420)."""
+
+    def test_reset_requeues_existing(self, pair):
+        import time as _t
+
+        src, dst = pair
+        for c in (src["client"], dst["client"]):
+            assert c.make_bucket("rstbkt").status_code in (200, 409)
+        _enable_versioning(src["client"], "rstbkt")
+        _enable_versioning(dst["client"], "rstbkt")
+        # Object written BEFORE any replication config exists.
+        assert src["client"].put_object("rstbkt", "pre-existing", b"old data").status_code == 200
+        _configure(
+            src,
+            dst,
+            "rstbkt",
+            extra_rule_xml=(
+                "<ExistingObjectReplication><Status>Enabled</Status>"
+                "</ExistingObjectReplication>"
+            ),
+        )
+        assert dst["client"].get_object("rstbkt", "pre-existing").status_code == 404
+        r = src["client"].request("PUT", "/rstbkt", query=[("replication-reset", "")])
+        assert r.status_code == 200, r.text
+        assert r.json()["queued"] >= 1
+        deadline = _t.monotonic() + 15
+        while _t.monotonic() < deadline:
+            if dst["client"].get_object("rstbkt", "pre-existing").status_code == 200:
+                break
+            _t.sleep(0.25)
+        assert dst["client"].get_object("rstbkt", "pre-existing").content == b"old data"
+
+    def test_reset_without_config_errors(self, pair):
+        src, _ = pair
+        assert src["client"].make_bucket("norepl").status_code in (200, 409)
+        r = src["client"].request("PUT", "/norepl", query=[("replication-reset", "")])
+        assert r.status_code == 404
+        assert b"ReplicationConfigurationNotFoundError" in r.content
