@@ -270,7 +270,12 @@ def s3_action(method: str, bucket: str, key: str, query: dict[str, str]) -> str:
                 return "s3:PutLifecycleConfiguration"
             if "encryption" in query:
                 return "s3:PutEncryptionConfiguration"
-            if "replication" in query or "replication-reset" in query:
+            if "replication-reset" in query:
+                # Separate action from config writes, as in the reference:
+                # a resync re-sends every existing object (bandwidth-heavy)
+                # and must be grantable/deniable independently.
+                return "s3:ResetBucketReplicationState"
+            if "replication" in query:
                 return "s3:PutReplicationConfiguration"
             if "notification" in query:
                 return "s3:PutBucketNotification"

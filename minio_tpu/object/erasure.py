@@ -20,6 +20,7 @@ from __future__ import annotations
 import hashlib
 import io
 import os
+import threading
 import uuid
 from typing import Iterator
 
@@ -84,6 +85,41 @@ def _iter_blocks(reader, first: bytes) -> Iterator[bytes]:
         del buf[:BLOCK_SIZE]
     if buf:
         yield bytes(buf)
+
+
+class _PipelinedMD5:
+    """ETag MD5 computed on a side thread, overlapping the encode+hash C
+    calls (both release the GIL): on multi-core hosts the ~0.6 GiB/s MD5
+    disappears from the PUT critical path; the reference gets the same
+    overlap from its io.Pipe'd hash.Reader stage (object-api-utils.go)."""
+
+    def __init__(self):
+        import queue as _q
+
+        self._h = hashlib.md5()
+        self._q: "_q.Queue[bytes | None]" = _q.Queue(maxsize=32)
+        self._t = threading.Thread(target=self._run, daemon=True, name="etag-md5")
+        self._t.start()
+
+    def _run(self) -> None:
+        while True:
+            b = self._q.get()
+            if b is None:
+                return
+            self._h.update(b)
+
+    def update(self, block: bytes) -> None:
+        self._q.put(block)
+
+    def shutdown(self) -> None:
+        """Stop the worker without a digest (failed put)."""
+        if self._t.is_alive():
+            self._q.put(None)
+            self._t.join()
+
+    def hexdigest(self) -> str:
+        self.shutdown()
+        return self._h.hexdigest()
 
 
 class ShardStageWriter:
@@ -592,7 +628,6 @@ class ErasureObjects:
         data_dir = str(uuid.uuid4())
         upload_id = str(uuid.uuid4())
         write_quorum = k + 1 if k == m else k
-        md5h = None if opts.etag else hashlib.md5()
         disks = self._online()
         size = 0
 
@@ -622,6 +657,18 @@ class ErasureObjects:
 
             meta_mod.parallel_map(rm, list(indices))
 
+        # Pipelined etag only helps when a second core can actually run it
+        # (affinity-aware, not host core count); on one core the handoff
+        # queue is pure overhead (~6% measured). Created immediately before
+        # the try so every failure path reaches the shutdown handler.
+        if opts.etag:
+            md5h = None
+        else:
+            try:
+                cores = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                cores = os.cpu_count() or 1
+            md5h = _PipelinedMD5() if cores > 1 else hashlib.md5()
         try:
             writer.create()
             group: list[bytes] = []
@@ -643,6 +690,8 @@ class ErasureObjects:
                     bucket, object_name, f"write quorum {write_quorum} lost mid-stream"
                 )
         except BaseException:
+            if isinstance(md5h, _PipelinedMD5):
+                md5h.shutdown()  # never leak the etag thread on a failed put
             cleanup(range(n))
             raise
 
