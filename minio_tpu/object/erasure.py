@@ -98,6 +98,7 @@ class _PipelinedMD5:
 
         self._h = hashlib.md5()
         self._q: "_q.Queue[bytes | None]" = _q.Queue(maxsize=32)
+        self._error: BaseException | None = None
         self._t = threading.Thread(target=self._run, daemon=True, name="etag-md5")
         self._t.start()
 
@@ -106,9 +107,18 @@ class _PipelinedMD5:
             b = self._q.get()
             if b is None:
                 return
-            self._h.update(b)
+            try:
+                self._h.update(b)
+            except BaseException as e:  # noqa: BLE001 - surfaced to the PUT
+                # Keep draining so the producer never blocks on a full
+                # queue; the error re-raises at the next update/hexdigest
+                # (a dead worker silently truncating the ETag would persist
+                # a wrong digest with a 200).
+                self._error = e
 
     def update(self, block: bytes) -> None:
+        if self._error is not None:
+            raise self._error
         self._q.put(block)
 
     def shutdown(self) -> None:
@@ -119,7 +129,19 @@ class _PipelinedMD5:
 
     def hexdigest(self) -> str:
         self.shutdown()
+        if self._error is not None:
+            raise self._error
         return self._h.hexdigest()
+
+
+def make_etag_md5():
+    """Pipelined MD5 when a second core can actually run it (affinity-aware);
+    plain hashlib on one core where the handoff queue is pure overhead."""
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    return _PipelinedMD5() if cores > 1 else hashlib.md5()
 
 
 class ShardStageWriter:
@@ -657,18 +679,9 @@ class ErasureObjects:
 
             meta_mod.parallel_map(rm, list(indices))
 
-        # Pipelined etag only helps when a second core can actually run it
-        # (affinity-aware, not host core count); on one core the handoff
-        # queue is pure overhead (~6% measured). Created immediately before
-        # the try so every failure path reaches the shutdown handler.
-        if opts.etag:
-            md5h = None
-        else:
-            try:
-                cores = len(os.sched_getaffinity(0))
-            except (AttributeError, OSError):
-                cores = os.cpu_count() or 1
-            md5h = _PipelinedMD5() if cores > 1 else hashlib.md5()
+        # Created immediately before the try so every failure path reaches
+        # the shutdown handler.
+        md5h = None if opts.etag else make_etag_md5()
         try:
             writer.create()
             group: list[bytes] = []

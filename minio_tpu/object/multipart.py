@@ -103,7 +103,14 @@ class MultipartManager:
         same way). The part stages under a tmp name and is published with a
         rename, so a re-upload of the same part number never leaves a
         half-written file behind."""
-        from .erasure import GROUP_BLOCKS, ShardStageWriter, _as_reader, _iter_blocks
+        from .erasure import (
+            GROUP_BLOCKS,
+            ShardStageWriter,
+            _PipelinedMD5,
+            _as_reader,
+            _iter_blocks,
+            make_etag_md5,
+        )
 
         if not (1 <= part_number <= MAX_PARTS):
             raise errors.InvalidArgument(bucket, object_name, "bad part number")
@@ -112,7 +119,6 @@ class MultipartManager:
         n = self.eo.drive_count
         k, m = self._geometry(meta_doc)
         distribution = hash_order(f"{bucket}/{object_name}", n)
-        md5h = hashlib.md5()
         reader = _as_reader(data)
         udir = _upload_dir(bucket, object_name, upload_id)
         stage = f"part.{part_number}.tmp.{uuid.uuid4().hex[:8]}"
@@ -135,6 +141,7 @@ class MultipartManager:
 
             meta_mod.parallel_map(rm, list(range(n)))
 
+        md5h = make_etag_md5()  # pipelined on multi-core (part etag)
         try:
             writer.create()
             group: list[bytes] = []
@@ -153,6 +160,8 @@ class MultipartManager:
             if writer.alive() < write_quorum:
                 raise errors.ErasureWriteQuorum(bucket, object_name, "upload part quorum")
         except BaseException:
+            if isinstance(md5h, _PipelinedMD5):
+                md5h.shutdown()
             cleanup()
             raise
 
