@@ -1074,7 +1074,7 @@ class S3Server:
                 "completed": st.completed,
                 "failed": st.failed,
                 "replicated_bytes": st.replicated_bytes,
-                "pending": self.replication.pending(),
+                "pending": self.replication.pending,
             }
         )
 

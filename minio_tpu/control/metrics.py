@@ -65,6 +65,7 @@ class MetricsSys:
         self.encode_device_ns = 0
         self.start_time = time.time()
         self.layer = None  # set by the server for storage gauges
+        self.replication = None  # ReplicationSys for replication gauges
 
     # -- recording -----------------------------------------------------------
 
@@ -185,6 +186,26 @@ class MetricsSys:
             metric("minio_tpu_cluster_capacity_raw_free_bytes", free)
             metric("minio_tpu_cluster_drives_online_total", online)
             metric("minio_tpu_cluster_drives_offline_total", offline)
+
+        repl = self.replication
+        if repl is not None:
+            st = repl.stats
+            metric("minio_tpu_replication_completed_total", st.completed,
+                   help_="Replica operations completed.")
+            metric("minio_tpu_replication_failed_total", st.failed)
+            metric("minio_tpu_replication_sent_bytes", st.replicated_bytes)
+            metric("minio_tpu_replication_pending_total", repl.pending)
+            for bucket, targets in repl.bandwidth.report().items():
+                for arn, row in targets.items():
+                    labels = {"bucket": bucket, "arn": arn}
+                    metric(
+                        "minio_tpu_replication_link_limit_bytes_per_second",
+                        row["limitInBytesPerSecond"], labels,
+                    )
+                    metric(
+                        "minio_tpu_replication_link_bytes_per_second",
+                        row["currentBandwidthInBytesPerSecond"], labels,
+                    )
         return "\n".join(lines) + "\n"
 
 

@@ -354,6 +354,7 @@ class Node:
             kms=self.kms,
         )
         self.s3.replication = self.replication
+        self.metrics.replication = self.replication
         from ..control.site_replication import SiteReplicationSys
 
         self.site_repl = SiteReplicationSys(
