@@ -30,44 +30,105 @@ from ..ops import rs, rs_matrix
 from ..parallel import mesh as mesh_lib
 
 
-_PALLAS_HASH_OK: bool | None = None
+# Per-backend hash-kernel selection, cached after one probe+timing pass:
+# {"choice": "pallas"|"xla", "pallas_ok": bool, "pallas_gibs": float,
+#  "xla_gibs": float, "detail": str}
+_HASH_SELECT: dict[str, dict] = {}
+
+# Production chunk length: the per-shard slice a 1 MiB block / 12 data
+# shards produces (cmd/erasure-utils.go shard math) — the length every
+# serving PutObject actually hashes. Probing at toy sizes let a kernel
+# that lowers at 8 packets but breaks at the real multi-step grid pass.
+_PROBE_CHUNK = rs_matrix.shard_size(1 << 20, 12)
 
 
-def _pallas_hash_works() -> bool:
-    """One-time probe: the Pallas hash kernel must actually lower on this
-    backend AND match the host oracle before the serving path may select
-    it (Mosaic op support varies by release; a kernel that fails to lower
-    must degrade to the XLA scan, not crash every PutObject)."""
-    global _PALLAS_HASH_OK
-    if _PALLAS_HASH_OK is None:
-        try:
-            from ..ops import highwayhash as hh_host
-            from ..ops import highwayhash_pallas as hhp
+def _probe_and_time_hash(backend: str) -> dict:
+    """Correctness-probe the Pallas hash at PRODUCTION chunk size, then time
+    it against the XLA scan and select by measurement.
 
-            probe = np.arange(2 * 256, dtype=np.uint8).reshape(2, 256)  # 8 packets: kernel path
-            got = np.asarray(hhp.hash256_batch(probe))
-            want = hh_host.hash256_batch(probe)
-            _PALLAS_HASH_OK = np.array_equal(got, want)
-        except Exception:  # noqa: BLE001 - any lowering/runtime failure
-            _PALLAS_HASH_OK = False
-    return _PALLAS_HASH_OK
+    The Pallas kernel must (a) lower on this backend (Mosaic op support
+    varies by release) and (b) match the host oracle bit-for-bit at the
+    real ~87 KiB serving chunk length — a multi-step grid, not the 8-packet
+    toy shape round 3 probed — before it may serve. A kernel that fails
+    either degrades to the XLA scan rather than crashing every PutObject.
+    """
+    sel = {"choice": "xla", "pallas_ok": False, "pallas_gibs": 0.0,
+           "xla_gibs": 0.0, "detail": ""}
+    if backend not in ("tpu", "axon"):
+        # On CPU the Pallas kernel only runs in interpret mode — a pure-
+        # Python emulation orders of magnitude slower than compiled XLA,
+        # not a serving-grade candidate; timing it at 87 KiB would stall
+        # server boot for minutes to confirm a foregone conclusion.
+        sel["detail"] = f"backend={backend}: pallas=interpret-only, xla serves"
+        return sel
+    import time as _time
+
+    from ..ops import highwayhash as hh_host
+    from ..ops import highwayhash_pallas as hhp
+
+    rng = np.random.default_rng(7)
+    probe = rng.integers(0, 256, (2, _PROBE_CHUNK), dtype=np.uint8)
+    try:
+        got = np.asarray(hhp.hash256_batch(probe))
+        want = hh_host.hash256_batch(probe)
+        sel["pallas_ok"] = np.array_equal(got, want)
+        if not sel["pallas_ok"]:
+            sel["detail"] = f"pallas mismatch at L={_PROBE_CHUNK}"
+            return sel
+    except Exception as e:  # noqa: BLE001 - any lowering/runtime failure
+        sel["detail"] = f"pallas probe failed: {type(e).__name__}: {e}"[:300]
+        return sel
+
+    # Both correct — pick by measured throughput at the serving shape.
+    timing = rng.integers(0, 256, (16, _PROBE_CHUNK), dtype=np.uint8)
+    dev = jax.device_put(jnp.asarray(timing))
+    nbytes = timing.size
+
+    def _gibs(fn):
+        jax.block_until_ready(fn(dev))  # compile
+        t0 = _time.perf_counter()
+        iters = 4
+        for _ in range(iters):
+            out = fn(dev)
+        jax.block_until_ready(out)
+        return nbytes * iters / (_time.perf_counter() - t0) / (1 << 30)
+
+    try:
+        sel["pallas_gibs"] = _gibs(jax.jit(hhp.hash256_batch))
+        sel["xla_gibs"] = _gibs(jax.jit(hhj.hash256_batch))
+    except Exception as e:  # noqa: BLE001
+        sel["detail"] = f"timing failed: {type(e).__name__}: {e}"[:300]
+        return sel
+    sel["choice"] = "pallas" if sel["pallas_gibs"] >= sel["xla_gibs"] else "xla"
+    sel["detail"] = (
+        f"measured @L={_PROBE_CHUNK}: pallas={sel['pallas_gibs']:.2f} "
+        f"xla={sel['xla_gibs']:.2f} GiB/s -> {sel['choice']}"
+    )
+    return sel
+
+
+def hash_selection() -> dict:
+    """The cached per-backend probe+timing verdict (for diagnostics/bench)."""
+    backend = jax.default_backend()
+    if backend not in _HASH_SELECT:
+        _HASH_SELECT[backend] = _probe_and_time_hash(backend)
+    return _HASH_SELECT[backend]
 
 
 def hash_batch_fn():
     """The device hash implementation the pipeline serves with.
 
-    MINIO_TPU_HASH = xla | pallas | auto (default). Auto picks the Pallas
-    VMEM-chain kernel on real TPU (the scan version pays a while-loop
-    dispatch per packet chunk) — but only after a live probe confirms it
-    lowers and matches the oracle; the XLA scan serves elsewhere (Pallas
-    interpret mode on CPU is far slower than compiled XLA).
+    MINIO_TPU_HASH = xla | pallas | auto (default). Auto probes the Pallas
+    VMEM-chain kernel at the production chunk size against the host oracle,
+    times it against the XLA scan, and serves with whichever measured
+    faster — cached per backend. The XLA scan serves on CPU (Pallas
+    interpret mode is not a compiled candidate) and whenever the probe or
+    timing fails.
     """
     mode = os.environ.get("MINIO_TPU_HASH", "auto").lower()
     if mode == "xla":
         return hhj.hash256_batch
-    if mode == "pallas" or (
-        jax.default_backend() in ("tpu", "axon") and _pallas_hash_works()
-    ):
+    if mode == "pallas" or hash_selection()["choice"] == "pallas":
         from ..ops import highwayhash_pallas as hhp
 
         return hhp.hash256_batch
@@ -109,12 +170,15 @@ class ErasurePipeline:
     def _build_encode(self):
         geom = self.geom
         mesh = self.mesh
+        # Resolved at build time so the probe+timing selection pass runs
+        # here, as plain device work — never inside a jit trace.
+        hash_fn = hash_batch_fn()
 
         def encode_step(data_shards: jax.Array):
             """[B, K, S] -> ([B, K+M, S] shards, [B, K+M, 32] digests)."""
             all_shards = self.codec.encode_all(data_shards)
             b, t, s = all_shards.shape
-            digests = hash_batch_fn()(all_shards.reshape(b * t, s)).reshape(b, t, 32)
+            digests = hash_fn(all_shards.reshape(b * t, s)).reshape(b, t, 32)
             return all_shards, digests
 
         if mesh is None:
@@ -136,6 +200,10 @@ class ErasurePipeline:
                 "silently drop digests"
             )
         w_parity = rs.parity_weights(geom.data, geom.parity)
+        # hash_fn (resolved above, outside the shard_map trace) gives
+        # multi-chip serving the same measured-fastest kernel as
+        # single-device — round 4 hardcoded the XLA scan here, silently
+        # dropping the Pallas kernel on the scaling path.
 
         def encode_local(data_local: jax.Array):
             # data_local: [B/dp, K, S/sp], replicated over tp.
@@ -151,7 +219,7 @@ class ErasurePipeline:
             t_loc = x.shape[1] // tp
             ti = jax.lax.axis_index("tp")
             x = jax.lax.dynamic_slice_in_dim(x, ti * t_loc, t_loc, axis=1)
-            digests = hhj.hash256_batch(x.reshape(-1, x.shape[-1])).reshape(
+            digests = hash_fn(x.reshape(-1, x.shape[-1])).reshape(
                 x.shape[0], t_loc, 32
             )
             return all_local, digests
@@ -192,7 +260,11 @@ class ErasurePipeline:
         hash halves the device work on that path; heal keeps it fused.
         """
         w = jnp.asarray(self._recon_weights(present, want))
-        return _reconstruct_step(survivors, w, with_digests)
+        # hash_fn resolved here (probe runs outside the trace) and passed as
+        # a static arg: both candidates are stable module-level functions, so
+        # the jit cache keys cleanly on the selection.
+        hash_fn = hash_batch_fn() if with_digests else None
+        return _reconstruct_step(survivors, w, hash_fn)
 
     def verify_digests(self, shards) -> jax.Array:
         """[B, T, S] shards -> [B, T, 32] digests (for bitrot deep-scan)."""
@@ -201,10 +273,10 @@ class ErasurePipeline:
 
 
 @functools.partial(jax.jit, static_argnums=(2,))
-def _reconstruct_step(survivors: jax.Array, w_bits: jax.Array, with_digests: bool):
+def _reconstruct_step(survivors: jax.Array, w_bits: jax.Array, hash_fn):
     rebuilt = rs.gf_matmul(survivors, w_bits)
-    if not with_digests:
+    if hash_fn is None:
         return rebuilt, None
     b, r, s = rebuilt.shape
-    digests = hash_batch_fn()(rebuilt.reshape(b * r, s)).reshape(b, r, 32)
+    digests = hash_fn(rebuilt.reshape(b * r, s)).reshape(b, r, 32)
     return rebuilt, digests
