@@ -182,7 +182,6 @@ class DRWMutex:
         self.on_lost = on_lost
         self._held: list[int] = []  # locker indexes we hold
         self._writer = False
-        self._refresher: threading.Thread | None = None
         self._stop = threading.Event()
         self.lost = threading.Event()
 
@@ -219,6 +218,7 @@ class DRWMutex:
 
     def release(self) -> None:
         self._stop.set()
+        _refresh_daemon.unregister(self)
         for i in self._held:
             try:
                 self.lockers[i].unlock(self.resource, self.uid)
@@ -228,29 +228,28 @@ class DRWMutex:
 
     def _start_refresher(self) -> None:
         self._stop.clear()
+        _refresh_daemon.register(self)
 
-        def loop():
-            while not self._stop.wait(REFRESH_INTERVAL):
-                ok = 0
-                for i in list(self._held):
-                    try:
-                        if self.lockers[i].refresh(self.resource, self.uid):
-                            ok += 1
-                    except Exception:  # noqa: BLE001
-                        continue
-                if ok < self._quorum(self._writer):
-                    # Lost the lock: cancel the protected operation
-                    # (drwmutex.go:221 loss callback).
-                    self.lost.set()
-                    if self.on_lost is not None:
-                        try:
-                            self.on_lost()
-                        except Exception:  # noqa: BLE001
-                            pass
-                    return
-
-        self._refresher = threading.Thread(target=loop, daemon=True)
-        self._refresher.start()
+    def _refresh_round(self) -> bool:
+        """One refresh sweep; returns False when the quorum is lost."""
+        ok = 0
+        for i in list(self._held):
+            try:
+                if self.lockers[i].refresh(self.resource, self.uid):
+                    ok += 1
+            except Exception:  # noqa: BLE001
+                continue
+        if ok >= self._quorum(self._writer):
+            return True
+        # Lost the lock: cancel the protected operation
+        # (drwmutex.go:221 loss callback).
+        self.lost.set()
+        if self.on_lost is not None:
+            try:
+                self.on_lost()
+            except Exception:  # noqa: BLE001
+                pass
+        return False
 
     def __enter__(self):
         if not self.acquire(True):
@@ -259,6 +258,60 @@ class DRWMutex:
 
     def __exit__(self, *exc):
         self.release()
+
+
+class _RefreshDaemon:
+    """One process-wide refresher thread for every held DRWMutex.
+
+    The reference runs a goroutine per held lock (drwmutex.go:221); a Python
+    thread per acquisition costs ~1 ms of spawn+join on the PUT commit path
+    for a lock typically held for microseconds. One shared daemon sweeping
+    all registered mutexes every REFRESH_INTERVAL gives the same liveness
+    (server-side entries expire after EXPIRY=30 s — ten missed sweeps)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._live: dict[int, DRWMutex] = {}
+        self._thread: threading.Thread | None = None
+        self._pool = None
+
+    def register(self, m: DRWMutex) -> None:
+        with self._mu:
+            self._live[id(m)] = m
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True, name="lock-refresh"
+                )
+                self._thread.start()
+
+    def unregister(self, m: DRWMutex) -> None:
+        with self._mu:
+            self._live.pop(id(m), None)
+
+    def _loop(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        # Refresh mutexes CONCURRENTLY: a blackholed peer stalls its
+        # RemoteLocker call for the full 5 s REST timeout, and a sequential
+        # sweep of many held locks through one dead peer could overrun the
+        # 30 s server-side EXPIRY — expiring locks this daemon exists to
+        # keep alive. Eight lanes bound the convoy to ceil(n/8) timeouts.
+        self._pool = ThreadPoolExecutor(max_workers=8, thread_name_prefix="lock-refresh")
+        while True:
+            time.sleep(REFRESH_INTERVAL)
+            with self._mu:
+                batch = list(self._live.values())
+            if not batch:
+                continue
+
+            def one(m):
+                if m._stop.is_set() or not m._refresh_round():
+                    self.unregister(m)
+
+            list(self._pool.map(one, batch))
+
+
+_refresh_daemon = _RefreshDaemon()
 
 
 # ---------------------------------------------------------------------------

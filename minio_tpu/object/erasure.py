@@ -21,6 +21,7 @@ import hashlib
 import io
 import os
 import threading
+import time
 import uuid
 from typing import Iterator
 
@@ -172,10 +173,15 @@ class ShardStageWriter:
         self._hashers = (
             [self.algo.new() for _ in range(k + m)] if self.algo is not None else None
         )
+        self._appended = False
 
-    def create(self) -> None:
-        """Create empty staged files up front (zero-byte payloads commit a
-        real — empty — shard file; appends extend it)."""
+    def finalize(self) -> None:
+        """Ensure staged shard files exist before commit. Appends create
+        files on demand (open "ab"), so this only does IO for zero-byte
+        payloads — which must still commit a real, empty shard file. The
+        old eager create() cost every PUT a 16-task fan-out up front."""
+        if self._appended:
+            return
 
         def mk(i):
             if not self.ok[i]:
@@ -207,6 +213,7 @@ class ShardStageWriter:
             row = self.distribution[i] - 1
             self.disks[i].append_file(META_BUCKET, self.stage_path(i), row_frames[row])
 
+        self._appended = True
         for i, (_, e) in enumerate(meta_mod.parallel_map(wr, range(len(self.disks)))):
             if e is not None:
                 self.ok[i] = False
@@ -371,6 +378,14 @@ class ErasureObjects:
         # process-local locker; Node.build swaps in the dsync quorum lockers
         # (reference: NSLock via dsync, cmd/erasure-object.go:933-941).
         self.ns_lock = ns_lock if ns_lock is not None else _process_ns_lock()
+        # Bucket-info cache: every object op starts with a bucket check that
+        # fanned a stat_vol to all drives — ~12 ms/request of the PUT fixed
+        # cost on a 1-core host. Positive entries only, short TTL (the
+        # reference keeps buckets in an always-warm metadata cache,
+        # cmd/bucket-metadata-sys.go); deletes invalidate locally, remote
+        # deletes are seen within the TTL window.
+        self._bucket_cache: dict[str, tuple[float, BucketInfo]] = {}
+        self._bucket_cache_ttl = float(os.environ.get("MINIO_TPU_BUCKET_CACHE_TTL", "2.0"))
 
     # ------------------------------------------------------------------ util
 
@@ -415,7 +430,16 @@ class ErasureObjects:
         if n_ok + n_exists < quorum:
             raise errors.ErasureWriteQuorum(bucket)
 
+    def _check_bucket(self, bucket: str) -> None:
+        """Bucket-existence gate for hot object paths (raises BucketNotFound;
+        result discarded — the cached get_bucket_info does the work)."""
+        self.get_bucket_info(bucket)
+
     def get_bucket_info(self, bucket: str) -> BucketInfo:
+        cached = self._bucket_cache.get(bucket)
+        if cached is not None and cached[0] > time.monotonic():
+            return cached[1]
+
         def stat(d):
             if d is None:
                 raise errors.DiskNotFound()
@@ -429,15 +453,22 @@ class ErasureObjects:
             if isinstance(err, errors.VolumeNotFound):
                 raise errors.BucketNotFound(bucket)
             raise err or errors.BucketNotFound(bucket)
-        return BucketInfo(name=bucket, created=min(v.created for v in vols))
+        info = BucketInfo(name=bucket, created=min(v.created for v in vols))
+        self._bucket_cache[bucket] = (time.monotonic() + self._bucket_cache_ttl, info)
+        return info
 
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
+        # Invalidate before AND after the fan-out: a concurrent check racing
+        # the rm could re-cache a still-present volume mid-delete.
+        self._bucket_cache.pop(bucket, None)
+
         def rm(d):
             if d is None:
                 raise errors.DiskNotFound()
             d.delete_vol(bucket, force=force)
 
         results = meta_mod.parallel_map(rm, self._online())
+        self._bucket_cache.pop(bucket, None)
         errs = [e for _, e in results]
         n_ok = sum(1 for e in errs if e is None)
         n_missing = sum(1 for e in errs if isinstance(e, errors.VolumeNotFound))
@@ -499,7 +530,7 @@ class ErasureObjects:
         the blocks grouped into device batches). Objects smaller than the
         inline threshold take the one-shot xl.meta-inline path."""
         opts = opts or PutObjectOptions()
-        self.get_bucket_info(bucket)  # raises BucketNotFound
+        self._check_bucket(bucket)  # raises BucketNotFound
 
         n = self.drive_count
         m = self.parity
@@ -683,7 +714,6 @@ class ErasureObjects:
         # the shutdown handler.
         md5h = None if opts.etag else make_etag_md5()
         try:
-            writer.create()
             group: list[bytes] = []
             for block in _iter_blocks(reader, head):
                 if md5h is not None:
@@ -698,6 +728,7 @@ class ErasureObjects:
                             bucket, object_name, f"write quorum {write_quorum} lost mid-stream"
                         )
             writer.append_group(group)
+            writer.finalize()  # zero-byte payloads still commit a shard file
             if writer.alive() < write_quorum:
                 raise errors.ErasureWriteQuorum(
                     bucket, object_name, f"write quorum {write_quorum} lost mid-stream"
@@ -818,7 +849,7 @@ class ErasureObjects:
         self, bucket: str, object_name: str, opts: GetObjectOptions | None = None
     ) -> ObjectInfo:
         opts = opts or GetObjectOptions()
-        self.get_bucket_info(bucket)
+        self._check_bucket(bucket)
         fi, metas, _ = self._read_quorum_fi(bucket, object_name, opts.version_id)
         n_versions = max((f.num_versions for f in metas if f is not None), default=1)
         fi.num_versions = n_versions
@@ -854,7 +885,7 @@ class ErasureObjects:
         ShardFileOffset + lazy parallelReader, cmd/erasure-coding.go:141,
         erasure-decode.go:31-202). Memory is O(GROUP_BLOCKS x BLOCK_SIZE)."""
         opts = opts or GetObjectOptions()
-        self.get_bucket_info(bucket)
+        self._check_bucket(bucket)
         fi, metas, disks = self._read_quorum_fi(bucket, object_name, opts.version_id)
         if fi.deleted:
             raise (
@@ -1170,7 +1201,7 @@ class ErasureObjects:
         """Update user metadata of an existing version in place
         (PutObjectMetadata / PutObjectTags, cmd/erasure-object.go equivalent:
         read quorum FileInfo, mutate metadata, update xl.meta on all drives)."""
-        self.get_bucket_info(bucket)
+        self._check_bucket(bucket)
         fi, metas, disks = self._read_quorum_fi(bucket, object_name, version_id)
         if fi.deleted:
             raise errors.MethodNotAllowed(bucket, object_name)
@@ -1229,7 +1260,7 @@ class ErasureObjects:
             STATUS_COMPLETE,
         )
 
-        self.get_bucket_info(bucket)
+        self._check_bucket(bucket)
         fi, metas, disks = self._read_quorum_fi(bucket, object_name, version_id)
         if fi.deleted:
             raise errors.MethodNotAllowed(bucket, object_name)
@@ -1264,7 +1295,7 @@ class ErasureObjects:
         self, bucket: str, object_name: str, opts: DeleteObjectOptions | None = None
     ) -> ObjectInfo:
         opts = opts or DeleteObjectOptions()
-        self.get_bucket_info(bucket)
+        self._check_bucket(bucket)
         disks = self._online()
         write_quorum = self.drive_count // 2 + 1
 

@@ -44,7 +44,7 @@ class MultipartManager:
         self, bucket: str, object_name: str, opts: PutObjectOptions | None = None
     ) -> str:
         opts = opts or PutObjectOptions()
-        self.eo.get_bucket_info(bucket)
+        self.eo.get_bucket_info(bucket)  # cached existence gate
         upload_id = str(uuid.uuid4())
         doc = json.dumps(
             {
@@ -143,7 +143,6 @@ class MultipartManager:
 
         md5h = make_etag_md5()  # pipelined on multi-core (part etag)
         try:
-            writer.create()
             group: list[bytes] = []
             for block in _iter_blocks(reader, b""):
                 md5h.update(block)
@@ -157,6 +156,7 @@ class MultipartManager:
                             bucket, object_name, "upload part quorum lost mid-stream"
                         )
             writer.append_group(group)
+            writer.finalize()  # zero-byte parts still commit a shard file
             if writer.alive() < write_quorum:
                 raise errors.ErasureWriteQuorum(bucket, object_name, "upload part quorum")
         except BaseException:

@@ -175,9 +175,13 @@ class LocalDrive(StorageAPI):
 
     def write_all(self, volume: str, path: str, data: bytes) -> None:
         p = self._file_path(volume, path)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = p + ".tmp" + os.urandom(4).hex()
-        with open(tmp, "wb") as f:
+        try:
+            f = open(tmp, "wb")
+        except FileNotFoundError:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            f = open(tmp, "wb")
+        with f:
             f.write(data)
             if self.fsync:
                 f.flush()
@@ -246,10 +250,21 @@ class LocalDrive(StorageAPI):
                 f.flush()
                 os.fsync(f.fileno())
 
+    # (append_file below opens first and only mkdirs on ENOENT; create_file
+    # keeps the eager makedirs because its native O_DIRECT branch reports a
+    # missing parent the same way as other failures.)
+
     def append_file(self, volume: str, path: str, data: bytes) -> None:
         p = self._file_path(volume, path)
-        os.makedirs(os.path.dirname(p), exist_ok=True)
-        with open(p, "ab") as f:
+        try:
+            f = open(p, "ab")
+        except FileNotFoundError:
+            # First append on this staged file: make the parent then. The
+            # happy path (every subsequent group) skips the makedirs stat
+            # walk — it was ~5 syscalls per drive per 16 MiB group.
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            f = open(p, "ab")
+        with f:
             f.write(data)
 
     def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes:
