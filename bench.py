@@ -248,6 +248,26 @@ def object_layer_metrics(use_device: bool) -> dict:
         dt = time.perf_counter() - t0
         out["heal_disks_healed"] = res.disks_healed
         out["heal_gibs"] = round(n_parts * len(part_body) / dt / (1 << 30), 3)
+
+        # --- transparent-compression codec (S2 role, object-api-utils.go:907)
+        try:
+            from minio_tpu.control import compress as compress_mod
+
+            src = open(os.path.abspath(__file__), "rb").read()
+            text = (src * (1 + (64 << 20) // len(src)))[: 64 << 20]
+            t0 = time.perf_counter()
+            blob, cmeta = compress_mod.compress(text)
+            ct = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            back = compress_mod.decompress(blob, cmeta)
+            dt = time.perf_counter() - t0
+            assert back == text
+            out["compress_algo"] = cmeta[compress_mod.META_COMPRESSION]
+            out["compress_gibs"] = round(len(text) / ct / (1 << 30), 3)
+            out["decompress_gibs"] = round(len(text) / dt / (1 << 30), 3)
+            out["compress_ratio"] = round(len(blob) / len(text), 3)
+        except Exception as e:  # noqa: BLE001
+            out["compress_error"] = f"{type(e).__name__}: {e}"[:200]
     finally:
         if codec is not None:
             codec.close()

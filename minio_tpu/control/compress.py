@@ -4,10 +4,13 @@ Role of the reference's compression path (cmd/object-api-utils.go:442
 isCompressible, :907 s2 writer, :686 readahead+s2 reader): objects whose
 extension/MIME matches the configured filters are compressed before erasure
 coding, with the pre-compression size kept in internal metadata so S3
-semantics (Content-Length, ranges) are preserved. Codec here is zlib (the
-host C library); the reference's S2 serves the same role -- a fast host-side
-byte codec, deliberately NOT a device workload (SURVEY.md section 2.9: "TPU
-not a fit").
+semantics (Content-Length, ranges) are preserved.
+
+Codec: snappy block format via the native C++ kernel (the reference's S2 is
+a snappy superset -- same speed class, interoperable baseline), falling back
+to zlib level-1 when the native toolchain is absent. Reads accept both, so
+objects written under either codec (or by an older build) always decompress.
+Deliberately NOT a device workload (SURVEY.md section 2.9: "TPU not a fit").
 """
 
 from __future__ import annotations
@@ -15,9 +18,12 @@ from __future__ import annotations
 import fnmatch
 import zlib
 
+from ..ops import native
+
 META_COMPRESSION = "x-internal-compression"
 META_ACTUAL_SIZE = "x-internal-actual-size"
-ALGO = "zlib"
+ALGO_SNAPPY = "snappy"
+ALGO_ZLIB = "zlib"
 
 # Incompressible content is skipped by extension/MIME, as in the reference.
 DEFAULT_EXTENSIONS = [".txt", ".log", ".csv", ".json", ".tar", ".xml", ".bin"]
@@ -38,14 +44,28 @@ def is_compressible(
 
 
 def compress(data: bytes) -> tuple[bytes, dict[str, str]]:
-    out = zlib.compress(data, level=1)  # speed-oriented, like S2
-    return out, {META_COMPRESSION: ALGO, META_ACTUAL_SIZE: str(len(data))}
+    if native.snappy_available():
+        out = native.snappy_compress(data)
+        algo = ALGO_SNAPPY
+    else:
+        out = zlib.compress(data, level=1)  # speed-oriented stand-in
+        algo = ALGO_ZLIB
+    return out, {META_COMPRESSION: algo, META_ACTUAL_SIZE: str(len(data))}
 
 
 def decompress(blob: bytes, meta: dict[str, str]) -> bytes:
-    if meta.get(META_COMPRESSION) != ALGO:
-        return blob
-    return zlib.decompress(blob)
+    algo = meta.get(META_COMPRESSION)
+    if algo == ALGO_SNAPPY:
+        if native.snappy_available():
+            return native.snappy_decompress(blob)
+        # Toolchain-less host reading snappy-written data: the pure-Python
+        # decoder (hosted with the parquet reader) keeps GETs correct.
+        from ..s3select.parquet import snappy_decompress as py_snappy
+
+        return py_snappy(blob)
+    if algo == ALGO_ZLIB:
+        return zlib.decompress(blob)
+    return blob
 
 
 def is_compressed(meta: dict[str, str]) -> bool:

@@ -31,16 +31,36 @@ def _build() -> bool:
     io_src = os.path.join(_NATIVE_DIR, "minio_io.cpp")
     if os.path.isfile(io_src):
         srcs.append(io_src)
+    # Build to a per-process temp path and rename: overwriting a .so that a
+    # running server has mapped corrupts that process, and a shared temp
+    # name would let a concurrent builder scribble into the freshly
+    # installed library through its still-open fd.
+    tmp = f"{_LIB_PATH}.build.{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O3", "-march=native", "-fPIC", "-shared", "-o", _LIB_PATH, *srcs],
+            ["g++", "-O3", "-march=native", "-fPIC", "-shared", "-o", tmp, *srcs],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.replace(tmp, _LIB_PATH)
         return True
-    except (subprocess.SubprocessError, FileNotFoundError):
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return False
+
+
+def _stale() -> bool:
+    """True when the prebuilt .so predates any native source (a stale lib
+    would silently serve yesterday's kernels after a source edit)."""
+    try:
+        lib_m = os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+    for name in ("minio_native.cpp", "minio_io.cpp"):
+        p = os.path.join(_NATIVE_DIR, name)
+        if os.path.isfile(p) and os.path.getmtime(p) > lib_m:
+            return True
+    return False
 
 
 def load() -> ctypes.CDLL | None:
@@ -49,7 +69,7 @@ def load() -> ctypes.CDLL | None:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.isfile(_LIB_PATH) and not _build():
+        if _stale() and not _build() and not os.path.isfile(_LIB_PATH):
             return None
         try:
             lib = ctypes.CDLL(_LIB_PATH)
@@ -68,6 +88,18 @@ def load() -> ctypes.CDLL | None:
                 u8p, u8p, ctypes.c_size_t, ctypes.c_size_t, u8p,
             ]
         except AttributeError:  # stale prebuilt .so without the verifier
+            pass
+        # Snappy codec (control/compress.py); absent in stale prebuilt libs.
+        try:
+            lib.sn_max_compressed.argtypes = [ctypes.c_size_t]
+            lib.sn_max_compressed.restype = ctypes.c_size_t
+            lib.sn_compress.argtypes = [u8p, ctypes.c_size_t, u8p]
+            lib.sn_compress.restype = ctypes.c_longlong
+            lib.sn_uncompressed_len.argtypes = [u8p, ctypes.c_size_t]
+            lib.sn_uncompressed_len.restype = ctypes.c_longlong
+            lib.sn_decompress.argtypes = [u8p, ctypes.c_size_t, u8p, ctypes.c_size_t]
+            lib.sn_decompress.restype = ctypes.c_longlong
+        except AttributeError:
             pass
         # IO layer (native/minio_io.cpp); absent in stale prebuilt libraries.
         try:
@@ -201,6 +233,46 @@ def hh256_frame_rows(stacked: np.ndarray, key: bytes) -> "list[memoryview]":
         # saves G x S bytes of memcpy per row.
         rows.append(out.data)
     return rows
+
+
+# -- snappy codec (control/compress.py fast path; S2 role) -------------------
+
+
+def snappy_available() -> bool:
+    lib = load()
+    return lib is not None and hasattr(lib, "sn_compress")
+
+
+def snappy_compress(data: bytes | np.ndarray) -> bytes:
+    lib = load()
+    assert lib is not None and hasattr(lib, "sn_compress")
+    arr = np.frombuffer(data, dtype=np.uint8) if not isinstance(data, np.ndarray) else data
+    arr = np.ascontiguousarray(arr)
+    out = np.empty(lib.sn_max_compressed(arr.size), dtype=np.uint8)
+    n = lib.sn_compress(_ptr(arr) if arr.size else None, arr.size, _ptr(out))
+    return out[:n].tobytes()
+
+
+def snappy_decompress(blob: bytes | np.ndarray) -> bytes:
+    """Raises ValueError on corrupt input (decoder validates every element)."""
+    lib = load()
+    assert lib is not None and hasattr(lib, "sn_decompress")
+    arr = np.frombuffer(blob, dtype=np.uint8) if not isinstance(blob, np.ndarray) else blob
+    arr = np.ascontiguousarray(arr)
+    want = lib.sn_uncompressed_len(_ptr(arr) if arr.size else None, arr.size)
+    # Bound the allocation BEFORE trusting the preamble: a corrupt length
+    # must raise ValueError, not MemoryError (or reserve half the address
+    # space). No valid stream expands more than ~21x (a 3-byte copy-2 tag
+    # emits at most 64 bytes), so 24x + slack is unreachable by real data.
+    if want < 0 or want > arr.size * 24 + 64:
+        raise ValueError("snappy: bad length preamble")
+    # +16 slop: the decoder's 8-byte overlap blasts may overshoot a copy's
+    # length by up to 7 bytes (never past cap); output is sliced to `want`.
+    out = np.empty(int(want) + 16, dtype=np.uint8)
+    n = lib.sn_decompress(_ptr(arr) if arr.size else None, arr.size, _ptr(out), out.size)
+    if n < 0:
+        raise ValueError(f"snappy: corrupt stream (code {n})")
+    return out[: int(n)].tobytes()
 
 
 # -- native IO (O_DIRECT aligned file path; xl-storage.go CreateFile role) ---

@@ -371,4 +371,246 @@ void hh256_frame(const uint8_t* key32, const uint8_t* data, size_t stride,
     }
 }
 
+// ---------------------------------------------------------------------------
+// Snappy block format: the fast transparent-compression codec.
+//
+// Role of the reference's S2 writer (cmd/object-api-utils.go:907,
+// klauspost/compress/s2): an LZ77-class byte codec fast enough to sit in a
+// GiB/s data plane. S2's wire format is a superset of snappy; this emits the
+// interoperable snappy baseline: a uvarint uncompressed length, then literal
+// and copy elements. Greedy 4-byte hash matching over independent 64 KiB
+// windows (offsets always fit 16 bits, so only 1- and 2-byte-offset copy
+// tags are emitted). Decoder accepts the full format incl. 4-byte offsets.
+// ---------------------------------------------------------------------------
+
+static inline uint32_t sn_load32(const uint8_t* p) {
+    uint32_t v; memcpy(&v, p, 4); return v;
+}
+static inline uint64_t sn_load64(const uint8_t* p) {
+    uint64_t v; memcpy(&v, p, 8); return v;
+}
+static inline uint32_t sn_hash(uint32_t v) {
+    return (v * 0x1e35a7bdu) >> 18;  // 14-bit table
+}
+
+// Literal length-header ladder (tag byte + 0-4 length bytes).
+static inline size_t sn_literal_header(uint8_t* dst, size_t len) {
+    uint8_t* d = dst;
+    size_t n = len - 1;
+    if (n < 60) {
+        *d++ = (uint8_t)(n << 2);
+    } else if (n < (1u << 8)) {
+        *d++ = 60 << 2; *d++ = (uint8_t)n;
+    } else if (n < (1u << 16)) {
+        *d++ = 61 << 2; *d++ = (uint8_t)n; *d++ = (uint8_t)(n >> 8);
+    } else if (n < (1u << 24)) {
+        *d++ = 62 << 2; *d++ = (uint8_t)n; *d++ = (uint8_t)(n >> 8);
+        *d++ = (uint8_t)(n >> 16);
+    } else {
+        *d++ = 63 << 2; *d++ = (uint8_t)n; *d++ = (uint8_t)(n >> 8);
+        *d++ = (uint8_t)(n >> 16); *d++ = (uint8_t)(n >> 24);
+    }
+    return (size_t)(d - dst);
+}
+
+// Tail-safe variant: exact-length copy, no overread. Used where src+16 may
+// run past the input buffer (the block remainder and sub-16-byte blocks).
+static size_t sn_emit_literal_tail(uint8_t* dst, const uint8_t* src, size_t len) {
+    size_t h = sn_literal_header(dst, len);
+    memcpy(dst + h, src, len);
+    return h + len;
+}
+
+static size_t sn_emit_literal(uint8_t* dst, const uint8_t* src, size_t len) {
+    if (len <= 16) {  // short literals dominate text; one 16B blast
+        *dst = (uint8_t)((len - 1) << 2);  // (dst has MaxEncodedLen slack)
+        memcpy(dst + 1, src, 16);
+        return 1 + len;
+    }
+    size_t h = sn_literal_header(dst, len);
+    memcpy(dst + h, src, len);
+    return h + len;
+}
+
+static size_t sn_emit_copy(uint8_t* dst, size_t offset, size_t len) {
+    uint8_t* d = dst;
+    // Long matches: 64-byte 2-byte-offset copies, with the snappy trick of
+    // leaving a 60..67-byte tail so the final copies stay in one element.
+    while (len >= 68) {
+        *d++ = (63 << 2) | 2; *d++ = (uint8_t)offset; *d++ = (uint8_t)(offset >> 8);
+        len -= 64;
+    }
+    if (len > 64) {
+        *d++ = (59 << 2) | 2; *d++ = (uint8_t)offset; *d++ = (uint8_t)(offset >> 8);
+        len -= 60;
+    }
+    if (len >= 12 || offset >= 2048) {
+        *d++ = (uint8_t)(((len - 1) << 2) | 2);
+        *d++ = (uint8_t)offset; *d++ = (uint8_t)(offset >> 8);
+    } else {
+        *d++ = (uint8_t)(((offset >> 8) << 5) | ((len - 4) << 2) | 1);
+        *d++ = (uint8_t)offset;
+    }
+    return (size_t)(d - dst);
+}
+
+// Greedy matcher over one block (n <= 65536). Returns bytes written.
+static size_t sn_compress_block(const uint8_t* src, size_t n, uint8_t* dst) {
+    uint16_t table[1 << 14];
+    if (n < 16) return sn_emit_literal_tail(dst, src, n);
+    memset(table, 0, sizeof(table));
+    size_t d = 0;
+    const size_t s_limit = n - 15;  // margin: 8-byte loads + copy slop stay in range
+    size_t next_emit = 0;
+    size_t s = 1;
+    uint32_t next_hash = sn_hash(sn_load32(src + s));
+    for (;;) {
+        // Probe with accelerating skip: incompressible data costs ~1 probe
+        // per 32 bytes instead of per byte.
+        size_t skip = 32, next_s = s, candidate = 0;
+        for (;;) {
+            s = next_s;
+            next_s = s + (skip >> 5);
+            skip++;
+            if (next_s > s_limit) goto remainder;
+            candidate = table[next_hash];
+            table[next_hash] = (uint16_t)s;
+            next_hash = sn_hash(sn_load32(src + next_s));
+            if (sn_load32(src + s) == sn_load32(src + candidate)) break;
+        }
+        d += sn_emit_literal(dst + d, src + next_emit, s - next_emit);
+        for (;;) {
+            size_t base = s, i = candidate + 4;
+            s += 4;
+            while (s + 8 <= n) {  // 8-byte compare + ctz beats byte-at-a-time
+                uint64_t x = sn_load64(src + i) ^ sn_load64(src + s);
+                if (x) { s += __builtin_ctzll(x) >> 3; goto matched; }
+                i += 8; s += 8;
+            }
+            while (s < n && src[i] == src[s]) { i++; s++; }
+        matched:
+            d += sn_emit_copy(dst + d, base - candidate, s - base);
+            next_emit = s;
+            if (s >= s_limit) goto remainder;
+            // Chain: re-seed the table at s-1 and test s immediately.
+            uint64_t x = sn_load64(src + s - 1);
+            table[sn_hash((uint32_t)x)] = (uint16_t)(s - 1);
+            uint32_t cur = sn_hash((uint32_t)(x >> 8));
+            candidate = table[cur];
+            table[cur] = (uint16_t)s;
+            if ((uint32_t)(x >> 8) != sn_load32(src + candidate)) {
+                next_hash = sn_hash((uint32_t)(x >> 16));
+                s++;
+                break;
+            }
+        }
+    }
+remainder:
+    if (next_emit < n) d += sn_emit_literal_tail(dst + d, src + next_emit, n - next_emit);
+    return d;
+}
+
+// Worst case: uvarint header + incompressible literals (snappy MaxEncodedLen).
+size_t sn_max_compressed(size_t n) { return 32 + n + n / 6; }
+
+long long sn_compress(const uint8_t* src, size_t n, uint8_t* dst) {
+    uint8_t* d = dst;
+    size_t v = n;
+    do { *d++ = (uint8_t)((v & 0x7f) | (v >= 0x80 ? 0x80 : 0)); v >>= 7; } while (v);
+    for (size_t off = 0; off < n; off += 65536) {
+        size_t blk = n - off < 65536 ? n - off : 65536;
+        d += sn_compress_block(src + off, blk, d);
+    }
+    return (long long)(d - dst);
+}
+
+// Parsed uncompressed length, or -1 on a bad preamble.
+long long sn_uncompressed_len(const uint8_t* src, size_t n) {
+    uint64_t v = 0; int shift = 0; size_t i = 0;
+    for (; i < n && i < 10; i++) {
+        v |= (uint64_t)(src[i] & 0x7f) << shift;
+        if (!(src[i] & 0x80)) return (long long)v;
+        shift += 7;
+    }
+    return -1;
+}
+
+// Returns bytes written, or a negative errno-style code on corrupt input.
+long long sn_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+    uint64_t want = 0; int shift = 0; size_t s = 0;
+    for (;;) {
+        if (s >= n || s >= 10) return -1;
+        want |= (uint64_t)(src[s] & 0x7f) << shift;
+        if (!(src[s++] & 0x80)) break;
+        shift += 7;
+    }
+    if (want > cap) return -2;
+    size_t d = 0;
+    while (s < n) {
+        uint8_t tag = src[s++];
+        size_t len, offset;
+        switch (tag & 3) {
+        case 0: {  // literal
+            len = (tag >> 2) + 1;
+            if (len > 60) {
+                size_t extra = len - 60;
+                if (s + extra > n) return -3;
+                len = 0;
+                for (size_t j = 0; j < extra; j++) len |= (size_t)src[s + j] << (8 * j);
+                len++;
+                s += extra;
+            }
+            if (s + len > n || d + len > want) return -3;
+            memcpy(dst + d, src + s, len);
+            s += len; d += len;
+            continue;
+        }
+        case 1:  // copy, 1-byte offset
+            if (s >= n) return -3;
+            len = 4 + ((tag >> 2) & 7);
+            offset = ((size_t)(tag >> 5) << 8) | src[s++];
+            break;
+        case 2:  // copy, 2-byte offset
+            if (s + 2 > n) return -3;
+            len = (tag >> 2) + 1;
+            offset = (size_t)src[s] | ((size_t)src[s + 1] << 8);
+            s += 2;
+            break;
+        default:  // copy, 4-byte offset
+            if (s + 4 > n) return -3;
+            len = (tag >> 2) + 1;
+            offset = sn_load32(src + s);
+            s += 4;
+            break;
+        }
+        if (offset == 0 || offset > d || d + len > want) return -4;
+        {
+            uint8_t* op = dst + d;
+            const uint8_t* sp = op - offset;
+            if (offset >= 16 && len <= 16 && d + 16 <= cap) {
+                memcpy(op, sp, 16);  // short copy blast (slop-covered)
+            } else if (offset >= len) {
+                memcpy(op, sp, len);
+            } else if (offset >= 8 && d + len + 8 <= cap) {
+                // Overlapping with lag >= 8: 8-byte strided blasts (may
+                // overshoot len by up to 7 bytes inside the caller's slop).
+                for (size_t j = 0; j < len; j += 8) memcpy(op + j, sp + j, 8);
+            } else if (d + len + 8 <= cap) {
+                // Tiny-offset RLE: seed one pattern period of >= 8 bytes
+                // byte-wise, then blast with a lag that is a multiple of
+                // the offset (so periodicity keeps every read correct).
+                size_t lag = offset;
+                while (lag < 8) lag += offset;
+                size_t j = len < lag ? len : lag;
+                for (size_t t = 0; t < j; t++) op[t] = sp[t];
+                for (; j < len; j += 8) memcpy(op + j, op + j - lag, 8);
+            } else {
+                for (size_t j = 0; j < len; j++) op[j] = sp[j];
+            }
+            d += len;
+        }
+    }
+    return d == want ? (long long)d : -5;
+}
+
 }  // extern "C"
