@@ -257,9 +257,9 @@ class Node:
             for s in sets.sets:
                 s.ns_lock = self.ns_lock
         self.iam = IAMSys(self.creds.access_key, self.creds.secret_key)
-        from ..control.kms import StaticKeyKMS
+        from ..control.kms import StaticKeyKMS, kms_from_env
 
-        self.kms = StaticKeyKMS.from_env() or StaticKeyKMS()
+        self.kms = kms_from_env() or StaticKeyKMS()
         self.notification = NotificationSys(
             [PeerClient(u, self.token) for u in self.peer_urls]
         )
