@@ -294,6 +294,17 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         _site_iam("policy-mapping", {"access_key": request.match_info["ak"], "policies": doc["policies"]})
         return {"ok": True}
 
+    def h_ldap_policy(request, body):
+        # Attach/detach policies for an LDAP user or group DN (the mc
+        # `idp ldap policy attach` role); empty policies detaches.
+        doc = json.loads(body)
+        ctx.iam.set_ldap_policy(doc["dn"], doc.get("policies", []))
+        _site_iam("ldap-policy-mapping", {"dn": doc["dn"], "policies": doc.get("policies", [])})
+        return {"ok": True}
+
+    def h_ldap_policy_list(request, body):
+        return dict(ctx.iam.ldap_policy_map)
+
     def h_list_policies(request, body):
         from ..control import policy as policy_mod
 
@@ -719,6 +730,8 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_delete("/users/{ak}", handler(h_remove_user))
     app.router.add_put("/users/{ak}/status", handler(h_user_status))
     app.router.add_put("/users/{ak}/policy", handler(h_user_policy))
+    app.router.add_put("/idp/ldap/policy", handler(h_ldap_policy))
+    app.router.add_get("/idp/ldap/policy", handler(h_ldap_policy_list))
     app.router.add_get("/policies", handler(h_list_policies))
     app.router.add_put("/policies/{name}", handler(h_put_policy))
     app.router.add_delete("/policies/{name}", handler(h_delete_policy))

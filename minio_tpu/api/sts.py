@@ -9,10 +9,10 @@ Role of the reference's cmd/sts-handlers.go:
     (internal/config/identity/openid claim_name, default "policy").
   * AssumeRoleWithCertificate (:606) — mTLS client certificate, policy
     named by the certificate CN.
-  * AssumeRoleWithLDAPIdentity (:419) — LDAP bind; gated on configuration
-    (this build has no LDAP client; the config surface exists and the
-    action reports itself unconfigured, the reference's behavior when
-    identity_ldap is absent).
+  * AssumeRoleWithLDAPIdentity (:419) — LDAP lookup-bind + user bind via
+    the zero-dep BER client (control/ldap.py); user/group DNs map to
+    policies through the IAM LDAP policy DB. Reports NotImplemented when
+    identity_ldap is unconfigured, the reference's behavior.
 
 Zero-egress: OIDC verification uses a static JWKS / shared secret from the
 identity_openid config subsystem, not issuer discovery.
@@ -61,10 +61,7 @@ def handle_sts(
     if action == "AssumeRoleWithCertificate":
         return _assume_role_with_certificate(iam, config, form, request)
     if action == "AssumeRoleWithLDAPIdentity":
-        server = config.get("identity_ldap", "server_addr") if config is not None else ""
-        if not server:
-            raise S3Error("NotImplemented", "LDAP identity is not configured")
-        raise S3Error("NotImplemented", "no LDAP client in this build")
+        return _assume_role_with_ldap(iam, config, form)
     raise S3Error("NotImplemented", f"STS action {action}")
 
 
@@ -182,6 +179,37 @@ def _assume_role_with_token(
         else ""
     )
     return _creds_xml(action, creds, expiry, extra)
+
+
+# -- LDAP identity ------------------------------------------------------------
+
+
+def _assume_role_with_ldap(iam: IAMSys, config, form: dict[str, str]) -> web.Response:
+    """AssumeRoleWithLDAPIdentity (cmd/sts-handlers.go:447): lookup-bind the
+    username, verify the password with a user bind, map the user/group DNs
+    through the IAM LDAP policy DB, and issue temp credentials."""
+    from ..control import ldap as ldap_mod
+
+    conf = ldap_mod.LDAPConfig.from_config(config)
+    if not conf.server_addr:
+        raise S3Error("NotImplemented", "LDAP identity is not configured")
+    username = form.get("LDAPUsername", "")
+    password = form.get("LDAPPassword", "")
+    if not username or not password:
+        raise S3Error("InvalidRequest", "LDAPUsername and LDAPPassword are required")
+    try:
+        user_dn, groups = ldap_mod.authenticate(conf, username, password)
+    except ldap_mod.LDAPError as e:
+        raise S3Error("AccessDenied", f"LDAP authentication failed: {e}")
+    policies = iam.ldap_policies_for(user_dn, groups)
+    if not policies:
+        raise S3Error(
+            "AccessDenied", f"no policy mapped for LDAP identity {user_dn!r}"
+        )
+    creds, expiry = iam.new_sts_credentials_for_policies(
+        policies, _duration(form), _session_policy(form)
+    )
+    return _creds_xml("AssumeRoleWithLDAPIdentity", creds, expiry)
 
 
 # -- mTLS certificate ---------------------------------------------------------

@@ -83,7 +83,18 @@ class ConfigSys:
         )
         self.register(
             SUBSYS_IDENTITY_LDAP,
-            [KV("server_addr", "", dynamic=False)],
+            [
+                # Lookup-bind flow keys (internal/config/identity/ldap names).
+                KV("server_addr", "", dynamic=False),
+                KV("lookup_bind_dn", "", dynamic=True),
+                KV("lookup_bind_password", "", dynamic=True),
+                KV("user_dn_search_base_dn", "", dynamic=True),
+                KV("user_dn_search_filter", "(uid=%s)", dynamic=True),
+                KV("group_search_base_dn", "", dynamic=True),
+                KV("group_search_filter", "", dynamic=True),
+                KV("tls", "off", dynamic=False),
+                KV("tls_skip_verify", "off", dynamic=False),
+            ],
         )
         self.register(
             SUBSYS_IDENTITY_TLS,

@@ -69,6 +69,10 @@ class IAMSys:
         self.users: dict[str, UserIdentity] = {}
         self.group_policies: dict[str, list[str]] = {}
         self.custom_policies: dict[str, dict] = {}
+        # LDAP policy DB: DN (user or group) -> policy names. The reference
+        # keeps the same mapping in its IAM store (mc admin policy attach
+        # --user 'uid=...'); LDAP identities have no local user records.
+        self.ldap_policy_map: dict[str, list[str]] = {}
         self.store = store  # object-layer-backed persistence (control/configsys)
         self._lock = threading.RLock()
 
@@ -85,16 +89,45 @@ class IAMSys:
         raw = self.store.get(f"{IAM_PREFIX}/policies.json")
         if raw:
             self.custom_policies = json.loads(raw)
+        raw = self.store.get(f"{IAM_PREFIX}/ldap-policy-map.json")
+        if raw:
+            self.ldap_policy_map = json.loads(raw)
 
     def _persist(self) -> None:
         if self.store is None:
             return
         with self._lock:
+            # Snapshot ALL maps under the lock: serializing a live dict that
+            # a concurrent mutator resizes raises mid-dumps and loses the
+            # update on restart.
             users = {k: v.to_dict() for k, v in self.users.items()}
+            policies = json.dumps(self.custom_policies)
+            ldap_map = json.dumps(self.ldap_policy_map)
         self.store.put(f"{IAM_PREFIX}/users.json", json.dumps(users).encode())
-        self.store.put(
-            f"{IAM_PREFIX}/policies.json", json.dumps(self.custom_policies).encode()
-        )
+        self.store.put(f"{IAM_PREFIX}/policies.json", policies.encode())
+        self.store.put(f"{IAM_PREFIX}/ldap-policy-map.json", ldap_map.encode())
+
+    # -- LDAP policy mapping (sts-handlers.go LDAP policy lookup role) -------
+
+    def set_ldap_policy(self, dn: str, policy_names: list[str]) -> None:
+        with self._lock:
+            if policy_names:
+                self.ldap_policy_map[dn] = list(policy_names)
+            else:
+                self.ldap_policy_map.pop(dn, None)
+        self._persist()
+
+    def ldap_policies_for(self, user_dn: str, group_dns: list[str]) -> list[str]:
+        """Union of policies attached to the user DN and its group DNs
+        (DN keys are compared case-insensitively, as LDAP DNs are)."""
+        with self._lock:
+            lowered = {k.lower(): v for k, v in self.ldap_policy_map.items()}
+        out: list[str] = []
+        for dn in [user_dn, *group_dns]:
+            for p in lowered.get(dn.lower(), []):
+                if p not in out:
+                    out.append(p)
+        return out
 
     # -- credential lookup (hot path for SigV4) ------------------------------
 

@@ -485,6 +485,8 @@ class SiteReplicationSys:
                 pass  # already gone: at-least-once replay must be idempotent
         elif kind == "policy-mapping":
             self.iam.attach_policy(payload["access_key"], payload["policies"])
+        elif kind == "ldap-policy-mapping":
+            self.iam.set_ldap_policy(payload["dn"], payload.get("policies", []))
         else:
             raise errors.InvalidArgument(msg=f"bad iam kind {kind!r}")
 

@@ -1,0 +1,173 @@
+"""In-process stub LDAP server for STS tests.
+
+Speaks the same RFC 4511 BER subset as minio_tpu.control.ldap (whose module
+helpers it reuses from the server side): simple bind against a credential
+map, subtree search with and/or/not/equality/present filters evaluated over
+a tiny in-memory directory, unbind. Single-threaded per connection.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+
+from minio_tpu.control.ldap import (
+    APP_BIND_REQ,
+    APP_BIND_RESP,
+    APP_SEARCH_DONE,
+    APP_SEARCH_ENTRY,
+    APP_SEARCH_REQ,
+    APP_UNBIND,
+    FILTER_AND,
+    FILTER_EQ,
+    FILTER_NOT,
+    FILTER_OR,
+    FILTER_PRESENT,
+    TAG_OCTET,
+    TAG_SEQ,
+    LDAPError,
+    ber_int,
+    ber_read,
+    ber_read_int,
+    tlv,
+)
+
+
+def _parse_filter(tag: int, content: bytes):
+    """BER filter -> ("and"|"or"|"not", [subs]) | ("eq", a, v) | ("present", a)."""
+    if tag in (FILTER_AND, FILTER_OR, FILTER_NOT):
+        subs, pos = [], 0
+        while pos < len(content):
+            t, c, pos = ber_read(content, pos)
+            subs.append(_parse_filter(t, c))
+        kind = {FILTER_AND: "and", FILTER_OR: "or", FILTER_NOT: "not"}[tag]
+        return (kind, subs)
+    if tag == FILTER_EQ:
+        _, attr, pos = ber_read(content)
+        _, val, _ = ber_read(content, pos)
+        return ("eq", attr.decode().lower(), val.decode())
+    if tag == FILTER_PRESENT:
+        return ("present", content.decode().lower())
+    raise LDAPError(f"stub: unsupported filter tag 0x{tag:02x}")
+
+
+def _matches(flt, attrs: dict[str, list[str]]) -> bool:
+    kind = flt[0]
+    if kind == "and":
+        return all(_matches(f, attrs) for f in flt[1])
+    if kind == "or":
+        return any(_matches(f, attrs) for f in flt[1])
+    if kind == "not":
+        return not _matches(flt[1][0], attrs)
+    if kind == "eq":
+        return flt[2] in attrs.get(flt[1], [])
+    return flt[1] in attrs  # present
+
+
+class StubLDAP:
+    """directory: {dn: {attr: [values]}}; passwords: {dn: password}."""
+
+    def __init__(self, directory: dict, passwords: dict):
+        self.directory = {dn.lower(): (dn, attrs) for dn, attrs in directory.items()}
+        self.passwords = {dn.lower(): pw for dn, pw in passwords.items()}
+        self.binds: list[str] = []
+        self._srv = socket.create_server(("127.0.0.1", 0))
+        self.addr = f"127.0.0.1:{self._srv.getsockname()[1]}"
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def close(self) -> None:
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        buf = b""
+        bound_dn = ""
+        try:
+            while True:
+                try:
+                    tag, content, nxt = ber_read(buf)
+                except LDAPError:
+                    chunk = conn.recv(65536)
+                    if not chunk:
+                        return
+                    buf += chunk
+                    continue
+                buf = buf[nxt:]
+                assert tag == TAG_SEQ
+                _, mid_raw, pos = ber_read(content)
+                mid = ber_read_int(mid_raw)
+                op_tag, op, _ = ber_read(content, pos)
+                if op_tag == APP_UNBIND:
+                    return
+                if op_tag == APP_BIND_REQ:
+                    _, _ver, pos = ber_read(op)
+                    _, dn_raw, pos = ber_read(op, pos)
+                    _, pw_raw, _ = ber_read(op, pos)
+                    dn = dn_raw.decode()
+                    self.binds.append(dn)
+                    # RFC 4513: empty password = anonymous bind, always ok.
+                    if not pw_raw:
+                        bound_dn = ""
+                        code = 0
+                    elif self.passwords.get(dn.lower()) == pw_raw.decode():
+                        bound_dn = dn
+                        code = 0
+                    else:
+                        code = 49  # invalidCredentials
+                    self._reply(conn, mid, APP_BIND_RESP, code)
+                elif op_tag == APP_SEARCH_REQ:
+                    _, base_raw, pos = ber_read(op)
+                    _, _scope, pos = ber_read(op, pos)
+                    _, _deref, pos = ber_read(op, pos)
+                    _, _sz, pos = ber_read(op, pos)
+                    _, _tm, pos = ber_read(op, pos)
+                    _, _types, pos = ber_read(op, pos)
+                    ftag = op[pos]
+                    _, fcontent, pos = ber_read(op, pos)
+                    flt = _parse_filter(ftag, fcontent)
+                    base = base_raw.decode().lower()
+                    for dn_l, (dn, attrs) in self.directory.items():
+                        if not dn_l.endswith(base):
+                            continue
+                        low = {k.lower(): v for k, v in attrs.items()}
+                        if _matches(flt, low):
+                            attr_seq = b"".join(
+                                tlv(TAG_SEQ,
+                                    tlv(TAG_OCTET, k.encode())
+                                    + tlv(0x31, b"".join(tlv(TAG_OCTET, v.encode()) for v in vs)))
+                                for k, vs in attrs.items()
+                            )
+                            entry = tlv(
+                                APP_SEARCH_ENTRY,
+                                tlv(TAG_OCTET, dn.encode()) + tlv(TAG_SEQ, attr_seq),
+                            )
+                            conn.sendall(tlv(TAG_SEQ, ber_int(mid) + entry))
+                    self._reply(conn, mid, APP_SEARCH_DONE, 0)
+                else:
+                    return
+        except (OSError, AssertionError, LDAPError):
+            return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _reply(conn, mid: int, op_tag: int, code: int) -> None:
+        body = (
+            ber_int(code, 0x0A) + tlv(TAG_OCTET, b"") + tlv(TAG_OCTET, b"")
+        )
+        conn.sendall(tlv(TAG_SEQ, ber_int(mid) + tlv(op_tag, body)))

@@ -69,7 +69,7 @@ def fed(tmp_path_factory):
     root = S3TestClient(endpoint, "fedroot", "fedroot-secret")
     root.make_bucket("fedbkt")
     root.put_object("fedbkt", "data.txt", b"federated read")
-    yield {"endpoint": endpoint, "key": key, "root": root, "iam": iam}
+    yield {"endpoint": endpoint, "key": key, "root": root, "iam": iam, "config": config}
     ts.stop()
 
 
@@ -190,6 +190,126 @@ def test_cred_lifetime_capped_by_token_exp(fed):
 def test_ldap_gated(fed):
     r = _sts_post(fed["endpoint"], {"Action": "AssumeRoleWithLDAPIdentity"})
     assert r.status_code == 501
+
+
+# -- LDAP identity (stub LDAP server; cmd/sts-handlers.go:447 role) ----------
+
+ALICE_DN = "uid=alice,ou=people,dc=example,dc=org"
+DEVS_DN = "cn=devs,ou=groups,dc=example,dc=org"
+
+
+@pytest.fixture()
+def ldap(fed):
+    from tests.ldapstub import StubLDAP
+
+    stub = StubLDAP(
+        directory={
+            ALICE_DN: {"uid": ["alice"], "objectclass": ["person"]},
+            "uid=bob,ou=people,dc=example,dc=org": {"uid": ["bob"], "objectclass": ["person"]},
+            DEVS_DN: {"objectclass": ["groupOfNames"], "member": [ALICE_DN]},
+        },
+        passwords={
+            ALICE_DN: "alice-pw",
+            "uid=bob,ou=people,dc=example,dc=org": "bob-pw",
+            "cn=lookup,dc=example,dc=org": "lookup-pw",
+        },
+    )
+    cfg_keys = {
+        "server_addr": stub.addr,
+        "lookup_bind_dn": "cn=lookup,dc=example,dc=org",
+        "lookup_bind_password": "lookup-pw",
+        "user_dn_search_base_dn": "ou=people,dc=example,dc=org",
+        "user_dn_search_filter": "(uid=%s)",
+        "group_search_base_dn": "ou=groups,dc=example,dc=org",
+        "group_search_filter": "(&(objectclass=groupOfNames)(member=%d))",
+    }
+    from minio_tpu.control.config import ConfigSys  # fed shares one ConfigSys
+
+    config = fed["config"]
+    for k, v in cfg_keys.items():
+        config.set("identity_ldap", k, v)
+    yield stub
+    for k in cfg_keys:
+        config.unset("identity_ldap", k)
+    fed["iam"].ldap_policy_map.clear()
+    stub.close()
+
+
+def _ldap_sts(fed, user, pw):
+    return _sts_post(
+        fed["endpoint"],
+        {
+            "Action": "AssumeRoleWithLDAPIdentity",
+            "LDAPUsername": user,
+            "LDAPPassword": pw,
+            "Version": "2011-06-15",
+        },
+    )
+
+
+def test_ldap_sts_flow_end_to_end(fed, ldap):
+    fed["iam"].set_ldap_policy(ALICE_DN, ["token-readers"])
+    r = _ldap_sts(fed, "alice", "alice-pw")
+    assert r.status_code == 200, r.text
+    ak, sk = _extract_creds(r.text)
+    c = S3TestClient(fed["endpoint"], ak, sk)
+    assert c.get_object("fedbkt", "data.txt").content == b"federated read"
+    # read-only policy: writes are denied
+    assert c.request("PUT", "/fedbkt/new.txt", body=b"x").status_code == 403
+
+
+def test_ldap_group_policy_mapping(fed, ldap):
+    # Policy attached to the GROUP DN only; alice inherits via membership.
+    fed["iam"].set_ldap_policy(DEVS_DN, ["token-readers"])
+    r = _ldap_sts(fed, "alice", "alice-pw")
+    assert r.status_code == 200, r.text
+    ak, sk = _extract_creds(r.text)
+    c = S3TestClient(fed["endpoint"], ak, sk)
+    assert c.get_object("fedbkt", "data.txt").status_code == 200
+    # bob is not in devs and has no mapping
+    r = _ldap_sts(fed, "bob", "bob-pw")
+    assert r.status_code == 403
+
+
+def test_ldap_wrong_password_rejected(fed, ldap):
+    fed["iam"].set_ldap_policy(ALICE_DN, ["token-readers"])
+    r = _ldap_sts(fed, "alice", "wrong")
+    assert r.status_code == 403
+    # the user bind was attempted and failed; no credential was minted
+    assert "<AccessKeyId>" not in r.text
+
+
+def test_ldap_empty_password_rejected(fed, ldap):
+    # RFC 4513 anonymous-bind bypass: empty password must be rejected
+    # client-side, never sent to the server as a bind.
+    fed["iam"].set_ldap_policy(ALICE_DN, ["token-readers"])
+    before = list(ldap.binds)
+    r = _ldap_sts(fed, "alice", "")
+    assert r.status_code == 400
+    assert ldap.binds == before
+
+
+def test_ldap_unknown_user(fed, ldap):
+    r = _ldap_sts(fed, "mallory", "x")
+    assert r.status_code == 403
+
+
+def test_ldap_filter_injection_escaped(fed, ldap):
+    # A username that would widen the filter to (uid=*) must not match.
+    fed["iam"].set_ldap_policy(ALICE_DN, ["token-readers"])
+    r = _ldap_sts(fed, "*", "alice-pw")
+    assert r.status_code == 403
+
+
+def test_ldap_filter_compile_unit():
+    from minio_tpu.control import ldap as ldap_mod
+
+    f = ldap_mod.compile_filter("(&(objectclass=person)(uid=al\\2aice))")
+    assert f[0] == ldap_mod.FILTER_AND
+    assert ldap_mod.compile_filter("(uid=*)")[0] == ldap_mod.FILTER_PRESENT
+    with pytest.raises(ldap_mod.LDAPError):
+        ldap_mod.compile_filter("(uid=par*tial)")
+    assert ldap_mod.escape_filter_value("a*(b)\\c") == "a\\2a\\28b\\29\\5cc"
 
 
 def test_certificate_flow_unit():
