@@ -75,6 +75,7 @@ class IAMSys:
         self.ldap_policy_map: dict[str, list[str]] = {}
         self.store = store  # object-layer-backed persistence (control/configsys)
         self._lock = threading.RLock()
+        self._persist_lock = threading.Lock()
 
     # -- persistence ---------------------------------------------------------
 
@@ -96,16 +97,17 @@ class IAMSys:
     def _persist(self) -> None:
         if self.store is None:
             return
-        with self._lock:
-            # Snapshot ALL maps under the lock: serializing a live dict that
-            # a concurrent mutator resizes raises mid-dumps and loses the
-            # update on restart.
-            users = {k: v.to_dict() for k, v in self.users.items()}
-            policies = json.dumps(self.custom_policies)
-            ldap_map = json.dumps(self.ldap_policy_map)
-        self.store.put(f"{IAM_PREFIX}/users.json", json.dumps(users).encode())
-        self.store.put(f"{IAM_PREFIX}/policies.json", policies.encode())
-        self.store.put(f"{IAM_PREFIX}/ldap-policy-map.json", ldap_map.encode())
+        # _persist_lock serializes whole persists so a stale snapshot can
+        # never overwrite a newer one; _lock (held briefly inside) protects
+        # the snapshot itself from concurrent mutation mid-serialization.
+        with self._persist_lock:
+            with self._lock:
+                users = {k: v.to_dict() for k, v in self.users.items()}
+                policies = json.dumps(self.custom_policies)
+                ldap_map = json.dumps(self.ldap_policy_map)
+            self.store.put(f"{IAM_PREFIX}/users.json", json.dumps(users).encode())
+            self.store.put(f"{IAM_PREFIX}/policies.json", policies.encode())
+            self.store.put(f"{IAM_PREFIX}/ldap-policy-map.json", ldap_map.encode())
 
     # -- LDAP policy mapping (sts-handlers.go LDAP policy lookup role) -------
 

@@ -38,6 +38,7 @@ APP_UNBIND = 0x42
 APP_SEARCH_REQ = 0x63
 APP_SEARCH_ENTRY = 0x64
 APP_SEARCH_DONE = 0x65
+APP_SEARCH_REF = 0x73  # SearchResultReference (referrals; AD returns these)
 CTX_SIMPLE_AUTH = 0x80
 FILTER_AND = 0xA0
 FILTER_OR = 0xA1
@@ -314,6 +315,8 @@ class LDAPClient:
                 if code != 0:
                     raise LDAPError(f"search failed (code {code}): {diag}")
                 return entries
+            elif op_tag == APP_SEARCH_REF:
+                continue  # referrals are not chased; AD sends them routinely
             else:
                 raise LDAPError(f"protocol: unexpected op 0x{op_tag:02x}")
 
@@ -381,7 +384,9 @@ def authenticate(conf: LDAPConfig, username: str, password: str) -> tuple[str, l
     try:
         lookup.bind(conf.lookup_bind_dn, conf.lookup_bind_password)
         flt = conf.user_dn_search_filter.replace("%s", escape_filter_value(username))
-        entries = lookup.search(conf.user_dn_search_base_dn, flt, [])
+        # "1.1" = noAttributes (RFC 4511): only the DN is used, so don't
+        # pull AD-sized attribute sets (jpegPhoto, huge member lists).
+        entries = lookup.search(conf.user_dn_search_base_dn, flt, ["1.1"])
         if not entries:
             raise LDAPError(f"user {username!r} not found")
         if len(entries) > 1:
@@ -399,7 +404,9 @@ def authenticate(conf: LDAPConfig, username: str, password: str) -> tuple[str, l
             gflt = conf.group_search_filter.replace(
                 "%d", escape_dn_value(user_dn)
             ).replace("%s", escape_filter_value(username))
-            groups = [dn for dn, _ in lookup.search(conf.group_search_base_dn, gflt, [])]
+            groups = [
+                dn for dn, _ in lookup.search(conf.group_search_base_dn, gflt, ["1.1"])
+            ]
         return user_dn, groups
     finally:
         lookup.close()

@@ -283,6 +283,104 @@ class TestDegraded:
         for s in servers:
             s.stop()
 
+    def test_node_killed_mid_write_under_load(self, tmp_path_factory):
+        """The harder half of verify-healing.sh: the node dies WHILE puts
+        are streaming (buildscripts/verify-healing.sh kills server
+        processes under load), not between them. Concurrent writers must
+        keep succeeding at quorum through the kill, the rejoined node gets
+        healed, and every object reads back bit-exact through BOTH sides."""
+        tmp = tmp_path_factory.mktemp("killload")
+        ports = [_free_port(), _free_port(), _free_port()]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        endpoints = []
+        for ni in range(3):
+            for di in range(2):
+                endpoints.append(f"{urls[ni]}{tmp}/n{ni}d{di}")
+
+        def boot(ni, node):
+            srv = ThreadedServer(SimpleNamespace(app=node.make_app()), port=ports[ni])
+            srv.start()
+            return srv
+
+        nodes = [
+            Node(endpoints, url=urls[ni], root_user=ROOT, root_password=SECRET, set_drive_count=6)
+            for ni in range(3)
+        ]
+        servers = [boot(ni, nodes[ni]) for ni in range(3)]
+        ths = [threading.Thread(target=n.build) for n in nodes]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join(60)
+        c0 = S3TestClient(urls[0], ROOT, SECRET)
+        c0.make_bucket("killb")
+
+        # 4 writer threads stream 2 MiB objects through node 0 continuously;
+        # the kill lands while several puts are mid-flight.
+        import hashlib as _hl
+
+        n_writers, per_writer = 4, 6
+        bodies: dict[str, bytes] = {}
+        results: dict[str, int] = {}
+        ready = threading.Barrier(n_writers + 1)
+
+        def writer(w):
+            c = S3TestClient(urls[0], ROOT, SECRET)
+            ready.wait()
+            for r in range(per_writer):
+                key = f"w{w}-r{r}"
+                body = _hl.sha256(key.encode()).digest() * (2 * 1024 * 1024 // 32)
+                bodies[key] = body
+                resp = c.put_object("killb", key, body)
+                results[key] = resp.status_code
+
+        writers = [threading.Thread(target=writer, args=(w,)) for w in range(n_writers)]
+        for t in writers:
+            t.start()
+        ready.wait()
+        # Gate the kill on observed progress, not wall clock: wait until a
+        # couple of puts have completed (writers are mid-stream on the
+        # rest), so the kill provably lands under load on any machine speed.
+        deadline = time.time() + 60
+        while len(results) < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(results) >= 2, "writers made no progress"
+        assert len(results) < n_writers * per_writer, "all puts finished before the kill"
+        servers[2].stop()  # kill node 2 under load
+        for t in writers:
+            t.join(120)
+        # Every put must have succeeded at quorum (4 of 6 drives alive).
+        assert all(code == 200 for code in results.values()), results
+        assert len(results) == n_writers * per_writer
+
+        # Node 2 rejoins over the same drives; wait out the REST backoff.
+        node2b = Node(
+            endpoints, url=urls[2], root_user=ROOT, root_password=SECRET, set_drive_count=6
+        )
+        servers[2] = boot(2, node2b)
+        node2b.build()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if all(d.is_online() and d.disk_id() for d in nodes[0].drives):
+                break
+            time.sleep(0.5)
+
+        # Heal converges: every object that lost shards rebuilds, and a
+        # second pass is clean.
+        healed_total = 0
+        for key in bodies:
+            healed_total += nodes[0].pools.heal_object("killb", key).disks_healed
+        assert healed_total >= 1, "kill landed after all writes? (timing too late)"
+        for key in bodies:
+            assert nodes[0].pools.heal_object("killb", key, dry_run=True).disks_healed == 0
+        # Bit-exact through the original node AND the rejoined one.
+        c2 = S3TestClient(urls[2], ROOT, SECRET)
+        for key, body in bodies.items():
+            assert c0.get_object("killb", key).content == body, key
+            assert c2.get_object("killb", key).content == body, key
+        for s in servers:
+            s.stop()
+
 
 class TestMultiPool:
     """Node-level multi-pool construction (round-3 weak #9): one node, two

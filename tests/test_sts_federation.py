@@ -223,9 +223,7 @@ def ldap(fed):
         "group_search_base_dn": "ou=groups,dc=example,dc=org",
         "group_search_filter": "(&(objectclass=groupOfNames)(member=%d))",
     }
-    from minio_tpu.control.config import ConfigSys  # fed shares one ConfigSys
-
-    config = fed["config"]
+    config = fed["config"]  # fed shares one ConfigSys
     for k, v in cfg_keys.items():
         config.set("identity_ldap", k, v)
     yield stub
