@@ -68,10 +68,8 @@ def make_console_app(ctx) -> web.Application:
     async def login(request: web.Request) -> web.Response:
         _ready()
         try:
-            doc = json.loads(await request.read() or b"{}")
-        except ValueError:
-            return _json({"error": "bad json"}, 400)
-        if not isinstance(doc, dict):
+            doc = await _body(request)
+        except web.HTTPBadRequest:
             return _json({"error": "bad json"}, 400)
         ak = doc.get("accessKey", "")
         sk = doc.get("secretKey", "")
@@ -190,6 +188,181 @@ def make_console_app(ctx) -> web.Application:
         text = await asyncio.to_thread(m.render) if m is not None else ""
         return web.Response(text=text, content_type="text/plain")
 
+    # -- management actions (the minio/console mutation surface: bucket,
+    # user, service-account CRUD and policy attach). These run the SAME
+    # post-mutation fan-out as the admin REST / S3 paths — peer IAM reload
+    # and site replication — or multi-node state diverges. -----------------
+
+    async def _body(request: web.Request) -> dict:
+        try:
+            doc = json.loads(await request.read() or b"{}")
+        except ValueError:
+            raise web.HTTPBadRequest(text="bad json")
+        if not isinstance(doc, dict):
+            raise web.HTTPBadRequest(text="bad json")
+        return doc
+
+    def _policies_field(doc: dict) -> list[str]:
+        policies = doc.get("policies", [])
+        if not isinstance(policies, list) or not all(
+            isinstance(p, str) for p in policies
+        ):
+            # A bare string would iterate per-character into nonsense
+            # policy names and "succeed" while denying everything.
+            raise web.HTTPBadRequest(text="policies must be a list of names")
+        return policies
+
+    def _iam_fanout(kind: str, payload: dict) -> None:
+        notification = getattr(ctx, "notification", None)
+        if notification is not None:
+            notification.reload_iam_all()
+        site = getattr(ctx, "site_repl", None)
+        if site is not None and getattr(site, "enabled", False):
+            site.on_iam(kind, payload)
+
+    async def bucket_create(request: web.Request) -> web.Response:
+        _authed(request)
+        doc = await _body(request)
+        name = doc.get("name", "")
+        if not isinstance(name, str) or not name:
+            return _json({"error": "name required"}, 400)
+
+        def work():
+            ctx.layer.make_bucket(name)
+            # Same hooks as the S3 PUT-bucket path (server.py _make_bucket):
+            # seed bucket metadata and fan out to site replication.
+            bm = getattr(ctx, "bucket_meta", None)
+            if bm is not None:
+                bm.save(bm.get(name))
+            site = getattr(ctx, "site_repl", None)
+            if site is not None and getattr(site, "enabled", False):
+                site.on_bucket_make(name)
+
+        try:
+            await asyncio.to_thread(work)
+        except (oerr.BucketExists,):
+            return _json({"error": f"bucket {name!r} exists"}, 409)
+        except oerr.StorageError as e:
+            return _json({"error": str(e)}, 400)
+        return _json({"ok": True})
+
+    async def bucket_delete(request: web.Request) -> web.Response:
+        _authed(request)
+        name = request.rel_url.query.get("name", "")
+        if not name:
+            return _json({"error": "name required"}, 400)
+
+        def work():
+            ctx.layer.delete_bucket(name)
+            # Same hooks as the S3 DELETE-bucket path: stale metadata left
+            # behind would be inherited by a later bucket of the same name.
+            bm = getattr(ctx, "bucket_meta", None)
+            if bm is not None:
+                bm.delete(name)
+            site = getattr(ctx, "site_repl", None)
+            if site is not None and getattr(site, "enabled", False):
+                site.on_bucket_delete(name)
+
+        try:
+            await asyncio.to_thread(work)
+        except oerr.BucketNotEmpty:
+            return _json({"error": "bucket not empty"}, 409)
+        except oerr.BucketNotFound:
+            return _json({"error": "no such bucket"}, 404)
+        except oerr.StorageError as e:
+            return _json({"error": str(e)}, 400)
+        return _json({"ok": True})
+
+    async def users_list(request: web.Request) -> web.Response:
+        _authed(request)
+        out = []
+        for ak, ident in sorted(ctx.iam.list_users().items()):
+            d = ident.to_dict(with_secret=False)
+            d.pop("sessionPolicy", None)
+            out.append(d)
+        return _json({"users": out})
+
+    async def user_create(request: web.Request) -> web.Response:
+        _authed(request)
+        doc = await _body(request)
+        ak, sk = doc.get("accessKey", ""), doc.get("secretKey", "")
+        if not ak or not sk or not isinstance(ak, str) or not isinstance(sk, str):
+            return _json({"error": "accessKey and secretKey required"}, 400)
+        if ak == ctx.iam.root.access_key:
+            return _json({"error": "cannot overwrite the root account"}, 403)
+        policies = _policies_field(doc)
+
+        def work():
+            ctx.iam.add_user(ak, sk, policies)
+            _iam_fanout("user", ctx.iam.users[ak].to_dict())
+
+        await asyncio.to_thread(work)
+        return _json({"ok": True})
+
+    async def user_delete(request: web.Request) -> web.Response:
+        _authed(request)
+        ak = request.rel_url.query.get("accessKey", "")
+
+        def work():
+            # Cascade to the user's service accounts: an orphan SA would
+            # silently revive if the access key is ever recreated.
+            children = [
+                sak for sak, ident in ctx.iam.list_users().items()
+                if ident.parent_user == ak
+            ]
+            ctx.iam.remove_user(ak)
+            for sak in children:
+                try:
+                    ctx.iam.remove_user(sak)
+                except oerr.StorageError:
+                    pass
+            _iam_fanout("user-delete", {"access_key": ak})
+            for sak in children:
+                _iam_fanout("user-delete", {"access_key": sak})
+
+        try:
+            await asyncio.to_thread(work)
+        except oerr.StorageError as e:
+            return _json({"error": str(e)}, 404)
+        return _json({"ok": True})
+
+    async def user_policy(request: web.Request) -> web.Response:
+        _authed(request)
+        doc = await _body(request)
+        ak = doc.get("accessKey", "")
+        policies = _policies_field(doc)
+
+        def work():
+            ctx.iam.attach_policy(ak, policies)
+            _iam_fanout("policy-mapping", {"access_key": ak, "policies": policies})
+
+        try:
+            await asyncio.to_thread(work)
+        except oerr.StorageError as e:
+            return _json({"error": str(e)}, 404)
+        return _json({"ok": True})
+
+    async def sa_create(request: web.Request) -> web.Response:
+        ak = _authed(request)
+        doc = await _body(request)
+        parent = doc.get("parent", "") or ak
+
+        def work():
+            creds = ctx.iam.new_service_account(parent)
+            _iam_fanout("user", ctx.iam.users[creds.access_key].to_dict())
+            return creds
+
+        creds = await asyncio.to_thread(work)
+        # The secret is shown ONCE at creation, as in the reference console.
+        return _json({"accessKey": creds.access_key, "secretKey": creds.secret_key})
+
+    async def policies_list(request: web.Request) -> web.Response:
+        _authed(request)
+        from ..control import policy as policy_mod
+
+        names = sorted({*policy_mod.CANNED, *ctx.iam.custom_policies})
+        return _json({"policies": names})
+
     async def index(request: web.Request) -> web.Response:
         return web.Response(text=_PAGE, content_type="text/html")
 
@@ -198,6 +371,14 @@ def make_console_app(ctx) -> web.Application:
     app.router.add_get("/api/buckets", buckets)
     app.router.add_get("/api/objects", objects)
     app.router.add_get("/api/metrics", metrics)
+    app.router.add_post("/api/buckets", bucket_create)
+    app.router.add_delete("/api/buckets", bucket_delete)
+    app.router.add_get("/api/users", users_list)
+    app.router.add_post("/api/users", user_create)
+    app.router.add_delete("/api/users", user_delete)
+    app.router.add_put("/api/users/policy", user_policy)
+    app.router.add_post("/api/service-accounts", sa_create)
+    app.router.add_get("/api/policies", policies_list)
     app.router.add_get("", index)
     app.router.add_get("/", index)
     return app
@@ -234,6 +415,9 @@ _PAGE = """<!doctype html>
  .crumbs { margin: 12px 0; color: #7c8a9c; } .hide { display: none; }
 </style></head><body>
 <header><h1>minio_tpu</h1><span>console</span>
+ <nav id="nav" class="hide" style="margin-left:24px">
+  <a id="nav-b">buckets</a> &nbsp; <a id="nav-u">users</a> &nbsp; <a id="nav-p">policies</a>
+ </nav>
  <span style="margin-left:auto"><a id="logout" class="hide">sign out</a></span></header>
 <main>
  <div id="login"><h3>Sign in</h3>
@@ -243,6 +427,8 @@ _PAGE = """<!doctype html>
  <div id="dash" class="hide">
   <div class="cards" id="cards"></div>
   <div class="crumbs" id="crumbs"></div>
+  <div id="actions"></div>
+  <div class="err" id="aerr"></div>
   <table id="tbl"><thead></thead><tbody></tbody></table>
  </div>
 </main><script>
@@ -257,7 +443,7 @@ const api = async (p, opt = {}) => {
 function out() {
   tok = ''; sessionStorage.removeItem('tok');
   $('#login').classList.remove('hide'); $('#dash').classList.add('hide');
-  $('#logout').classList.add('hide');
+  $('#logout').classList.add('hide'); $('#nav').classList.add('hide');
 }
 $('#logout').onclick = out;
 $('#go').onclick = async () => {
@@ -291,9 +477,26 @@ const head = cols => {
   $('#tbl thead').replaceChildren(tr);
   $('#tbl tbody').replaceChildren();
 };
+// Mutations report failures in #aerr; the acting view refreshes after.
+const act = async (method, p, body) => {
+  $('#aerr').textContent = '';
+  const r = await api(p, {method, body: body == null ? undefined : JSON.stringify(body)});
+  let d = {};
+  try { d = await r.json(); } catch {}
+  if (!r.ok) { $('#aerr').textContent = d.error || ('failed (' + r.status + ')'); throw 0; }
+  return d;
+};
+const input = (ph, type) => {
+  const i = el('input'); i.placeholder = ph; if (type) i.type = type;
+  i.style.width = '180px'; i.style.margin = '0 8px 0 0'; return i;
+};
+const btn = (label, onclick) => {
+  const b = el('button', label, onclick);
+  b.style.width = 'auto'; b.style.marginTop = '0'; b.style.padding = '7px 14px'; return b;
+};
 async function boot() {
   $('#login').classList.add('hide'); $('#dash').classList.remove('hide');
-  $('#logout').classList.remove('hide');
+  $('#logout').classList.remove('hide'); $('#nav').classList.remove('hide');
   const i = await (await api('/info')).json();
   const cards = [['pools', i.pools], ['sets', i.sets], ['drives online', i.drivesOnline],
     ['drives total', i.drivesTotal], ['objects', i.usage.objectsCount ?? '\\u2013'],
@@ -303,19 +506,83 @@ async function boot() {
   }));
   showBuckets();
 }
+$('#nav-b').onclick = () => showBuckets();
+$('#nav-u').onclick = () => showUsers();
+$('#nav-p').onclick = () => showPolicies();
 async function showBuckets() {
   $('#crumbs').replaceChildren(el('a', 'buckets', showBuckets));
+  const name = input('new bucket name');
+  $('#actions').replaceChildren(name,
+    btn('create bucket', async () => {
+      await act('POST', '/buckets', {name: name.value}); showBuckets();
+    }));
   const d = await (await api('/buckets')).json();
-  head(['bucket', 'objects', 'size']);
+  head(['bucket', 'objects', 'size', '']);
   const body = $('#tbl tbody');
-  if (!d.buckets.length) body.append(row(['no buckets', '', '']));
+  if (!d.buckets.length) body.append(row(['no buckets', '', '', '']));
   for (const b of d.buckets)
     body.append(row([el('a', b.name, () => showObjs(b.name, '')),
-      b.objects ?? '\\u2013', fmt(b.size)]));
+      b.objects ?? '\\u2013', fmt(b.size),
+      el('a', 'delete', async () => {
+        if (!confirm('Delete bucket ' + b.name + '?')) return;
+        await act('DELETE', '/buckets?' + new URLSearchParams({name: b.name}));
+        showBuckets();
+      })]));
+}
+async function showUsers() {
+  $('#crumbs').replaceChildren(el('b', 'users'));
+  const ak = input('access key'), sk = input('secret key', 'password'),
+        pol = input('policies (comma-sep)');
+  $('#actions').replaceChildren(ak, sk, pol,
+    btn('create user', async () => {
+      await act('POST', '/users', {accessKey: ak.value, secretKey: sk.value,
+        policies: pol.value.split(',').map(s => s.trim()).filter(Boolean)});
+      showUsers();
+    }));
+  const d = await (await api('/users')).json();
+  head(['access key', 'status', 'policies', 'parent', '']);
+  const body = $('#tbl tbody');
+  if (!d.users.length) body.append(row(['no users', '', '', '', '']));
+  for (const u of d.users) {
+    const actions = el('span');
+    actions.append(
+      el('a', 'attach policy', async () => {
+        const p = prompt('Policies for ' + u.accessKey + ' (comma-sep):',
+          u.policies.join(','));
+        if (p == null) return;
+        await act('PUT', '/users/policy', {accessKey: u.accessKey,
+          policies: p.split(',').map(s => s.trim()).filter(Boolean)});
+        showUsers();
+      }),
+      el('span', ' \\u00b7 '),
+      el('a', 'svc acct', async () => {
+        const c = await act('POST', '/service-accounts', {parent: u.accessKey});
+        // shown once; the secret is not retrievable later
+        prompt('Service account created \\u2014 copy these now:',
+          c.accessKey + ' / ' + c.secretKey);
+      }),
+      el('span', ' \\u00b7 '),
+      el('a', 'delete', async () => {
+        if (!confirm('Delete user ' + u.accessKey + '?')) return;
+        await act('DELETE', '/users?' + new URLSearchParams({accessKey: u.accessKey}));
+        showUsers();
+      }));
+    body.append(row([u.accessKey, u.status, u.policies.join(', ') || '\\u2013',
+      u.parentUser || '\\u2013', actions]));
+  }
+}
+async function showPolicies() {
+  $('#crumbs').replaceChildren(el('b', 'policies'));
+  $('#actions').replaceChildren();
+  const d = await (await api('/policies')).json();
+  head(['policy']);
+  const body = $('#tbl tbody');
+  for (const p of d.policies) body.append(row([p]));
 }
 async function showObjs(bucket, prefix, marker = '') {
   $('#crumbs').replaceChildren(el('a', 'buckets', showBuckets),
     el('span', ' / '), el('b', bucket), el('span', ' / ' + prefix));
+  $('#actions').replaceChildren();
   const q = new URLSearchParams({bucket, prefix, marker, 'max-keys': '100'});
   const d = await (await api('/objects?' + q)).json();
   head(['key', 'size', 'modified']);

@@ -93,6 +93,78 @@ def test_non_admin_user_rejected(srv):
     assert _login(srv["base"], ak="plainuser", sk="plainsecret1234").status_code == 403
 
 
+def test_management_loop(srv):
+    """The operator's basic management loop, console API only: create a
+    bucket, create a user with a policy, re-attach policies, mint a
+    service account, delete everything — no raw admin REST involved."""
+    base = srv["base"]
+    hdrs = {"Authorization": "Bearer " + _login(base).json()["token"]}
+
+    def call(method, path, body=None, **kw):
+        return requests.request(
+            method, f"{base}/mtpu/console/api{path}",
+            headers=hdrs, data=json.dumps(body) if body is not None else None,
+            timeout=10, **kw,
+        )
+
+    # bucket create / duplicate / delete
+    assert call("POST", "/buckets", {"name": "mgmtb"}).status_code == 200
+    assert call("POST", "/buckets", {"name": "mgmtb"}).status_code == 409
+    names = [b["name"] for b in call("GET", "/buckets").json()["buckets"]]
+    assert "mgmtb" in names
+
+    # user create with policy, listed without secrets
+    r = call("POST", "/users",
+             {"accessKey": "conuser", "secretKey": "consecret123", "policies": ["readonly"]})
+    assert r.status_code == 200, r.text
+    users = {u["accessKey"]: u for u in call("GET", "/users").json()["users"]}
+    assert users["conuser"]["policies"] == ["readonly"]
+    assert users["conuser"]["secretKey"] == ""
+    # root cannot be overwritten through the console
+    assert call("POST", "/users",
+                {"accessKey": ROOT, "secretKey": "x" * 12}).status_code == 403
+
+    # the created user actually works against S3 (policy-scoped)
+    import sys
+    sys.path.insert(0, os.path.dirname(__file__))
+    from s3client import S3TestClient
+
+    cu = S3TestClient(base, "conuser", "consecret123")
+    assert cu.request("GET", "/mgmtb", query=[("list-type", "2")]).status_code == 200
+    assert cu.request("PUT", "/mgmtb/denied.txt", body=b"x").status_code == 403
+
+    # policy re-attach widens access
+    assert call("PUT", "/users/policy",
+                {"accessKey": "conuser", "policies": ["readwrite"]}).status_code == 200
+    assert cu.request("PUT", "/mgmtb/ok.txt", body=b"x").status_code == 200
+
+    # service account under the user; creds shown once and usable
+    sa = call("POST", "/service-accounts", {"parent": "conuser"}).json()
+    sc = S3TestClient(base, sa["accessKey"], sa["secretKey"])
+    assert sc.request("GET", "/mgmtb", query=[("list-type", "2")]).status_code == 200
+
+    # policies list covers canned + custom
+    assert "readonly" in call("GET", "/policies").json()["policies"]
+
+    # a bare-string policies field must 400, not fragment per character
+    assert call("POST", "/users",
+                {"accessKey": "frag", "secretKey": "fragsecret12",
+                 "policies": "readonly"}).status_code == 400
+
+    # cleanup: deleting the user cascades to its service accounts
+    assert call("DELETE", "/users", params={"accessKey": "conuser"}).status_code == 200
+    assert _login(base, ak="conuser", sk="consecret123").status_code == 401
+    remaining = {u["accessKey"] for u in call("GET", "/users").json()["users"]}
+    assert sa["accessKey"] not in remaining, "orphan service account survived"
+    assert sc.request("GET", "/mgmtb", query=[("list-type", "2")]).status_code == 403
+    cu2 = S3TestClient(base, "conuser", "consecret123")
+    assert cu2.request("GET", "/mgmtb", query=[("list-type", "2")]).status_code == 403
+    assert call("DELETE", "/buckets", params={"name": "mgmtb"}).status_code == 409  # not empty
+    srv["node"].pools.delete_object("mgmtb", "ok.txt")
+    assert call("DELETE", "/buckets", params={"name": "mgmtb"}).status_code == 200
+    assert call("DELETE", "/buckets", params={"name": "mgmtb"}).status_code == 404
+
+
 def test_503_before_build(tmp_path):
     dirs = []
     for i in range(4):
