@@ -254,6 +254,23 @@ def default_parity(drive_count: int) -> int:
     return 4
 
 
+def _join_block_rows(rows, k: int, need: int) -> bytes:
+    """Join the first k shard rows into EXACTLY `need` bytes of block data.
+
+    Shards pad the tail (k*chunk >= block length), so joining whole rows
+    and slicing afterward re-copied every block; trimming the tail rows
+    first makes the join itself produce the block."""
+    pieces: list = []
+    for j in range(k):
+        r = rows[j]
+        take = min(len(r), need)
+        pieces.append(r if take == len(r) else memoryview(r)[:take])
+        need -= take
+        if need <= 0:
+            break
+    return b"".join(pieces)
+
+
 def _whole_layout(metas) -> bool:
     """Majority vote across drive metas on the whole-file-bitrot layout.
 
@@ -1079,10 +1096,11 @@ class ErasureObjects:
                         rows_by_block[wi][j] = chunks[slot]
 
             for b in range(g0, g1 + 1):
-                rows = rows_by_block[b - g0]
-                joined = b"".join(rows[j] for j in range(k))  # type: ignore[misc]
+                joined = _join_block_rows(rows_by_block[b - g0], k, block_len(b))
                 s = max(lo - b * BLOCK_SIZE, 0)
                 e = min(hi - b * BLOCK_SIZE, block_len(b))
+                # Full-range slice of bytes returns the same object, so a
+                # full-block yield is copy-free now that the join is exact.
                 yield joined[s:e]
 
     def _stream_part_range_whole(
@@ -1182,10 +1200,11 @@ class ErasureObjects:
                     for slot, j in enumerate(missing):
                         rows[j] = chunks[slot]
             for b in range(g0, g1 + 1):
-                rows = rows_by_block[b - g0]
-                joined = b"".join(rows[j] for j in range(k))  # type: ignore[misc]
+                joined = _join_block_rows(rows_by_block[b - g0], k, block_len(b))
                 s = max(lo - b * BLOCK_SIZE, 0)
                 e = min(hi - b * BLOCK_SIZE, block_len(b))
+                # Full-range slice of bytes returns the same object, so a
+                # full-block yield is copy-free now that the join is exact.
                 yield joined[s:e]
 
     # ---------------------------------------------------------------- delete
