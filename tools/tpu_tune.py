@@ -17,14 +17,19 @@ jit caches cleared per point. Prints one line per point; run under
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 
 import numpy as np
 
 K, M = 12, 4
-BLOCK = 1 << 20
+# Env knobs: shrink for harness smoke-tests off-chip (Pallas interpret mode
+# is orders of magnitude slower than compiled) or for short chip windows.
+BLOCK = int(os.environ.get("TUNE_BLOCK", str(1 << 20)))
 SHARD = -(-BLOCK // K)
+BATCH_Q = int(os.environ.get("TUNE_BATCH", "128"))
+STREAMS_Q = int(os.environ.get("TUNE_STREAMS", "1024"))
 
 
 def _time(fn, arg, iters=8) -> float:
@@ -39,6 +44,50 @@ def _time(fn, arg, iters=8) -> float:
     return time.perf_counter() - t0
 
 
+# (family, label, gibs, exact); exact: True = oracle-checked ok,
+# False = mismatch/failure, None = no oracle for this family (timing only).
+_RESULTS: list[tuple[str, str, float, bool | None]] = []
+_OUT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "tune_last.txt")
+
+
+def _report(family: str, label: str, gibs: float, exact: bool | None) -> None:
+    """Print AND append to the durable record immediately: chip windows are
+    short and runs sit under `timeout` — points measured before a kill must
+    survive it (scrollback doesn't)."""
+    _RESULTS.append((family, label, gibs, exact))
+    tag = {True: "ok", False: "FAIL", None: "unchecked"}[exact]
+    print(f"{label}: {gibs:.2f} GiB/s [{tag}]")
+    mode = "a" if _RESULTS[1:] else "w"
+    with open(_OUT_PATH, mode) as f:
+        if mode == "w":
+            f.write(f"# tpu_tune results {time.strftime('%Y-%m-%dT%H:%M:%S')}\n")
+        f.write(f"{family}\t{label}\t{gibs:.3f}\t{tag}\n")
+
+
+def _fail(family: str, label: str, err: str) -> None:
+    print(f"{label}: FAIL {err}")
+    _report(family, f"{label} ({err})", 0.0, False)
+
+
+def _summary() -> None:
+    """Winners per family (mismatched/failed points are never winners;
+    families without an oracle are reported as timing-only)."""
+    fams: dict[str, tuple[str, float, bool | None]] = {}
+    for family, label, gibs, exact in _RESULTS:
+        if exact is not False and (family not in fams or gibs > fams[family][1]):
+            fams[family] = (label, gibs, exact)
+    lines = [
+        f"[tune] BEST {fam}: {label} ({gibs:.2f} GiB/s"
+        f"{', timing-only' if exact is None else ''})"
+        for fam, (label, gibs, exact) in fams.items()
+    ]
+    for ln in lines:
+        print(ln)
+    with open(_OUT_PATH, "a") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"[tune] results written to {_OUT_PATH}")
+
+
 def main() -> None:
     quick = (sys.argv[1:] or ["quick"])[0] == "quick"
     import jax
@@ -51,30 +100,32 @@ def main() -> None:
     import minio_tpu.ops.rs_pallas as rp
     from minio_tpu.ops import rs
 
-    batch = 128 if quick else 512
+    batch = BATCH_Q if quick else max(512, BATCH_Q)
     data = rng.integers(0, 256, (batch, K, SHARD), dtype=np.uint8)
     dev = jax.device_put(jnp.asarray(data))
     codec = rs.RSCodec(K, M)
     dt = _time(jax.jit(codec.encode), dev)
-    print(f"xla encode: {batch * BLOCK * 8 / dt / 2**30:.2f} GiB/s")
+    # Timing-only here: the XLA encode is golden-pinned by the test suite,
+    # not re-checked in this run.
+    _report("rs-encode", "xla encode", batch * BLOCK * 8 / dt / 2**30, None)
     for ts in (4096, 8192, 16384) if quick else (2048, 4096, 8192, 16384, 32768):
-        rp.TILE_S = ts
-        rp._apply_padded.clear_cache()
-        pcodec = rp.RSPallasCodec(K, M)
         try:
+            rp.TILE_S = ts
+            rp._apply_padded.clear_cache()
+            pcodec = rp.RSPallasCodec(K, M)
             ok = np.array_equal(
                 np.asarray(codec.encode(dev[:2])), np.asarray(pcodec.encode(dev[:2]))
             )
             dt = _time(jax.jit(pcodec.encode), dev)
-            print(f"pallas rs TILE_S={ts}: {batch * BLOCK * 8 / dt / 2**30:.2f} GiB/s exact={ok}")
+            _report("rs-encode", f"pallas rs TILE_S={ts}", batch * BLOCK * 8 / dt / 2**30, ok)
         except Exception as e:  # noqa: BLE001
-            print(f"pallas rs TILE_S={ts}: FAIL {str(e)[:120]}")
+            _fail("rs-encode", f"pallas rs TILE_S={ts}", str(e)[:120])
 
     # --- hash sweeps -----------------------------------------------------
     from minio_tpu.ops import highwayhash as hh_host
     from minio_tpu.ops import highwayhash_jax as hhj
 
-    streams = 1024 if quick else 4096
+    streams = STREAMS_Q if quick else max(4096, STREAMS_Q)
     hdata_np = rng.integers(0, 256, (streams, SHARD), dtype=np.uint8)
     hdata = jax.device_put(jnp.asarray(hdata_np))
     oracle = hh_host.hash256_batch(hdata_np[:2])
@@ -85,11 +136,9 @@ def main() -> None:
         try:
             ok = np.array_equal(np.asarray(hhj.hash256_batch(hdata[:2])), oracle)
             dt = _time(jax.jit(hhj.hash256_batch), hdata)
-            print(
-                f"xla hash CHUNK={chunk}: {hdata.size * 8 / dt / 2**30:.2f} GiB/s exact={ok}"
-            )
+            _report("hash", f"xla hash CHUNK={chunk}", hdata.size * 8 / dt / 2**30, ok)
         except Exception as e:  # noqa: BLE001
-            print(f"xla hash CHUNK={chunk}: FAIL {str(e)[:120]}")
+            _fail("hash", f"xla hash CHUNK={chunk}", str(e)[:120])
     hhj.CHUNK = None
     hhj._hh256_impl.clear_cache()
 
@@ -105,29 +154,29 @@ def main() -> None:
         try:
             ok = np.array_equal(np.asarray(hhp.hash256_batch(hdata[:2])), oracle)
             dt = _time(jax.jit(hhp.hash256_batch), hdata)
-            print(
-                f"pallas hash TILE_N={tile_n} CHUNK_P={chunk_p}: "
-                f"{hdata.size * 8 / dt / 2**30:.2f} GiB/s exact={ok}"
+            _report(
+                "hash", f"pallas hash TILE_N={tile_n} CHUNK_P={chunk_p}",
+                hdata.size * 8 / dt / 2**30, ok,
             )
         except Exception as e:  # noqa: BLE001
-            print(f"pallas hash TILE_N={tile_n} CHUNK_P={chunk_p}: FAIL {str(e)[:150]}")
+            _fail("hash", f"pallas hash TILE_N={tile_n} CHUNK_P={chunk_p}", str(e)[:150])
 
     # --- fused at serving batch sizes ------------------------------------
     from minio_tpu.models import pipeline as pipe_mod
 
-    for fb in (16, 32, 64) if quick else (16, 32, 64, 128):
+    grid = (16, 32, 64) if quick else (16, 32, 64, 128)
+    for fb in sorted({min(fb, batch) for fb in grid}):
         fdata = jax.device_put(jnp.asarray(data[:fb]))
         for impl in ("xla", "pallas"):
-            import os
-
             os.environ["MINIO_TPU_HASH"] = impl
             p = pipe_mod.ErasurePipeline(pipe_mod.Geometry(K, M))
             try:
                 dt = _time(p.encode, fdata, iters=4)
-                print(f"fused B={fb} hash={impl}: {fb * BLOCK * 4 / dt / 2**30:.2f} GiB/s")
+                _report("fused", f"fused B={fb} hash={impl}", fb * BLOCK * 4 / dt / 2**30, None)
             except Exception as e:  # noqa: BLE001
-                print(f"fused B={fb} hash={impl}: FAIL {str(e)[:120]}")
+                _fail("fused", f"fused B={fb} hash={impl}", str(e)[:120])
         os.environ.pop("MINIO_TPU_HASH", None)
+    _summary()
 
 
 if __name__ == "__main__":
