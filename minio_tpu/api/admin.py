@@ -158,6 +158,40 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             raise S3Error("NotImplemented", "no KMS configured")
         return {**ctx.kms.stat(), "key-check": _kms_key_check("")}
 
+    def h_update(request, body):
+        # ServerUpdate role (cmd/admin-handlers.go ServerUpdateHandler):
+        # check + verify + STAGE only. Swapping the live tree out from
+        # under a running interpreter is a CLI decision
+        # (`minio_tpu update --apply` + restart), not an HTTP side effect.
+        from ..control import update as upd
+
+        url = request.rel_url.query.get("url", "")
+        if not url:
+            raise S3Error("InvalidRequest", "url query parameter required")
+        import os as os_mod
+        import tempfile
+
+        stage = request.rel_url.query.get(
+            "stage-dir", os_mod.path.join(tempfile.gettempdir(), "minio_tpu-updates")
+        )
+        try:
+            info = upd.check_update(url)
+            os_mod.makedirs(stage, exist_ok=True)
+            staged = upd.download_and_stage(info, stage)
+        except upd.UpdateError as e:
+            raise S3Error("XMinioAdminUpdateApplyFailure", str(e))
+        return {
+            **upd.update_status(),
+            "available": info.version,
+            "staged": staged,
+            "note": "apply via `minio_tpu update --apply` + restart",
+        }
+
+    def h_update_status(request, body):
+        from ..control import update as upd
+
+        return upd.update_status()
+
     def h_kms_key_status(request, body):
         if ctx.kms is None:
             raise S3Error("NotImplemented", "no KMS configured")
@@ -721,6 +755,8 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_put("/quota", handler(h_set_quota))
     app.router.add_get("/bandwidth", handler(h_bandwidth))
     app.router.add_get("/kms/status", handler(h_kms_status))
+    app.router.add_post("/update", handler(h_update))
+    app.router.add_get("/update", handler(h_update_status))
     app.router.add_get("/kms/key/status", handler(h_kms_key_status))
     app.router.add_get("/inspect", handler(h_inspect))
     app.router.add_get("/config", handler(h_get_config))

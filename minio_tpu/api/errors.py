@@ -65,6 +65,7 @@ ERRORS = {
     "NoSuchObjectLockConfiguration": APIError("NoSuchObjectLockConfiguration", "The specified object does not have an ObjectLock configuration.", 404),
     "NotImplemented": APIError("NotImplemented", "A header you provided implies functionality that is not implemented.", 501),
     "XMinioAdminBucketQuotaExceeded": APIError("XMinioAdminBucketQuotaExceeded", "Bucket quota exceeded", 400),
+    "XMinioAdminUpdateApplyFailure": APIError("XMinioAdminUpdateApplyFailure", "Server update failed", 400),
     "PreconditionFailed": APIError("PreconditionFailed", "At least one of the pre-conditions you specified did not hold.", 412),
     "RequestTimeTooSkewed": APIError("RequestTimeTooSkewed", "The difference between the request time and the server's time is too large.", 403),
     "SignatureDoesNotMatch": APIError("SignatureDoesNotMatch", "The request signature we calculated does not match the signature you provided.", 403),

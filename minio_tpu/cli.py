@@ -420,8 +420,52 @@ def main(argv: list[str] | None = None) -> int:
         return serve(rest)
     if cmd == "gateway":
         return gateway(rest)
-    print(f"unknown command {cmd!r}; supported: server, gateway", file=sys.stderr)
+    if cmd == "update":
+        return update_cmd(rest)
+    print(f"unknown command {cmd!r}; supported: server, gateway, update", file=sys.stderr)
     return 2
+
+
+def update_cmd(argv: list[str]) -> int:
+    """`minio_tpu update <base-url>`: check + verify + stage a release
+    (cmd/update.go role). Applies only with --apply; otherwise it stages
+    and prints what it would do — updates should be two-phase on servers."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="minio_tpu update")
+    p.add_argument("url", help="release base URL (https:// or file:// mirror)")
+    p.add_argument("--stage-dir", default=os.path.expanduser("~/.minio_tpu/updates"))
+    p.add_argument("--apply", action="store_true", help="swap the running tree")
+    p.add_argument(
+        "--allow-unsigned", action="store_true",
+        help="accept a release without a signature (NOT for production)",
+    )
+    a = p.parse_args(argv)
+    from .control import update as upd
+
+    try:
+        info = upd.check_update(a.url, allow_unsigned=a.allow_unsigned)
+        print(f"release: {info.version} sha256={info.sha256[:16]}...")
+        os.makedirs(a.stage_dir, exist_ok=True)
+        staged = upd.download_and_stage(info, a.stage_dir)
+        print(f"staged: {staged}")
+        if a.apply:
+            # Swap the PACKAGE directory only: the grandparent would be
+            # site-packages (or the repo root) and swapping that would
+            # discard every other installed package.
+            install = os.path.dirname(os.path.abspath(__file__))
+            staged_pkg = os.path.join(staged, "minio_tpu")
+            if not os.path.isdir(staged_pkg):
+                print("update failed: release has no minio_tpu/ tree", file=sys.stderr)
+                return 1
+            backup = upd.apply_staged(staged_pkg, install)
+            print(f"applied; previous tree at {backup}. Restart to load {info.version}.")
+        else:
+            print("not applied (pass --apply to swap the install tree)")
+        return 0
+    except upd.UpdateError as e:
+        print(f"update failed: {e}", file=sys.stderr)
+        return 1
 
 
 if __name__ == "__main__":

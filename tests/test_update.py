@@ -1,0 +1,195 @@
+"""Self-update: signed release verification, staging, apply/rollback
+(cmd/update.go:587 role)."""
+
+import base64
+import hashlib
+import io
+import json
+import os
+import subprocess
+import sys
+import tarfile
+
+import pytest
+
+from minio_tpu.control import update as upd
+
+
+def _keypair():
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import Ed25519PrivateKey
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat,
+    )
+
+    priv = Ed25519PrivateKey.generate()
+    pub_raw = priv.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+    return priv, base64.b64encode(pub_raw).decode()
+
+
+def _make_release(tmp_path, version="0.6.0", tamper=None, sign=True, priv=None):
+    """Build a release mirror dir; returns (base_url, pubkey_b64)."""
+    priv_new, pub = (None, "")
+    if priv is None:
+        priv, pub = _keypair()
+    else:
+        pub = ""  # caller manages the key
+    mirror = tmp_path / f"mirror-{version}"
+    mirror.mkdir()
+    # a tiny "package": one top-level dir with a marker file
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+        data = f"version = {version!r}\n".encode()
+        ti = tarfile.TarInfo(f"minio_tpu/{'version.py'}")
+        ti.size = len(data)
+        tf.addfile(ti, io.BytesIO(data))
+    blob = buf.getvalue()
+    (mirror / "pkg.tar.gz").write_bytes(blob)
+    manifest = json.dumps(
+        {"version": version, "sha256": hashlib.sha256(blob).hexdigest(), "archive": "pkg.tar.gz"}
+    ).encode()
+    if tamper == "manifest":
+        manifest = manifest.replace(version.encode(), b"6.6.6")
+    (mirror / "RELEASE.json").write_bytes(manifest)
+    if sign:
+        sig = priv.sign(json.dumps(
+            {"version": version, "sha256": hashlib.sha256(blob).hexdigest(), "archive": "pkg.tar.gz"}
+        ).encode())
+        (mirror / "RELEASE.json.sig").write_bytes(sig)
+    if tamper == "archive":
+        (mirror / "pkg.tar.gz").write_bytes(blob + b"x")
+    return f"file://{mirror}", pub
+
+
+class TestUpdate:
+    def test_signed_check_stage_apply_rollback(self, tmp_path):
+        url, pub = _make_release(tmp_path)
+        info = upd.check_update(url, pubkey_b64=pub)
+        assert info.version == "0.6.0"
+        staged = upd.download_and_stage(info, str(tmp_path / "stage"))
+        assert os.path.isfile(os.path.join(staged, "minio_tpu", "version.py"))
+        # apply swaps the install dir and keeps a rollback
+        install = tmp_path / "install"
+        install.mkdir()
+        (install / "old.txt").write_text("previous")
+        backup = upd.apply_staged(staged, str(install))
+        assert os.path.isfile(install / "minio_tpu" / "version.py")
+        assert os.path.isfile(os.path.join(backup, "old.txt"))
+
+    def test_tampered_manifest_rejected(self, tmp_path):
+        url, pub = _make_release(tmp_path, tamper="manifest")
+        with pytest.raises(upd.UpdateError, match="signature"):
+            upd.check_update(url, pubkey_b64=pub)
+
+    def test_tampered_archive_rejected(self, tmp_path):
+        url, pub = _make_release(tmp_path, tamper="archive")
+        info = upd.check_update(url, pubkey_b64=pub)
+        with pytest.raises(upd.UpdateError, match="sha256"):
+            upd.download_and_stage(info, str(tmp_path / "stage"))
+
+    def test_unsigned_refused_without_optin(self, tmp_path):
+        url, _ = _make_release(tmp_path, sign=False)
+        with pytest.raises(upd.UpdateError, match="public key"):
+            upd.check_update(url, pubkey_b64="")
+        info = upd.check_update(url, pubkey_b64="", allow_unsigned=True)
+        assert info.version == "0.6.0"
+
+    def test_wrong_key_rejected(self, tmp_path):
+        url, _ = _make_release(tmp_path)
+        _, other_pub = _keypair()
+        with pytest.raises(upd.UpdateError, match="signature"):
+            upd.check_update(url, pubkey_b64=other_pub)
+
+    def test_path_traversal_blocked(self, tmp_path):
+        priv, pub = _keypair()
+        mirror = tmp_path / "evil"
+        mirror.mkdir()
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            data = b"pwned"
+            ti = tarfile.TarInfo("../escape.txt")
+            ti.size = len(data)
+            tf.addfile(ti, io.BytesIO(data))
+        blob = buf.getvalue()
+        (mirror / "pkg.tar.gz").write_bytes(blob)
+        manifest = json.dumps(
+            {"version": "1", "sha256": hashlib.sha256(blob).hexdigest(), "archive": "pkg.tar.gz"}
+        ).encode()
+        (mirror / "RELEASE.json").write_bytes(manifest)
+        (mirror / "RELEASE.json.sig").write_bytes(priv.sign(manifest))
+        info = upd.check_update(f"file://{mirror}", pubkey_b64=pub)
+        with pytest.raises(upd.UpdateError, match="escapes|extraction"):
+            upd.download_and_stage(info, str(tmp_path / "stage"))
+        assert not (tmp_path / "escape.txt").exists()
+
+    def test_symlink_entry_blocked(self, tmp_path):
+        priv, pub = _keypair()
+        mirror = tmp_path / "sym"
+        mirror.mkdir()
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tf:
+            ti = tarfile.TarInfo("link")
+            ti.type = tarfile.SYMTYPE
+            ti.linkname = "/etc/passwd"
+            tf.addfile(ti)
+        blob = buf.getvalue()
+        (mirror / "pkg.tar.gz").write_bytes(blob)
+        manifest = json.dumps(
+            {"version": "1", "sha256": hashlib.sha256(blob).hexdigest(), "archive": "pkg.tar.gz"}
+        ).encode()
+        (mirror / "RELEASE.json").write_bytes(manifest)
+        (mirror / "RELEASE.json.sig").write_bytes(priv.sign(manifest))
+        info = upd.check_update(f"file://{mirror}", pubkey_b64=pub)
+        with pytest.raises(upd.UpdateError, match="link"):
+            upd.download_and_stage(info, str(tmp_path / "stage"))
+
+    def test_admin_update_endpoint(self, tmp_path, monkeypatch):
+        # Admin POST /update checks + stages (never applies over HTTP).
+        from types import SimpleNamespace
+
+        from minio_tpu.api.server import ThreadedServer
+        from minio_tpu.dist.node import Node
+        from minio_tpu.object.codec import HostCodec
+        from tests.s3client import S3TestClient
+
+        url, pub = _make_release(tmp_path, version="0.8.0")
+        monkeypatch.setenv(upd.PUBKEY_ENV, pub)
+        dirs = []
+        for i in range(4):
+            d = str(tmp_path / f"d{i}")
+            os.makedirs(d)
+            dirs.append(d)
+        node = Node(dirs, root_user="upadmin", root_password="updsecret1", codec=HostCodec())
+        ts = ThreadedServer(SimpleNamespace(app=node.make_app()))
+        base = ts.start()
+        try:
+            node.build()
+            c = S3TestClient(base, "upadmin", "updsecret1")
+            r = c.request("GET", "/mtpu/admin/v1/update")
+            assert r.status_code == 200 and r.json()["pubkey_configured"] is True
+            r = c.request(
+                "POST", "/mtpu/admin/v1/update",
+                query=[("url", url), ("stage-dir", str(tmp_path / "adm-stage"))],
+            )
+            assert r.status_code == 200, r.text
+            doc = r.json()
+            assert doc["available"] == "0.8.0"
+            assert os.path.isdir(doc["staged"])
+            # tampered mirror -> clean admin error, nothing staged
+            bad_url, _ = _make_release(tmp_path, version="0.9.0", tamper="manifest")
+            r = c.request("POST", "/mtpu/admin/v1/update", query=[("url", bad_url)])
+            assert r.status_code >= 400
+        finally:
+            ts.stop()
+
+    def test_cli_update_stages(self, tmp_path):
+        url, pub = _make_release(tmp_path, version="0.7.0")
+        env = {**os.environ, "MINIO_TPU_UPDATE_PUBKEY": pub,
+               "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.abspath(__file__)))}
+        r = subprocess.run(
+            [sys.executable, "-m", "minio_tpu", "update", url,
+             "--stage-dir", str(tmp_path / "cli-stage")],
+            capture_output=True, text=True, timeout=60, env=env,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "staged:" in r.stdout and "not applied" in r.stdout
+        assert (tmp_path / "cli-stage" / "minio_tpu-0.7.0" / "minio_tpu").is_dir()

@@ -65,7 +65,6 @@ def _report(family: str, label: str, gibs: float, exact: bool | None) -> None:
 
 
 def _fail(family: str, label: str, err: str) -> None:
-    print(f"{label}: FAIL {err}")
     _report(family, f"{label} ({err})", 0.0, False)
 
 
