@@ -19,6 +19,7 @@ from aiohttp import web
 
 from ..control.iam import IAMSys
 from ..utils import errors as oerr
+from ..utils import fips as fips_mod
 from .auth import SigV4Verifier
 from .errors import S3Error
 
@@ -115,6 +116,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             "drivesOnline": online,
             "drivesOffline": offline,
             "buckets": {"count": len(ctx.layer.list_buckets())},
+            "fips": fips_mod.enabled(),
         }
         if ctx.scanner is not None:
             info["usage"] = ctx.scanner.usage.summary()

@@ -110,6 +110,18 @@ def presign_url_v2(
     return f"http://{host}{path}?{qs}"
 
 
+def _reject_if_fips() -> None:
+    # V2 signatures are HMAC-SHA1; FIPS deployments must refuse them at
+    # the door rather than verify-then-serve. Checked per verify call so
+    # the runtime switch holds even if a verifier instance is ever cached.
+    from ..utils import fips
+
+    if fips.enabled():
+        raise S3Error(
+            "InvalidRequest", "Signature Version 2 is disabled in FIPS mode"
+        )
+
+
 class SigV2Verifier:
     def __init__(self, lookup, check_expiry: bool = True):
         """lookup: access_key -> object with .secret_key, or None."""
@@ -129,6 +141,7 @@ class SigV2Verifier:
         query: list[tuple[str, str]],
         headers: dict[str, str],
     ) -> str:
+        _reject_if_fips()
         h = {k.lower(): v for k, v in headers.items()}
         authz = h.get("authorization", "")
         if not authz.startswith("AWS ") or ":" not in authz:
@@ -147,6 +160,7 @@ class SigV2Verifier:
         path: str,
         query: list[tuple[str, str]],
     ) -> str:
+        _reject_if_fips()
         qd = dict(query)
         try:
             access_key = qd["AWSAccessKeyId"]
