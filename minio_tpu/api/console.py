@@ -253,15 +253,14 @@ def make_console_app(ctx) -> web.Application:
             return _json({"error": "name required"}, 400)
 
         def work():
-            ctx.layer.delete_bucket(name)
-            # Same hooks as the S3 DELETE-bucket path: stale metadata left
-            # behind would be inherited by a later bucket of the same name.
-            bm = getattr(ctx, "bucket_meta", None)
-            if bm is not None:
-                bm.delete(name)
-            site = getattr(ctx, "site_repl", None)
-            if site is not None and getattr(site, "enabled", False):
-                site.on_bucket_delete(name)
+            from .server import delete_bucket_with_hooks
+
+            delete_bucket_with_hooks(
+                ctx.layer, name,
+                bucket_meta=getattr(ctx, "bucket_meta", None),
+                notification=getattr(ctx, "notification", None),
+                site_repl=getattr(ctx, "site_repl", None),
+            )
 
         try:
             await asyncio.to_thread(work)

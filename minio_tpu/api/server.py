@@ -73,6 +73,27 @@ def _xml(content: str, status: int = 200) -> web.Response:
     )
 
 
+def delete_bucket_with_hooks(
+    layer, bucket: str, *, bucket_meta=None, notification=None, site_repl=None
+) -> None:
+    """Bucket delete plus every cache/replication hook, in one place for
+    the S3 handler AND the console (a hook added to only one path would
+    leave the other resurrecting stale state):
+      * bucket_meta.delete — or a later bucket of the same name inherits
+        the old quota/lock/versioning config;
+      * peer reload — peers' bucket-meta AND bucket-existence caches must
+        drop NOW, not after their TTL window, or they keep accepting PUTs
+        into the deleted namespace;
+      * site replication fan-out."""
+    layer.delete_bucket(bucket)
+    if bucket_meta is not None:
+        bucket_meta.delete(bucket)
+    if notification is not None:
+        notification.reload_bucket_meta_all(bucket)
+    if site_repl is not None and getattr(site_repl, "enabled", False):
+        site_repl.on_bucket_delete(bucket)
+
+
 def _read_all(reader, chunk: int = 1 << 20) -> bytes:
     out = bytearray()
     while True:
@@ -832,10 +853,12 @@ class S3Server:
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
     def _delete_bucket(self, bucket: str) -> web.Response:
-        self.layer.delete_bucket(bucket)
-        self.bucket_meta.delete(bucket)
-        if self.site_repl is not None and self.site_repl.enabled:
-            self.site_repl.on_bucket_delete(bucket)
+        delete_bucket_with_hooks(
+            self.layer, bucket,
+            bucket_meta=self.bucket_meta,
+            notification=self.peer_notification,
+            site_repl=self.site_repl,
+        )
         return web.Response(status=204)
 
     def _site_meta_sync(self, bucket: str) -> None:

@@ -72,6 +72,11 @@ def make_peer_app(node, token: str) -> web.Application:
     def h_reload_bucket_meta(a):
         if node.s3 is not None:
             node.s3.bucket_meta.invalidate(a.get("bucket", ""))
+        # Also drop the object layer's bucket-EXISTENCE cache: a peer that
+        # deleted the bucket must not leave this node serving PUTs into the
+        # removed namespace for the cache TTL.
+        if node.pools is not None:
+            node.pools.invalidate_bucket_cache(a.get("bucket", ""))
         return {"ok": True}
 
     def h_top_locks(a):
@@ -234,19 +239,34 @@ class NotificationSys:
     def __init__(self, peers: list[PeerClient]):
         self.peers = peers
 
-    def reload_iam_all(self) -> None:
-        for p in self.peers:
+    def _fanout(self, call) -> None:
+        """Best-effort broadcast: skip peers already marked offline (their
+        REST client tracks health — a blackholed peer would otherwise add
+        its full connect timeout to the CALLER's request latency) and run
+        the rest concurrently."""
+        live = [p for p in self.peers if p.client.is_online()]
+        if not live:
+            return
+
+        def one(p):
             try:
-                p.reload_iam()
+                call(p)
             except errors.StorageError:
-                continue
+                pass
+
+        if len(live) == 1:
+            one(live[0])
+            return
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(8, len(live))) as pool:
+            list(pool.map(one, live))
+
+    def reload_iam_all(self) -> None:
+        self._fanout(lambda p: p.reload_iam())
 
     def reload_bucket_meta_all(self, bucket: str = "") -> None:
-        for p in self.peers:
-            try:
-                p.reload_bucket_meta(bucket)
-            except errors.StorageError:
-                continue
+        self._fanout(lambda p: p.reload_bucket_meta(bucket))
 
     def server_info_all(self) -> list[dict]:
         out = []

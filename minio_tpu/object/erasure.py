@@ -452,6 +452,14 @@ class ErasureObjects:
         result discarded — the cached get_bucket_info does the work)."""
         self.get_bucket_info(bucket)
 
+    def invalidate_bucket_cache(self, bucket: str = "") -> None:
+        """Drop cached bucket info (all buckets when name is empty) — the
+        peer-invalidation hook for cross-node bucket deletes."""
+        if bucket:
+            self._bucket_cache.pop(bucket, None)
+        else:
+            self._bucket_cache.clear()
+
     def get_bucket_info(self, bucket: str) -> BucketInfo:
         cached = self._bucket_cache.get(bucket)
         if cached is not None and cached[0] > time.monotonic():

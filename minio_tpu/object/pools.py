@@ -102,6 +102,10 @@ class ServerPools:
         except errors.BucketNotFound:
             return False
 
+    def invalidate_bucket_cache(self, bucket: str = "") -> None:
+        for p in self.pools:
+            p.invalidate_bucket_cache(bucket)
+
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         # Refuse unless empty across every pool (unless forced).
         if not force:

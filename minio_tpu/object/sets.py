@@ -131,6 +131,10 @@ class ErasureSets:
     def get_bucket_info(self, bucket: str) -> BucketInfo:
         return self.sets[0].get_bucket_info(bucket)
 
+    def invalidate_bucket_cache(self, bucket: str = "") -> None:
+        for s in self.sets:
+            s.invalidate_bucket_cache(bucket)
+
     def delete_bucket(self, bucket: str, force: bool = False) -> None:
         self.metacache.invalidate(bucket)
         results = meta_mod.parallel_map(lambda s: s.delete_bucket(bucket, force), self.sets)

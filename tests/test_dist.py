@@ -99,6 +99,20 @@ class TestRemoteDrive:
 
 
 class TestCrossNodeIO:
+    def test_delete_on_a_immediately_404s_put_on_b(self, cluster):
+        """The bucket-existence cache is TTL'd per node; a cross-node
+        delete must invalidate peers NOW (peer reload hook), not after the
+        cache window — a stale hit would accept PUTs into the deleted
+        namespace."""
+        ca, cb = cluster["clients"]
+        ca.make_bucket("xdel")
+        # Warm node B's existence cache with a successful op.
+        assert cb.put_object("xdel", "warm.bin", b"w").status_code == 200
+        assert cb.request("DELETE", "/xdel/warm.bin").status_code in (200, 204)
+        assert ca.request("DELETE", "/xdel").status_code in (200, 204)
+        r = cb.request("PUT", "/xdel/after.bin", body=b"x")
+        assert r.status_code == 404, f"stale peer bucket cache: {r.status_code}"
+
     def test_put_on_a_get_on_b(self, cluster):
         c0, c1 = cluster["clients"]
         assert c0.make_bucket("distbucket").status_code == 200
