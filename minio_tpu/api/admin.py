@@ -299,6 +299,12 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             for ak, u in ctx.iam.list_users().items()
         }
 
+    def _reload_peers_iam():
+        # Peers cache IAM in memory; a deleted/disabled identity must stop
+        # authenticating NOW, not at their next restart.
+        if ctx.notification is not None:
+            ctx.notification.reload_iam_all()
+
     def _site_iam(kind, payload):
         if ctx.site_repl is not None and getattr(ctx.site_repl, "enabled", False):
             ctx.site_repl.on_iam(kind, payload)
@@ -306,13 +312,13 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     def h_add_user(request, body):
         doc = json.loads(body)
         ctx.iam.add_user(doc["accessKey"], doc["secretKey"], doc.get("policies", []))
-        if ctx.notification is not None:
-            ctx.notification.reload_iam_all()
+        _reload_peers_iam()
         _site_iam("user", ctx.iam.users[doc["accessKey"]].to_dict())
         return {"ok": True}
 
     def h_remove_user(request, body):
         ctx.iam.remove_user(request.match_info["ak"])
+        _reload_peers_iam()
         _site_iam("user-delete", {"access_key": request.match_info["ak"]})
         return {"ok": True}
 
@@ -320,6 +326,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         doc = json.loads(body)
         ak = request.match_info["ak"]
         ctx.iam.set_user_status(ak, doc["status"])
+        _reload_peers_iam()
         if ak in ctx.iam.users:
             _site_iam("user", ctx.iam.users[ak].to_dict())
         return {"ok": True}
@@ -327,6 +334,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     def h_user_policy(request, body):
         doc = json.loads(body)
         ctx.iam.attach_policy(request.match_info["ak"], doc["policies"])
+        _reload_peers_iam()
         _site_iam("policy-mapping", {"access_key": request.match_info["ak"], "policies": doc["policies"]})
         return {"ok": True}
 
@@ -335,6 +343,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         # `idp ldap policy attach` role); empty policies detaches.
         doc = json.loads(body)
         ctx.iam.set_ldap_policy(doc["dn"], doc.get("policies", []))
+        _reload_peers_iam()
         _site_iam("ldap-policy-mapping", {"dn": doc["dn"], "policies": doc.get("policies", [])})
         return {"ok": True}
 
@@ -358,11 +367,13 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         except ValueError as e:
             raise S3Error("MalformedPolicy", str(e))
         ctx.iam.set_policy(request.match_info["name"], doc)
+        _reload_peers_iam()
         _site_iam("policy", {"name": request.match_info["name"], "doc": doc})
         return {"ok": True}
 
     def h_delete_policy(request, body):
         ctx.iam.delete_policy(request.match_info["name"])
+        _reload_peers_iam()
         _site_iam("policy-delete", {"name": request.match_info["name"]})
         return {"ok": True}
 
@@ -370,6 +381,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         doc = json.loads(body) if body else {}
         parent = doc.get("parent") or ctx.iam.root.access_key
         creds = ctx.iam.new_service_account(parent, doc.get("policy"))
+        _reload_peers_iam()
         if creds.access_key in ctx.iam.users:
             _site_iam("user", ctx.iam.users[creds.access_key].to_dict())
         return {"accessKey": creds.access_key, "secretKey": creds.secret_key}

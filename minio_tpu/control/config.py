@@ -282,8 +282,12 @@ class ConfigStore:
         try:
             _, data = self.layer.pools[0].get_object(META_BUCKET, path, GetObjectOptions())
             return data
-        except errors.ObjectError:
+        except (errors.ObjectNotFound, errors.BucketNotFound, errors.VersionNotFound):
             return None
+        # Quorum/read failures PROPAGATE: "couldn't read the config" must
+        # never be conflated with "no config exists" — a caller that treats
+        # a degraded-quorum None as an empty store will later persist an
+        # empty snapshot over the real one.
 
     def delete(self, path: str) -> None:
         from ..object.erasure import META_BUCKET

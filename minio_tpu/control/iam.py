@@ -9,6 +9,7 @@ and replicates with the cluster. STS temporary credentials layer on top
 
 from __future__ import annotations
 
+import contextlib
 import json
 import secrets
 import threading
@@ -74,23 +75,82 @@ class IAMSys:
         # --user 'uid=...'); LDAP identities have no local user records.
         self.ldap_policy_map: dict[str, list[str]] = {}
         self.store = store  # object-layer-backed persistence (control/configsys)
+        # Optional cluster lock factory (dist NamespaceLock): when set,
+        # persisted mutations serialize cluster-wide and refresh from the
+        # store first, so two nodes mutating concurrently can't clobber
+        # each other's whole-snapshot writes.
+        self.ns_lock = None
         self._lock = threading.RLock()
         self._persist_lock = threading.Lock()
+        # Serializes whole mutations AND reloads: a peer-triggered load()
+        # landing between a mutation's in-memory apply and its persist
+        # would reset state to the pre-mutation snapshot and the persist
+        # would then write the change away.
+        self._mutate_lock = threading.RLock()
 
     # -- persistence ---------------------------------------------------------
 
+    _SEAL_MAGIC = b"MTPUIAM1"
+
+    def _seal_key(self) -> bytes:
+        # Keyed from the root credential, like the reference's
+        # madmin-encrypted IAM blobs (iam-object-store.go loadIAMConfig):
+        # drive access alone must not yield every long-lived secret key.
+        import hashlib
+
+        return hashlib.sha256(b"minio_tpu-iam-store:" + self.root.secret_key.encode()).digest()
+
+    def _seal(self, data: bytes) -> bytes:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        nonce = secrets.token_bytes(12)
+        ct = AESGCM(self._seal_key()).encrypt(nonce, data, b"iam")
+        return self._SEAL_MAGIC + nonce + ct
+
+    def _unseal(self, blob: bytes) -> bytes:
+        if not blob.startswith(self._SEAL_MAGIC):
+            return blob  # pre-encryption plaintext blob: readable once,
+            # re-sealed on the next persist
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+        try:
+            return AESGCM(self._seal_key()).decrypt(
+                blob[len(self._SEAL_MAGIC) : len(self._SEAL_MAGIC) + 12],
+                blob[len(self._SEAL_MAGIC) + 12 :],
+                b"iam",
+            )
+        except Exception as e:  # noqa: BLE001 - wrong root credential / corrupt
+            raise errors.FileCorrupt(
+                "IAM store unseal failed (root credentials changed?)"
+            ) from e
+
+    def _get_sealed(self, path: str) -> bytes | None:
+        raw = self.store.get(path)
+        return self._unseal(raw) if raw else None
+
     def load(self) -> None:
+        """Refresh from the store. Unexpired TEMPORARY credentials (STS)
+        are deliberately never persisted; a reload must merge them back in
+        or every active federated session dies on any peer IAM reload."""
         if self.store is None:
             return
-        raw = self.store.get(f"{IAM_PREFIX}/users.json")
+        with self._mutate_lock:
+            self._load_locked()
+
+    def _load_locked(self) -> None:
+        raw = self._get_sealed(f"{IAM_PREFIX}/users.json")
         if raw:
             data = json.loads(raw)
             with self._lock:
-                self.users = {k: UserIdentity.from_dict(v) for k, v in data.items()}
-        raw = self.store.get(f"{IAM_PREFIX}/policies.json")
+                fresh = {k: UserIdentity.from_dict(v) for k, v in data.items()}
+                for ak, ident in self.users.items():
+                    if ak not in fresh and ident.expiration > 0 and not ident.expired():
+                        fresh[ak] = ident
+                self.users = fresh
+        raw = self._get_sealed(f"{IAM_PREFIX}/policies.json")
         if raw:
             self.custom_policies = json.loads(raw)
-        raw = self.store.get(f"{IAM_PREFIX}/ldap-policy-map.json")
+        raw = self._get_sealed(f"{IAM_PREFIX}/ldap-policy-map.json")
         if raw:
             self.ldap_policy_map = json.loads(raw)
 
@@ -100,24 +160,46 @@ class IAMSys:
         # _persist_lock serializes whole persists so a stale snapshot can
         # never overwrite a newer one; _lock (held briefly inside) protects
         # the snapshot itself from concurrent mutation mid-serialization.
+        # Temporary credentials stay memory-only (never written).
         with self._persist_lock:
             with self._lock:
-                users = {k: v.to_dict() for k, v in self.users.items()}
+                users = {
+                    k: v.to_dict() for k, v in self.users.items() if v.expiration == 0
+                }
                 policies = json.dumps(self.custom_policies)
                 ldap_map = json.dumps(self.ldap_policy_map)
-            self.store.put(f"{IAM_PREFIX}/users.json", json.dumps(users).encode())
-            self.store.put(f"{IAM_PREFIX}/policies.json", policies.encode())
-            self.store.put(f"{IAM_PREFIX}/ldap-policy-map.json", ldap_map.encode())
+            self.store.put(f"{IAM_PREFIX}/users.json", self._seal(json.dumps(users).encode()))
+            self.store.put(f"{IAM_PREFIX}/policies.json", self._seal(policies.encode()))
+            self.store.put(f"{IAM_PREFIX}/ldap-policy-map.json", self._seal(ldap_map.encode()))
+
+    @contextlib.contextmanager
+    def _mutating(self):
+        """Context for a persisted mutation: under the process mutation
+        lock (so a peer-triggered reload can't reset state between apply
+        and persist) and the cluster IAM lock (when wired), refreshing
+        from the store first so a concurrent mutation on another node
+        isn't clobbered by this node's whole-snapshot write."""
+        with self._mutate_lock:
+            lk = self.ns_lock.new(".minio_tpu.sys", "iam") if self.ns_lock is not None else None
+            if lk is not None and not lk.acquire(writer=True, timeout=15):
+                raise errors.ErasureWriteQuorum(".minio_tpu.sys", "iam lock timeout")
+            try:
+                if lk is not None and self.store is not None:
+                    self._load_locked()
+                yield
+                self._persist()
+            finally:
+                if lk is not None:
+                    lk.release()
 
     # -- LDAP policy mapping (sts-handlers.go LDAP policy lookup role) -------
 
     def set_ldap_policy(self, dn: str, policy_names: list[str]) -> None:
-        with self._lock:
+        with self._mutating(), self._lock:
             if policy_names:
                 self.ldap_policy_map[dn] = list(policy_names)
             else:
                 self.ldap_policy_map.pop(dn, None)
-        self._persist()
 
     def ldap_policies_for(self, user_dn: str, group_dns: list[str]) -> list[str]:
         """Union of policies attached to the user DN and its group DNs
@@ -145,55 +227,50 @@ class IAMSys:
     # -- user management (admin API surface) ---------------------------------
 
     def add_user(self, access_key: str, secret_key: str, policies: list[str] | None = None):
-        with self._lock:
+        with self._mutating(), self._lock:
             self.users[access_key] = UserIdentity(
                 Credentials(access_key, secret_key), policies=policies or []
             )
-        self._persist()
 
     def remove_user(self, access_key: str) -> None:
-        with self._lock:
+        with self._mutating(), self._lock:
             if access_key not in self.users:
                 raise errors.InvalidArgument(msg=f"no such user {access_key}")
             del self.users[access_key]
-        self._persist()
 
     def set_user_status(self, access_key: str, status: str) -> None:
-        with self._lock:
+        with self._mutating(), self._lock:
             if access_key not in self.users:
                 raise errors.InvalidArgument(msg=f"no such user {access_key}")
             self.users[access_key].status = status
-        self._persist()
 
     def list_users(self) -> dict[str, UserIdentity]:
         with self._lock:
             return dict(self.users)
 
     def attach_policy(self, access_key: str, policy_names: list[str]) -> None:
-        with self._lock:
+        with self._mutating(), self._lock:
             if access_key not in self.users:
                 raise errors.InvalidArgument(msg=f"no such user {access_key}")
             self.users[access_key].policies = list(policy_names)
-        self._persist()
 
     def set_policy(self, name: str, doc: dict) -> None:
-        self.custom_policies[name] = doc
-        self._persist()
+        with self._mutating():
+            self.custom_policies[name] = doc
 
     def delete_policy(self, name: str) -> None:
-        self.custom_policies.pop(name, None)
-        self._persist()
+        with self._mutating():
+            self.custom_policies.pop(name, None)
 
     def new_service_account(
         self, parent: str, session_policy: dict | None = None
     ) -> Credentials:
         ak = "SA" + secrets.token_hex(8).upper()
         sk = secrets.token_urlsafe(30)
-        with self._lock:
+        with self._mutating(), self._lock:
             self.users[ak] = UserIdentity(
                 Credentials(ak, sk), parent_user=parent, session_policy=session_policy
             )
-        self._persist()
         return Credentials(ak, sk)
 
     def new_sts_credentials_for_policies(

@@ -123,7 +123,10 @@ class SiteReplicationSys:
         return bool(self.sites)
 
     def load(self) -> None:
-        raw = self.store.get(STATE_PATH) if self.store is not None else None
+        try:
+            raw = self.store.get(STATE_PATH) if self.store is not None else None
+        except errors.StorageError:
+            return  # degraded-quorum boot: start un-federated, don't crash
         if not raw:
             return
         try:

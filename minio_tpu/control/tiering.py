@@ -188,7 +188,13 @@ class TierConfigMgr:
     def load(self) -> None:
         from .crypto import unseal_secret
 
-        raw = self.store.get(CONFIG_PATH) if self.store is not None else None
+        try:
+            raw = self.store.get(CONFIG_PATH) if self.store is not None else None
+        except errors.StorageError:
+            # Degraded-quorum boot: start with no tiers rather than failing
+            # the whole node; tier saves are admin-driven, so the empty-
+            # overwrite risk IAM guards against doesn't arise unprompted.
+            return
         if raw:
             docs = json.loads(raw)
             with self._lock:
@@ -197,7 +203,10 @@ class TierConfigMgr:
                     t = TierConfig.from_dict(d)
                     t.secret_key = unseal_secret(self.kms, f"tier/{t.name}", t.secret_key)
                     self._tiers[t.name] = t
-        rawj = self.store.get(JOURNAL_PATH) if self.store is not None else None
+        try:
+            rawj = self.store.get(JOURNAL_PATH) if self.store is not None else None
+        except errors.StorageError:
+            return
         if rawj:
             with self._lock:
                 self._journal = json.loads(rawj)

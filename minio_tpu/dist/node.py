@@ -279,6 +279,25 @@ class Node:
             self.config.load()
         except errors.StorageError:
             pass
+        # IAM durability (iam-object-store.go role): users/policies persist
+        # through the same erasure-backed config store (sealed with the
+        # root credential) and reload on boot. A FAILED load (degraded
+        # quorum) disables persistence for this process instead of risking
+        # an empty snapshot overwriting the real one on the next mutation.
+        self.iam.store = store
+        self.iam.ns_lock = self.ns_lock
+        try:
+            self.iam.load()
+        except errors.StorageError as e:
+            self.iam.store = None
+            self.iam.ns_lock = None
+            import logging
+
+            logging.getLogger("minio_tpu").error(
+                "IAM store unreadable at boot (%s); IAM persistence DISABLED "
+                "for this process — identities created now will not survive "
+                "a restart. Heal the config store and restart.", e,
+            )
         # Optional SSD read-cache in front of the object layer for the S3
         # serving path only — background subsystems keep the raw layer
         # (the reference interposes CacheObjectLayer at the handler level,
