@@ -338,6 +338,53 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         _site_iam("policy-mapping", {"access_key": request.match_info["ak"], "policies": doc["policies"]})
         return {"ok": True}
 
+    def _str_list(doc, key: str) -> list[str]:
+        # A bare string would iterate per-character into nonsense names
+        # and "succeed" while denying everything.
+        v = doc.get(key, [])
+        if not isinstance(v, list) or not all(isinstance(x, str) for x in v):
+            raise S3Error("InvalidRequest", f"{key} must be a list of strings")
+        return v
+
+    def h_groups_list(request, body):
+        return {"groups": ctx.iam.list_groups()}
+
+    def h_group_info(request, body):
+        return ctx.iam.group_info(request.match_info["name"])
+
+    def h_group_update(request, body):
+        # UpdateGroupMembers (cmd/admin-handlers-users.go): members +
+        # isRemove, creating the group on first add.
+        doc = json.loads(body)
+        ctx.iam.update_group_members(
+            request.match_info["name"],
+            _str_list(doc, "members"),
+            remove=bool(doc.get("isRemove", False)),
+        )
+        _reload_peers_iam()
+        _site_iam("group", ctx.iam.group_info(request.match_info["name"]))
+        return {"ok": True}
+
+    def h_group_delete(request, body):
+        ctx.iam.remove_group(request.match_info["name"])
+        _reload_peers_iam()
+        _site_iam("group-delete", {"name": request.match_info["name"]})
+        return {"ok": True}
+
+    def h_group_status(request, body):
+        doc = json.loads(body)
+        ctx.iam.set_group_status(request.match_info["name"], doc["status"])
+        _reload_peers_iam()
+        _site_iam("group", ctx.iam.group_info(request.match_info["name"]))
+        return {"ok": True}
+
+    def h_group_policy(request, body):
+        doc = json.loads(body)
+        ctx.iam.attach_group_policy(request.match_info["name"], _str_list(doc, "policies"))
+        _reload_peers_iam()
+        _site_iam("group", ctx.iam.group_info(request.match_info["name"]))
+        return {"ok": True}
+
     def h_ldap_policy(request, body):
         # Attach/detach policies for an LDAP user or group DN (the mc
         # `idp ldap policy attach` role); empty policies detaches.
@@ -780,6 +827,12 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_delete("/users/{ak}", handler(h_remove_user))
     app.router.add_put("/users/{ak}/status", handler(h_user_status))
     app.router.add_put("/users/{ak}/policy", handler(h_user_policy))
+    app.router.add_get("/groups", handler(h_groups_list))
+    app.router.add_get("/groups/{name}", handler(h_group_info))
+    app.router.add_put("/groups/{name}", handler(h_group_update))
+    app.router.add_delete("/groups/{name}", handler(h_group_delete))
+    app.router.add_put("/groups/{name}/status", handler(h_group_status))
+    app.router.add_put("/groups/{name}/policy", handler(h_group_policy))
     app.router.add_put("/idp/ldap/policy", handler(h_ldap_policy))
     app.router.add_get("/idp/ldap/policy", handler(h_ldap_policy_list))
     app.router.add_get("/policies", handler(h_list_policies))

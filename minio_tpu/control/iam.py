@@ -68,7 +68,10 @@ class IAMSys:
     def __init__(self, root_user: str, root_password: str, store=None):
         self.root = Credentials(root_user, root_password)
         self.users: dict[str, UserIdentity] = {}
-        self.group_policies: dict[str, list[str]] = {}
+        # Groups (cmd/group-handlers.go role): name -> {"members": [ak],
+        # "status": "enabled"|"disabled", "policies": [names]}. A user's
+        # effective policy set unions every enabled group they belong to.
+        self.groups: dict[str, dict] = {}
         self.custom_policies: dict[str, dict] = {}
         # LDAP policy DB: DN (user or group) -> policy names. The reference
         # keeps the same mapping in its IAM store (mc admin policy attach
@@ -153,6 +156,9 @@ class IAMSys:
         raw = self._get_sealed(f"{IAM_PREFIX}/ldap-policy-map.json")
         if raw:
             self.ldap_policy_map = json.loads(raw)
+        raw = self._get_sealed(f"{IAM_PREFIX}/groups.json")
+        if raw:
+            self.groups = json.loads(raw)
 
     def _persist(self) -> None:
         if self.store is None:
@@ -168,9 +174,11 @@ class IAMSys:
                 }
                 policies = json.dumps(self.custom_policies)
                 ldap_map = json.dumps(self.ldap_policy_map)
+                groups = json.dumps(self.groups)
             self.store.put(f"{IAM_PREFIX}/users.json", self._seal(json.dumps(users).encode()))
             self.store.put(f"{IAM_PREFIX}/policies.json", self._seal(policies.encode()))
             self.store.put(f"{IAM_PREFIX}/ldap-policy-map.json", self._seal(ldap_map.encode()))
+            self.store.put(f"{IAM_PREFIX}/groups.json", self._seal(groups.encode()))
 
     @contextlib.contextmanager
     def _mutating(self):
@@ -191,6 +199,82 @@ class IAMSys:
             finally:
                 if lk is not None:
                     lk.release()
+
+    # -- groups (cmd/group-handlers.go: add/remove members, status, policy) --
+
+    def update_group_members(self, group: str, members: list[str], remove: bool = False) -> None:
+        """Add (or remove) members; adding creates the group (the
+        reference's UpdateGroupMembers semantics). Validates the WHOLE
+        member list before touching anything — a failure mid-apply would
+        leave earlier members holding the group's policies in memory while
+        the request reports an error."""
+        with self._mutating(), self._lock:
+            g = self.groups.get(group)
+            if g is None and remove:
+                raise errors.InvalidArgument(msg=f"no such group {group}")
+            if not remove:
+                missing = [ak for ak in members if ak not in self.users]
+                if missing:
+                    raise errors.InvalidArgument(msg=f"no such user(s) {missing}")
+            if g is None:
+                g = self.groups[group] = {"members": [], "status": "enabled", "policies": []}
+            for ak in members:
+                if remove:
+                    if ak in g["members"]:
+                        g["members"].remove(ak)
+                    if ak in self.users and group in self.users[ak].groups:
+                        self.users[ak].groups.remove(group)
+                else:
+                    if ak not in g["members"]:
+                        g["members"].append(ak)
+                    if group not in self.users[ak].groups:
+                        self.users[ak].groups.append(group)
+
+    def remove_group(self, group: str) -> None:
+        with self._mutating(), self._lock:
+            g = self.groups.get(group)
+            if g is None:
+                raise errors.InvalidArgument(msg=f"no such group {group}")
+            if g["members"]:
+                raise errors.InvalidArgument(
+                    msg=f"group {group} is not empty; remove members first"
+                )
+            del self.groups[group]
+
+    def set_group_status(self, group: str, status: str) -> None:
+        with self._mutating(), self._lock:
+            if group not in self.groups:
+                raise errors.InvalidArgument(msg=f"no such group {group}")
+            self.groups[group]["status"] = status
+
+    def attach_group_policy(self, group: str, policy_names: list[str]) -> None:
+        with self._mutating(), self._lock:
+            if group not in self.groups:
+                raise errors.InvalidArgument(msg=f"no such group {group}")
+            self.groups[group]["policies"] = list(policy_names)
+
+    def list_groups(self) -> list[str]:
+        with self._lock:
+            return sorted(self.groups)
+
+    def group_info(self, group: str) -> dict:
+        with self._lock:
+            g = self.groups.get(group)
+            if g is None:
+                raise errors.InvalidArgument(msg=f"no such group {group}")
+            return {"name": group, **{k: list(v) if isinstance(v, list) else v for k, v in g.items()}}
+
+    def _group_policy_names(self, ident: UserIdentity) -> list[str]:
+        """Policies inherited from the user's ENABLED groups."""
+        out: list[str] = []
+        with self._lock:
+            for gname in ident.groups:
+                g = self.groups.get(gname)
+                if g is not None and g.get("status") == "enabled":
+                    for p in g.get("policies", []):
+                        if p not in out:
+                            out.append(p)
+        return out
 
     # -- LDAP policy mapping (sts-handlers.go LDAP policy lookup role) -------
 
@@ -237,6 +321,9 @@ class IAMSys:
             if access_key not in self.users:
                 raise errors.InvalidArgument(msg=f"no such user {access_key}")
             del self.users[access_key]
+            for g in self.groups.values():
+                if access_key in g["members"]:
+                    g["members"].remove(access_key)
 
     def set_user_status(self, access_key: str, status: str) -> None:
         with self._mutating(), self._lock:
@@ -327,10 +414,11 @@ class IAMSys:
             ident = self.users.get(access_key)
         if ident is None or ident.status != "enabled" or ident.expired():
             return False
-        names = list(ident.policies)
+        names = list(ident.policies) + self._group_policy_names(ident)
         subject = ident
-        # Service accounts / STS inherit the parent's policies, optionally
-        # narrowed by a session policy.
+        # Service accounts / STS inherit the parent's policies (incl. the
+        # parent's group-derived ones), optionally narrowed by a session
+        # policy.
         if ident.parent_user:
             if ident.parent_user == self.root.access_key:
                 parent_allowed = True
@@ -339,7 +427,7 @@ class IAMSys:
                     parent = self.users.get(ident.parent_user)
                 if parent is None:
                     return False
-                names = list(parent.policies)
+                names = list(parent.policies) + self._group_policy_names(parent)
                 parent_allowed = self._eval(names, action, resource, context)
             if ident.session_policy is not None:
                 sp = policy_mod.Policy.from_dict(ident.session_policy)

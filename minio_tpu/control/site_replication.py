@@ -328,6 +328,13 @@ class SiteReplicationSys:
         for ak, ident in self.iam.list_users().items():
             self.on_iam("user", ident.to_dict())
             synced["users"] += 1
+        # Groups too: users carry group NAMES, but the definitions
+        # (members/status/policies) live in iam.groups — without this pass
+        # a joined site denies every group-granted request.
+        synced["groups"] = 0
+        for gname in self.iam.list_groups():
+            self.on_iam("group", self.iam.group_info(gname))
+            synced["groups"] += 1
         return {"status": "success", "synced": synced, "sites": names}
 
     def _sync_bucket_everywhere(self, bucket: str) -> None:
@@ -490,6 +497,27 @@ class SiteReplicationSys:
             self.iam.attach_policy(payload["access_key"], payload["policies"])
         elif kind == "ldap-policy-mapping":
             self.iam.set_ldap_policy(payload["dn"], payload.get("policies", []))
+        elif kind == "group":
+            # Whole-group snapshot replace (members/status/policies).
+            name = payload["name"]
+            with self.iam._mutating(), self.iam._lock:
+                self.iam.groups[name] = {
+                    "members": list(payload.get("members", [])),
+                    "status": payload.get("status", "enabled"),
+                    "policies": list(payload.get("policies", [])),
+                }
+                for ak, ident in self.iam.users.items():
+                    member = ak in payload.get("members", [])
+                    if member and name not in ident.groups:
+                        ident.groups.append(name)
+                    if not member and name in ident.groups:
+                        ident.groups.remove(name)
+        elif kind == "group-delete":
+            with self.iam._mutating(), self.iam._lock:
+                self.iam.groups.pop(payload["name"], None)
+                for ident in self.iam.users.values():
+                    if payload["name"] in ident.groups:
+                        ident.groups.remove(payload["name"])
         else:
             raise errors.InvalidArgument(msg=f"bad iam kind {kind!r}")
 

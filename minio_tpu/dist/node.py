@@ -288,6 +288,11 @@ class Node:
         self.iam.ns_lock = self.ns_lock
         try:
             self.iam.load()
+        except errors.FileCorrupt:
+            # Unseal failure = wrong root credential, not a flaky drive.
+            # Booting anyway would silently serve with ZERO identities;
+            # fail loudly instead so the operator restores the credential.
+            raise
         except errors.StorageError as e:
             self.iam.store = None
             self.iam.ns_lock = None

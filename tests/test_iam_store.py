@@ -76,6 +76,45 @@ class TestIamStore:
         with pytest.raises(errors.StorageError):
             iam.load()  # callers (node boot) disable persistence on this
 
+    def test_groups_policy_resolution_and_persistence(self):
+        store = DictStore()
+        iam = IAMSys("rootak", "root-secret-key", store=store)
+        iam.set_policy("grp-read", {
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow", "Action": ["s3:GetObject"],
+                           "Resource": ["arn:aws:s3:::b/*"]}],
+        })
+        iam.add_user("member1", "membersecret1")
+        iam.update_group_members("devs", ["member1"])
+        iam.attach_group_policy("devs", ["grp-read"])
+        # membership grants the group's policy...
+        assert iam.is_allowed("member1", "s3:GetObject", "arn:aws:s3:::b/x")
+        assert not iam.is_allowed("member1", "s3:PutObject", "arn:aws:s3:::b/x")
+        # ...a disabled group stops granting...
+        iam.set_group_status("devs", "disabled")
+        assert not iam.is_allowed("member1", "s3:GetObject", "arn:aws:s3:::b/x")
+        iam.set_group_status("devs", "enabled")
+        # ...service accounts under the member inherit via the parent...
+        sa = iam.new_service_account("member1")
+        assert iam.is_allowed(sa.access_key, "s3:GetObject", "arn:aws:s3:::b/x")
+        # ...and everything survives a reload.
+        fresh = IAMSys("rootak", "root-secret-key", store=store)
+        fresh.load()
+        assert fresh.is_allowed("member1", "s3:GetObject", "arn:aws:s3:::b/x")
+        assert fresh.groups["devs"]["members"] == ["member1"]
+        # member removal revokes; empty group deletes; non-empty refuses
+        iam.update_group_members("devs", ["member1"], remove=True)
+        assert not iam.is_allowed("member1", "s3:GetObject", "arn:aws:s3:::b/x")
+        iam.remove_group("devs")
+        assert "devs" not in iam.groups
+
+    def test_user_delete_leaves_no_group_ghost(self):
+        iam = IAMSys("rootak", "root-secret-key", store=DictStore())
+        iam.add_user("ghost", "ghostsecret12")
+        iam.update_group_members("ops", ["ghost"])
+        iam.remove_user("ghost")
+        assert iam.groups["ops"]["members"] == []
+
     def test_mutation_refreshes_from_store_under_lock(self):
         # Two IAMSys instances sharing one store (two "nodes"): a mutation
         # on B must not clobber A's earlier write, because the cluster-lock
