@@ -852,6 +852,14 @@ class S3Server:
             self.site_repl.on_bucket_make(bucket)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
+    def _update_meta(self, bucket: str, **fields) -> None:
+        """All bucket-metadata writes go through here: peers cache meta
+        with NO TTL, so every change must broadcast an invalidation or
+        other nodes serve the stale policy/tags/rules indefinitely."""
+        self.bucket_meta.update(bucket, **fields)
+        if self.peer_notification is not None:
+            self.peer_notification.reload_bucket_meta_all(bucket)
+
     def _delete_bucket(self, bucket: str) -> web.Response:
         delete_bucket_with_hooks(
             self.layer, bucket,
@@ -892,7 +900,7 @@ class S3Server:
                 "InvalidBucketState",
                 "versioning cannot be suspended on a site-replicated bucket",
             )
-        self.bucket_meta.update(bucket, versioning=status)
+        self._update_meta(bucket, versioning=status)
         self._site_meta_sync(bucket)
         return web.Response(status=200)
 
@@ -912,7 +920,7 @@ class S3Server:
             pol.validate()  # unknown operators / bad CIDRs refuse at write
         except ValueError as e:
             raise S3Error("MalformedPolicy", str(e))
-        self.bucket_meta.update(bucket, policy_json=body.decode())
+        self._update_meta(bucket, policy_json=body.decode())
         self._site_meta_sync(bucket)
         return web.Response(status=204)
 
@@ -925,7 +933,7 @@ class S3Server:
 
     def _delete_policy(self, bucket: str) -> web.Response:
         self.layer.get_bucket_info(bucket)
-        self.bucket_meta.update(bucket, policy_json="")
+        self._update_meta(bucket, policy_json="")
         self._site_meta_sync(bucket)
         return web.Response(status=204)
 
@@ -942,7 +950,7 @@ class S3Server:
                             tags[kv["Key"]] = kv.get("Value", "")
             except ET.ParseError:
                 raise S3Error("MalformedXML")
-        self.bucket_meta.update(bucket, tagging=tags)
+        self._update_meta(bucket, tagging=tags)
         self._site_meta_sync(bucket)
         return web.Response(status=200 if body else 204)
 
@@ -975,7 +983,7 @@ class S3Server:
                 "InvalidBucketState",
                 "replication config is managed by site replication",
             )
-        self.bucket_meta.update(bucket, **{field: body.decode() if body else ""})
+        self._update_meta(bucket, **{field: body.decode() if body else ""})
         if field == "notification_xml" and self.notifier is not None:
             self.notifier.set_bucket_rules_from_xml(bucket, body)
         if field != "replication_xml":
@@ -2178,7 +2186,7 @@ class S3Server:
                 "InvalidBucketState",
                 "object lock requires bucket versioning to be enabled",
             )
-        self.bucket_meta.update(bucket, object_lock_xml=body.decode("utf-8", "replace"))
+        self._update_meta(bucket, object_lock_xml=body.decode("utf-8", "replace"))
         self._site_meta_sync(bucket)
         return web.Response(status=200)
 

@@ -363,6 +363,12 @@ class Node:
         self.s3.trace = self.trace
         self.s3.logger = self.logger
         self.s3.notifier = self.notifier
+        # Rehydrate notification rules from persisted bucket metadata: the
+        # notifier starts empty, and without this pass a restart silently
+        # stops event delivery for every configured bucket until an
+        # operator re-PUTs the config.
+        for _b in self.pools.list_buckets():
+            self.refresh_bucket_notification(_b.name)
         # Cluster-wide watcher streams: listen/trace responses merge every
         # peer's records (ListenNotification + admin trace peer subscription).
         self.s3.peer_notification = self.notification
@@ -393,6 +399,26 @@ class Node:
         )
         self.s3.site_repl = self.site_repl
         return self
+
+    def refresh_bucket_notification(self, bucket: str) -> None:
+        """Load one bucket's notifier rules from its persisted metadata —
+        the single implementation boot rehydration and the peer reload
+        handler share. Error policy: bucket gone -> clear the rules;
+        transient read failure or malformed XML -> KEEP the current rules
+        (silently dropping events on a flap would be worse than serving
+        one stale rule set)."""
+        if self.s3 is None or self.notifier is None:
+            return
+        try:
+            xml = self.s3.bucket_meta.get(bucket).notification_xml or ""
+        except (errors.ObjectNotFound, errors.BucketNotFound):
+            xml = ""  # bucket deleted: no rules
+        except errors.StorageError:
+            return  # transient: keep what we have
+        try:
+            self.notifier.set_bucket_rules_from_xml(bucket, xml)
+        except Exception:  # noqa: BLE001 - malformed persisted XML
+            return
 
     def _quota_usage(self, bucket: str) -> int | None:
         """Bucket usage bytes for quota enforcement, or None when unknown.

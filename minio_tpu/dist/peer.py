@@ -70,13 +70,19 @@ def make_peer_app(node, token: str) -> web.Application:
         return {"ok": True}
 
     def h_reload_bucket_meta(a):
+        bucket = a.get("bucket", "")
         if node.s3 is not None:
-            node.s3.bucket_meta.invalidate(a.get("bucket", ""))
+            node.s3.bucket_meta.invalidate(bucket)
+            # Refresh this node's notifier rules from the re-fetched
+            # metadata (event config changed on a peer would otherwise
+            # keep firing by this node's stale rule set).
+            if bucket:
+                node.refresh_bucket_notification(bucket)
         # Also drop the object layer's bucket-EXISTENCE cache: a peer that
         # deleted the bucket must not leave this node serving PUTs into the
         # removed namespace for the cache TTL.
         if node.pools is not None:
-            node.pools.invalidate_bucket_cache(a.get("bucket", ""))
+            node.pools.invalidate_bucket_cache(bucket)
         return {"ok": True}
 
     def h_top_locks(a):

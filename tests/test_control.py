@@ -337,3 +337,37 @@ def test_metrics_duration_histogram():
     assert 'minio_tpu_s3_request_duration_seconds_bucket{api="GetObject",le="0.25"} 2' in out
     assert 'minio_tpu_s3_request_duration_seconds_bucket{api="GetObject",le="+Inf"} 3' in out
     assert 'minio_tpu_s3_request_duration_seconds_count{api="GetObject"} 3' in out
+
+
+def test_notification_rules_rehydrate_on_boot(tmp_path):
+    """A restart must reload persisted bucket notification configs into the
+    notifier — the rules live in memory, the config in bucket metadata; a
+    fresh process otherwise silently stops delivering events."""
+    import os as os_mod
+
+    from minio_tpu.dist.node import Node
+    from minio_tpu.object.codec import HostCodec
+
+    dirs = []
+    for i in range(4):
+        d = str(tmp_path / f"nb{i}")
+        os_mod.makedirs(d)
+        dirs.append(d)
+    node = Node(dirs, root_user="nbroot", root_password="nbsecret123", codec=HostCodec())
+    node.build()
+    node.pools.make_bucket("evb")
+    xml = (
+        '<NotificationConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        "<QueueConfiguration><Id>q1</Id><Queue>arn:minio:sqs::primary:webhook</Queue>"
+        "<Event>s3:ObjectCreated:*</Event></QueueConfiguration>"
+        "</NotificationConfiguration>"
+    )
+    node.s3.bucket_meta.update("evb", notification_xml=xml)
+    node.notifier.set_bucket_rules_from_xml("evb", xml)
+    assert node.notifier.bucket_rules.get("evb")
+
+    # Fresh process over the same drives: rules must come back on boot.
+    node2 = Node(dirs, root_user="nbroot", root_password="nbsecret123", codec=HostCodec())
+    node2.build()
+    rules = node2.notifier.bucket_rules.get("evb")
+    assert rules, "notification rules lost across restart"

@@ -113,6 +113,31 @@ class TestCrossNodeIO:
         r = cb.request("PUT", "/xdel/after.bin", body=b"x")
         assert r.status_code == 404, f"stale peer bucket cache: {r.status_code}"
 
+    def test_bucket_policy_on_a_applies_on_b(self, cluster):
+        """Bucket metadata is cached per node with NO TTL; a config write
+        must broadcast invalidation or peers serve the old policy forever."""
+        import json as json_mod
+
+        ca, cb = cluster["clients"]
+        ca.make_bucket("xpol")
+        ca.put_object("xpol", "pub.txt", b"public-read")
+        # Warm node B's meta cache with the no-policy state.
+        r = cb.request("GET", "/xpol/pub.txt", anonymous=True)
+        assert r.status_code == 403
+        pol = {
+            "Version": "2012-10-17",
+            "Statement": [{"Effect": "Allow", "Principal": "*",
+                           "Action": ["s3:GetObject"],
+                           "Resource": ["arn:aws:s3:::xpol/*"]}],
+        }
+        assert ca.request(
+            "PUT", "/xpol", query=[("policy", "")],
+            body=json_mod.dumps(pol).encode(),
+        ).status_code in (200, 204)
+        r = cb.request("GET", "/xpol/pub.txt", anonymous=True)
+        assert r.status_code == 200, f"stale bucket policy on peer: {r.status_code}"
+        assert r.content == b"public-read"
+
     def test_put_on_a_get_on_b(self, cluster):
         c0, c1 = cluster["clients"]
         assert c0.make_bucket("distbucket").status_code == 200
