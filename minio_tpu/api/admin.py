@@ -270,11 +270,9 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         if quota < 0 or cfg.get("quotatype", "hard") not in ("", "hard"):
             # FIFO quota is deprecated in the reference too; hard-only.
             raise S3Error("InvalidRequest", "only hard quotas are supported")
+        # bucket_meta.update's on_change hook broadcasts the peer
+        # invalidation (quota enforcement reads cached meta on every node).
         ctx.bucket_meta.update(bucket, quota=quota)
-        if ctx.notification is not None:
-            # Peers cache bucket metadata; a quota change must reach every
-            # node's enforcement path, not just this one's.
-            ctx.notification.reload_bucket_meta_all(bucket)
         return {"ok": True}
 
     # -- config --------------------------------------------------------------

@@ -260,6 +260,7 @@ def make_console_app(ctx) -> web.Application:
                 bucket_meta=getattr(ctx, "bucket_meta", None),
                 notification=getattr(ctx, "notification", None),
                 site_repl=getattr(ctx, "site_repl", None),
+                notifier=getattr(ctx, "notifier", None),
             )
 
         try:

@@ -74,7 +74,8 @@ def _xml(content: str, status: int = 200) -> web.Response:
 
 
 def delete_bucket_with_hooks(
-    layer, bucket: str, *, bucket_meta=None, notification=None, site_repl=None
+    layer, bucket: str, *, bucket_meta=None, notification=None, site_repl=None,
+    notifier=None,
 ) -> None:
     """Bucket delete plus every cache/replication hook, in one place for
     the S3 handler AND the console (a hook added to only one path would
@@ -84,12 +85,17 @@ def delete_bucket_with_hooks(
       * peer reload — peers' bucket-meta AND bucket-existence caches must
         drop NOW, not after their TTL window, or they keep accepting PUTs
         into the deleted namespace;
+      * LOCAL notifier rules — the peer broadcast excludes this node, and
+        stale rules would fire the old event config if the bucket is ever
+        recreated here;
       * site replication fan-out."""
     layer.delete_bucket(bucket)
     if bucket_meta is not None:
-        bucket_meta.delete(bucket)
-    if notification is not None:
+        bucket_meta.delete(bucket)  # its on_change hook broadcasts to peers
+    elif notification is not None:
         notification.reload_bucket_meta_all(bucket)
+    if notifier is not None:
+        notifier.set_bucket_rules_from_xml(bucket, b"")
     if site_repl is not None and getattr(site_repl, "enabled", False):
         site_repl.on_bucket_delete(bucket)
 
@@ -852,20 +858,13 @@ class S3Server:
             self.site_repl.on_bucket_make(bucket)
         return web.Response(status=200, headers={"Location": f"/{bucket}"})
 
-    def _update_meta(self, bucket: str, **fields) -> None:
-        """All bucket-metadata writes go through here: peers cache meta
-        with NO TTL, so every change must broadcast an invalidation or
-        other nodes serve the stale policy/tags/rules indefinitely."""
-        self.bucket_meta.update(bucket, **fields)
-        if self.peer_notification is not None:
-            self.peer_notification.reload_bucket_meta_all(bucket)
-
     def _delete_bucket(self, bucket: str) -> web.Response:
         delete_bucket_with_hooks(
             self.layer, bucket,
             bucket_meta=self.bucket_meta,
             notification=self.peer_notification,
             site_repl=self.site_repl,
+            notifier=self.notifier,
         )
         return web.Response(status=204)
 
@@ -900,7 +899,7 @@ class S3Server:
                 "InvalidBucketState",
                 "versioning cannot be suspended on a site-replicated bucket",
             )
-        self._update_meta(bucket, versioning=status)
+        self.bucket_meta.update(bucket, versioning=status)
         self._site_meta_sync(bucket)
         return web.Response(status=200)
 
@@ -920,7 +919,7 @@ class S3Server:
             pol.validate()  # unknown operators / bad CIDRs refuse at write
         except ValueError as e:
             raise S3Error("MalformedPolicy", str(e))
-        self._update_meta(bucket, policy_json=body.decode())
+        self.bucket_meta.update(bucket, policy_json=body.decode())
         self._site_meta_sync(bucket)
         return web.Response(status=204)
 
@@ -933,7 +932,7 @@ class S3Server:
 
     def _delete_policy(self, bucket: str) -> web.Response:
         self.layer.get_bucket_info(bucket)
-        self._update_meta(bucket, policy_json="")
+        self.bucket_meta.update(bucket, policy_json="")
         self._site_meta_sync(bucket)
         return web.Response(status=204)
 
@@ -950,7 +949,7 @@ class S3Server:
                             tags[kv["Key"]] = kv.get("Value", "")
             except ET.ParseError:
                 raise S3Error("MalformedXML")
-        self._update_meta(bucket, tagging=tags)
+        self.bucket_meta.update(bucket, tagging=tags)
         self._site_meta_sync(bucket)
         return web.Response(status=200 if body else 204)
 
@@ -983,7 +982,7 @@ class S3Server:
                 "InvalidBucketState",
                 "replication config is managed by site replication",
             )
-        self._update_meta(bucket, **{field: body.decode() if body else ""})
+        self.bucket_meta.update(bucket, **{field: body.decode() if body else ""})
         if field == "notification_xml" and self.notifier is not None:
             self.notifier.set_bucket_rules_from_xml(bucket, body)
         if field != "replication_xml":
@@ -2186,7 +2185,7 @@ class S3Server:
                 "InvalidBucketState",
                 "object lock requires bucket versioning to be enabled",
             )
-        self._update_meta(bucket, object_lock_xml=body.decode("utf-8", "replace"))
+        self.bucket_meta.update(bucket, object_lock_xml=body.decode("utf-8", "replace"))
         self._site_meta_sync(bucket)
         return web.Response(status=200)
 

@@ -56,6 +56,14 @@ class BucketMetadataSys:
         self.layer = layer
         self._cache: dict[str, BucketMetadata] = {}
         self._lock = threading.RLock()
+        # Fired after every durable mutation (save/update/delete) with the
+        # bucket name. The node wires this to the peer-invalidation
+        # broadcast: this cache has NO TTL, so EVERY writer — the S3
+        # handlers, site replication applying remote changes, the
+        # replication target registry — must reach peers or they serve
+        # stale policy/rules/targets indefinitely. Hooking the mutation
+        # itself means no writer can forget.
+        self.on_change = None
 
     def _path(self, bucket: str) -> str:
         return f"buckets/{bucket}/bucket-metadata.json"
@@ -69,8 +77,12 @@ class BucketMetadataSys:
                 META_BUCKET, self._path(bucket), GetObjectOptions()
             )
             meta = BucketMetadata.from_bytes(raw)
-        except errors.ObjectError:
-            meta = BucketMetadata(name=bucket)
+        except (errors.ObjectNotFound, errors.BucketNotFound, errors.VersionNotFound,
+                errors.FileNotFound):
+            meta = BucketMetadata(name=bucket)  # genuinely no config yet
+        # Quorum/read failures PROPAGATE uncached: caching a default-empty
+        # record on a degraded read would serve no-policy/no-quota/no-rules
+        # indefinitely (this cache has no TTL).
         with self._lock:
             self._cache[bucket] = meta
         return meta
@@ -81,6 +93,8 @@ class BucketMetadataSys:
         )
         with self._lock:
             self._cache[meta.name] = meta
+        if self.on_change is not None:
+            self.on_change(meta.name)
 
     def update(self, bucket: str, **fields) -> BucketMetadata:
         meta = self.get(bucket)
@@ -96,6 +110,8 @@ class BucketMetadataSys:
             self.layer.pools[0].delete_object(META_BUCKET, self._path(bucket))
         except errors.ObjectError:
             pass
+        if self.on_change is not None:
+            self.on_change(bucket)
 
     def invalidate(self, bucket: str) -> None:
         with self._lock:

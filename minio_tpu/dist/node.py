@@ -366,12 +366,23 @@ class Node:
         # Rehydrate notification rules from persisted bucket metadata: the
         # notifier starts empty, and without this pass a restart silently
         # stops event delivery for every configured bucket until an
-        # operator re-PUTs the config.
-        for _b in self.pools.list_buckets():
-            self.refresh_bucket_notification(_b.name)
+        # operator re-PUTs the config. Parallel: serial per-bucket quorum
+        # reads would add O(buckets) to boot on large namespaces.
+        from ..object import metadata as _meta_mod
+
+        _meta_mod.parallel_map(
+            lambda b: self.refresh_bucket_notification(b.name),
+            self.pools.list_buckets(),
+        )
         # Cluster-wide watcher streams: listen/trace responses merge every
         # peer's records (ListenNotification + admin trace peer subscription).
         self.s3.peer_notification = self.notification
+        # Every durable bucket-meta mutation (from ANY writer: S3 handlers,
+        # site replication, target registry, quota admin) broadcasts the
+        # peer invalidation — the meta cache has no TTL.
+        self.s3.bucket_meta.on_change = (
+            lambda b: self.notification.reload_bucket_meta_all(b)
+        )
         # Hard bucket quotas read the scanner's usage tree
         # (enforceBucketQuota, cmd/bucket-quota.go:112).
         self.s3.quota_usage = self._quota_usage
@@ -541,6 +552,10 @@ class _LazyAdminContext:
     @property
     def site_repl(self):
         return getattr(self._node, "site_repl", None)
+
+    @property
+    def notifier(self):
+        return getattr(self._node, "notifier", None)
 
     @property
     def bucket_meta(self):
