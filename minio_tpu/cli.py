@@ -365,6 +365,26 @@ def _serve_simple_layer(kind, target, host, port, root_user, root_password, regi
 
     config = ConfigSys()
     iam = IAMSys(root_user, root_password)
+    # Gateway mode has no erasure meta bucket to persist IAM into; etcd is
+    # the reference's answer there (iam.go picks the etcd store whenever
+    # one is configured) — without it, gateway IAM is memory-only.
+    from .control.etcd import etcd_store_from_env
+
+    from .utils import errors as _errs
+
+    etcd_store = etcd_store_from_env()
+    if etcd_store is not None:
+        iam.store = etcd_store
+        try:
+            iam.load()
+        except _errs.FileCorrupt as e:
+            # Wrong root credential, not an outage: serving with zero
+            # identities would mask the misconfiguration. Fail the boot.
+            print(f"fatal: etcd IAM store unseal failed ({e})", file=sys.stderr)
+            return 1
+        except _errs.StorageError as e:
+            print(f"warning: etcd IAM store unreadable ({e}); IAM is memory-only", file=sys.stderr)
+            iam.store = None
     srv = S3Server(layer, iam, region=region, check_skew=False, config=config)
     app = web.Application(client_max_size=1 << 31)
     app.add_subapp(

@@ -192,7 +192,13 @@ class IAMSys:
             if lk is not None and not lk.acquire(writer=True, timeout=15):
                 raise errors.ErasureWriteQuorum(".minio_tpu.sys", "iam lock timeout")
             try:
-                if lk is not None and self.store is not None:
+                # Refresh-before-apply whenever a store exists, locked or
+                # not: a second writer sharing the store (another gateway
+                # on the same etcd) would otherwise have every mutation
+                # clobber the other's whole snapshot. Without a shared
+                # lock the refresh shrinks the lost-update window to the
+                # apply+persist span rather than eliminating it.
+                if self.store is not None:
                     self._load_locked()
                 yield
                 self._persist()

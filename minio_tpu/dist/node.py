@@ -280,11 +280,16 @@ class Node:
         except errors.StorageError:
             pass
         # IAM durability (iam-object-store.go role): users/policies persist
-        # through the same erasure-backed config store (sealed with the
-        # root credential) and reload on boot. A FAILED load (degraded
-        # quorum) disables persistence for this process instead of risking
-        # an empty snapshot overwriting the real one on the next mutation.
-        self.iam.store = store
+        # through the erasure-backed config store (sealed with the root
+        # credential) and reload on boot — or through etcd when configured
+        # (iam-etcd-store.go role; the reference prefers etcd whenever it
+        # is set, which is how federated/gateway deployments share IAM).
+        # A FAILED load (degraded quorum) disables persistence for this
+        # process instead of risking an empty snapshot overwriting the
+        # real one on the next mutation.
+        from ..control.etcd import etcd_store_from_env
+
+        self.iam.store = etcd_store_from_env() or store
         self.iam.ns_lock = self.ns_lock
         try:
             self.iam.load()
@@ -294,14 +299,16 @@ class Node:
             # fail loudly instead so the operator restores the credential.
             raise
         except errors.StorageError as e:
+            backend = "etcd" if self.iam.store is not store else "erasure config store"
             self.iam.store = None
             self.iam.ns_lock = None
             import logging
 
             logging.getLogger("minio_tpu").error(
-                "IAM store unreadable at boot (%s); IAM persistence DISABLED "
-                "for this process — identities created now will not survive "
-                "a restart. Heal the config store and restart.", e,
+                "IAM store (%s) unreadable at boot (%s); IAM persistence "
+                "DISABLED for this process — identities created now will "
+                "not survive a restart. Restore the %s and restart.",
+                backend, e, backend,
             )
         # Optional SSD read-cache in front of the object layer for the S3
         # serving path only — background subsystems keep the raw layer
