@@ -1664,14 +1664,20 @@ class S3Server:
         return body
 
     def _transform_get(
-        self, bucket: str, key: str, data: bytes, oi: ObjectInfo, request: web.Request
+        self, bucket: str, key: str, data: bytes, oi: ObjectInfo, request: web.Request,
+        ssec_prefix: str = "",
     ) -> bytes:
+        """ssec_prefix selects which SSE-C header family carries the key:
+        "" for GET/HEAD, "copy-source-" when the caller is reading an
+        x-amz-copy-source (whose key travels in the
+        x-amz-copy-source-server-side-encryption-customer-* headers, NOT
+        the destination's)."""
         from ..control import compress as compress_mod
         from ..control import crypto as crypto_mod
 
         algo = crypto_mod.is_encrypted(oi.internal)
         if algo == crypto_mod.ALGO_SSE_C:
-            client_key = self._parse_ssec_key(request)
+            client_key = self._parse_ssec_key(request, prefix=ssec_prefix)
             if client_key is None:
                 raise S3Error("InvalidRequest", "object is SSE-C encrypted; key required")
             data = crypto_mod.sse_c_decrypt(data, oi.internal, client_key, bucket, key)
@@ -1811,12 +1817,22 @@ class S3Server:
         src_oi, data = self.layer.get_object(src_bucket, src_key, GetObjectOptions(vid))
 
         h = request.headers
-        # Copy preconditions: BOTH outcomes are 412 on CopyObject (there is
-        # no 304 for copies).
+        # Copy preconditions FIRST (a failed if-match must 412 before any
+        # decrypt work or key-required errors): BOTH outcomes are 412 on
+        # CopyObject (there is no 304 for copies).
         if _rfc7232_outcome(
             h, src_oi.etag, src_oi.mod_time, prefix="x-amz-copy-source-if-"
         ) is not None:
             raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
+        # LOGICAL bytes, like GET: a compressed/encrypted source copied raw
+        # would land at the destination without its transform metadata —
+        # permanently unreadable ciphertext/deflate under a 200. The copy
+        # destination re-applies its own transforms via _transform_put. An
+        # SSE-C source's key arrives in the copy-source header family.
+        if self._is_transformed(src_oi):
+            data = self._transform_get(
+                src_bucket, src_key, data, src_oi, request, ssec_prefix="copy-source-"
+            )
         return src_oi, data
 
     def _copy_object(self, bucket: str, key: str, request: web.Request) -> web.Response:
@@ -1830,6 +1846,12 @@ class S3Server:
             # (src metadata never carries internal replication keys).
             if self.replication is not None:
                 self.replication.mark_pending(bucket, key, opts.user_defined)
+        # The destination gets its own transforms (bucket-default SSE,
+        # compression filters, x-amz-server-side-encryption on the COPY
+        # request), exactly as a fresh PUT of the logical bytes would —
+        # including the PUT path's etag-of-logical-bytes semantics.
+        opts.etag = hashlib.md5(data).hexdigest()
+        data = self._transform_put(bucket, key, data, request, opts)
         oi = self.layer.put_object(bucket, key, data, opts)
         self._emit("s3:ObjectCreated:Copy", bucket, oi)
         return _xml(
