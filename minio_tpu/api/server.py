@@ -1814,16 +1814,26 @@ class S3Server:
         if "/" not in src:
             raise S3Error("InvalidArgument", "bad copy source")
         src_bucket, src_key = src.split("/", 1)
-        src_oi, data = self.layer.get_object(src_bucket, src_key, GetObjectOptions(vid))
+        probe = self.layer.get_object_info(src_bucket, src_key, GetObjectOptions(vid))
 
-        h = request.headers
-        # Copy preconditions FIRST (a failed if-match must 412 before any
-        # decrypt work or key-required errors): BOTH outcomes are 412 on
-        # CopyObject (there is no 304 for copies).
+        # Copy preconditions FIRST, against metadata only: a failed
+        # if-match must 412 before ANY data IO — especially the remote-tier
+        # recall below, which would otherwise download a whole object just
+        # to discard it. BOTH outcomes are 412 on CopyObject (no 304).
         if _rfc7232_outcome(
-            h, src_oi.etag, src_oi.mod_time, prefix="x-amz-copy-source-if-"
+            request.headers, probe.etag, probe.mod_time, prefix="x-amz-copy-source-if-"
         ) is not None:
             raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
+
+        # Transitioned sources stream back from their remote tier (the GET
+        # path's discipline; copying must not 5xx just because the local
+        # shards were freed — cmd/object-handlers.go CopyObject restores
+        # through getTransitionedObjectReader).
+        if self.tiering is not None and tiering_mod.is_transitioned(probe.internal):
+            src_oi = probe
+            data = self.tiering.read_object(self.layer, src_bucket, src_key, probe)
+        else:
+            src_oi, data = self.layer.get_object(src_bucket, src_key, GetObjectOptions(vid))
         # LOGICAL bytes, like GET: a compressed/encrypted source copied raw
         # would land at the destination without its transform metadata —
         # permanently unreadable ciphertext/deflate under a 200. The copy
@@ -1841,6 +1851,11 @@ class S3Server:
         opts = self._put_opts(bucket, request, key)
         if request.headers.get("x-amz-metadata-directive", "COPY") == "COPY":
             opts.user_defined = dict(src_oi.user_defined)
+            # A restored-from-tier source's x-amz-restore stamp must not
+            # travel: the destination is a plain local object, and a stale
+            # stamp would later convince the tiering reader a restored
+            # copy exists (S3 strips it on copy too).
+            opts.user_defined.pop(tiering_mod.META_RESTORE, None)
             opts.content_type = src_oi.content_type
             # COPY directive replaced user_defined; re-mark for replication
             # (src metadata never carries internal replication keys).

@@ -209,3 +209,25 @@ class TestTiering:
         if remote:
             assert remote[0]["secret_key"].startswith("sealed:")
             assert SECRET not in json.dumps(remote)
+
+
+def test_copy_of_transitioned_object(srv):
+    """CopyObject with a transitioned source must stream it back from the
+    tier (the GET path's discipline) instead of 5xx-ing on freed local
+    shards; the destination lands as a normal local object."""
+    node, c = srv["node"], srv["client"]
+    assert c.make_bucket("arch").status_code in (200, 409)  # own setup
+    body = os.urandom(200 * 1024)
+    c.put_object("arch", "cp-tiered.bin", body)
+    node.tiering.transition(node.pools, "arch", "cp-tiered.bin", "", "COLD")
+    r = c.request("PUT", "/arch/cp-tiered-dst.bin",
+                  headers={"x-amz-copy-source": "/arch/cp-tiered.bin"})
+    assert r.status_code == 200, r.text
+    assert c.get_object("arch", "cp-tiered-dst.bin").content == body
+    oi = node.pools.get_object_info("arch", "cp-tiered-dst.bin")
+    assert not tiering_mod.is_transitioned(oi.internal)
+    # the source stays tiered and readable
+    assert tiering_mod.is_transitioned(
+        node.pools.get_object_info("arch", "cp-tiered.bin").internal
+    )
+    assert c.get_object("arch", "cp-tiered.bin").content == body
