@@ -27,6 +27,7 @@ from aiohttp import web
 
 from ..utils import errors as oerr
 from .jwt import JWTError, sign_hs256, verify as jwt_verify
+from .server import _display_size
 
 CONSOLE_PREFIX = "/mtpu/console"
 TOKEN_TTL_S = 12 * 3600
@@ -173,7 +174,7 @@ def make_console_app(ctx) -> web.Application:
         return _json(
             {
                 "objects": [
-                    {"name": o.name, "size": o.size, "modTime": o.mod_time, "etag": o.etag}
+                    {"name": o.name, "size": _display_size(o), "modTime": o.mod_time, "etag": o.etag}
                     for o in res.objects
                 ],
                 "prefixes": res.prefixes,

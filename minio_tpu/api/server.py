@@ -30,6 +30,7 @@ from xml.sax.saxutils import escape
 from aiohttp import web
 
 from ..control.bucket_meta import BucketMetadataSys
+from ..control.compress import META_ACTUAL_SIZE
 from ..control import objectlock as ol
 from ..control import tiering as tiering_mod
 from ..control.iam import IAMSys
@@ -230,11 +231,20 @@ def _enc_key(name: str, url_encode: bool) -> str:
     return escape(name)
 
 
+def _display_size(o: ObjectInfo) -> int:
+    """Logical object size for listings/HEAD: transformed objects store
+    compressed/encrypted bytes, but S3 clients (sync tools especially)
+    compare listing sizes against local files — they must see the actual
+    size, as the reference's ObjectInfo.GetActualSize does."""
+    raw = o.internal.get(META_ACTUAL_SIZE, "")
+    return int(raw) if raw else o.size
+
+
 def _obj_xml(o: ObjectInfo, url_encode: bool = False) -> str:
     return (
         f"<Contents><Key>{_enc_key(o.name, url_encode)}</Key>"
         f"<LastModified>{_iso(o.mod_time)}</LastModified>"
-        f"<ETag>&quot;{o.etag}&quot;</ETag><Size>{o.size}</Size>"
+        f"<ETag>&quot;{o.etag}&quot;</ETag><Size>{_display_size(o)}</Size>"
         f"<StorageClass>{o.storage_class}</StorageClass>"
         "<Owner><ID>minio-tpu</ID><DisplayName>minio-tpu</DisplayName></Owner>"
         "</Contents>"
@@ -1244,7 +1254,7 @@ class S3Server:
                     f"<Version><Key>{_enc_key(o.name, url_enc)}</Key><VersionId>{vid}</VersionId>"
                     f"<IsLatest>{'true' if o.is_latest else 'false'}</IsLatest>"
                     f"<LastModified>{_iso(o.mod_time)}</LastModified>"
-                    f"<ETag>&quot;{o.etag}&quot;</ETag><Size>{o.size}</Size>"
+                    f"<ETag>&quot;{o.etag}&quot;</ETag><Size>{_display_size(o)}</Size>"
                     f"<StorageClass>{o.storage_class}</StorageClass></Version>"
                 )
         prefixes = "".join(
@@ -1698,10 +1708,7 @@ class S3Server:
 
     @staticmethod
     def _logical_size(oi: ObjectInfo) -> int:
-        from ..control.crypto import META_ACTUAL_SIZE
-
-        raw = oi.internal.get(META_ACTUAL_SIZE, "")
-        return int(raw) if raw else oi.size
+        return _display_size(oi)
 
     def _sse_response_headers(self, oi: ObjectInfo) -> dict[str, str]:
         from ..control import crypto as crypto_mod

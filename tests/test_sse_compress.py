@@ -462,6 +462,27 @@ class TestAPIIntegration:
         finally:
             stack["config"].unset("compression", "enable")
 
+    def test_listing_shows_actual_size_of_compressed(self, stack):
+        """Sync tools compare listing <Size> against local files; a
+        compressed object must list its ACTUAL size, not the stored form
+        (the reference's ObjectInfo.GetActualSize in listings)."""
+        import re
+
+        c = stack["client"]
+        stack["config"].set("compression", "enable", "on")
+        try:
+            body = b"sizable line\n" * 10000
+            c.put_object("sseb", "sz.txt", body)
+            r = c.request("GET", "/sseb", query=[("list-type", "2"), ("prefix", "sz.txt")])
+            size = int(re.search(r"<Size>(\d+)</Size>", r.text).group(1))
+            assert size == len(body), f"listed {size}, actual {len(body)}"
+            # versions listing too
+            r = c.request("GET", "/sseb", query=[("versions", ""), ("prefix", "sz.txt")])
+            size = int(re.search(r"<Size>(\d+)</Size>", r.text).group(1))
+            assert size == len(body)
+        finally:
+            stack["config"].unset("compression", "enable")
+
     def test_compression_transparent(self, stack):
         c = stack["client"]
         stack["config"].set("compression", "enable", "on")
