@@ -483,6 +483,26 @@ class TestAPIIntegration:
         finally:
             stack["config"].unset("compression", "enable")
 
+    def test_get_object_attributes(self, stack):
+        """?attributes must return the metadata document (unquoted ETag,
+        logical ObjectSize), not fall through to a body GET."""
+        c = stack["client"]
+        stack["config"].set("compression", "enable", "on")
+        try:
+            body = b"attr text\n" * 8000
+            c.put_object("sseb", "at.txt", body)
+            r = c.request("GET", "/sseb/at.txt", query=[("attributes", "")],
+                          headers={"x-amz-object-attributes": "ETag,ObjectSize,StorageClass"})
+            assert r.status_code == 200, r.text
+            assert b"GetObjectAttributesResponse" in r.content, r.content[:120]
+            assert f"<ObjectSize>{len(body)}</ObjectSize>".encode() in r.content
+            assert b"<ETag>" in r.content and b"&quot;" not in r.content
+            # header required
+            r = c.request("GET", "/sseb/at.txt", query=[("attributes", "")])
+            assert r.status_code == 400
+        finally:
+            stack["config"].unset("compression", "enable")
+
     def test_compression_transparent(self, stack):
         c = stack["client"]
         stack["config"].set("compression", "enable", "on")
