@@ -2539,7 +2539,9 @@ class S3Server:
                         bucket=bucket,
                         object_name=oi.name,
                         etag=oi.etag,
-                        size=oi.size,
+                        # Event consumers see S3 semantics: the object's
+                        # logical size, not the stored transformed form.
+                        size=_display_size(oi),
                         version_id=oi.version_id,
                         region=self.region,
                     )
