@@ -1823,36 +1823,23 @@ class S3Server:
         if "/" not in src:
             raise S3Error("InvalidArgument", "bad copy source")
         src_bucket, src_key = src.split("/", 1)
-        probe = self.layer.get_object_info(src_bucket, src_key, GetObjectOptions(vid))
 
-        # Copy preconditions FIRST, against metadata only: a failed
-        # if-match must 412 before ANY data IO — especially the remote-tier
-        # recall below, which would otherwise download a whole object just
-        # to discard it. BOTH outcomes are 412 on CopyObject (no 304).
-        if _rfc7232_outcome(
-            request.headers, probe.etag, probe.mod_time, prefix="x-amz-copy-source-if-"
-        ) is not None:
-            raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
+        def pre_check(probe: ObjectInfo) -> None:
+            # Copy preconditions against metadata only, before any data IO
+            # or tier recall. BOTH outcomes are 412 on CopyObject (no 304).
+            if _rfc7232_outcome(
+                request.headers, probe.etag, probe.mod_time,
+                prefix="x-amz-copy-source-if-",
+            ) is not None:
+                raise S3Error("PreconditionFailed", resource=f"/{src_bucket}/{src_key}")
 
-        # Transitioned sources stream back from their remote tier (the GET
-        # path's discipline; copying must not 5xx just because the local
-        # shards were freed — cmd/object-handlers.go CopyObject restores
-        # through getTransitionedObjectReader).
-        if self.tiering is not None and tiering_mod.is_transitioned(probe.internal):
-            src_oi = probe
-            data = self.tiering.read_object(self.layer, src_bucket, src_key, probe)
-        else:
-            src_oi, data = self.layer.get_object(src_bucket, src_key, GetObjectOptions(vid))
-        # LOGICAL bytes, like GET: a compressed/encrypted source copied raw
-        # would land at the destination without its transform metadata —
-        # permanently unreadable ciphertext/deflate under a 200. The copy
-        # destination re-applies its own transforms via _transform_put. An
-        # SSE-C source's key arrives in the copy-source header family.
-        if self._is_transformed(src_oi):
-            data = self._transform_get(
-                src_bucket, src_key, data, src_oi, request, ssec_prefix="copy-source-"
-            )
-        return src_oi, data
+        # Logical bytes, tiered recall included; the SSE-C source key
+        # arrives in the copy-source header family. The destination
+        # re-applies its own transforms via _transform_put.
+        return self._read_logical(
+            src_bucket, src_key, request, vid,
+            ssec_prefix="copy-source-", pre_check=pre_check,
+        )
 
     def _copy_object(self, bucket: str, key: str, request: web.Request) -> web.Response:
         src_oi, data = self._resolve_copy_source(request)
@@ -1919,17 +1906,34 @@ class S3Server:
 
     # -- zip extension (s3-zip-handlers.go role) ------------------------------
 
-    def _read_zip_archive(self, bucket: str, zip_key: str, request: web.Request) -> bytes:
-        """Whole archive in logical bytes (transforms undone, tiered versions
-        fetched back)."""
-        opts = GetObjectOptions()
-        probe = self.layer.get_object_info(bucket, zip_key, opts)
+    def _read_logical(
+        self, bucket: str, key: str, request: web.Request, vid: str = "",
+        ssec_prefix: str = "", pre_check=None,
+    ) -> tuple[ObjectInfo, bytes]:
+        """Whole object in LOGICAL bytes: tiered versions recalled from
+        their remote tier, transforms (SSE/compression) undone — the read
+        every non-streaming consumer (Select, zip extraction, copy source)
+        must share, or each grows its own 5xx-on-tiered / raw-bytes bug.
+
+        pre_check(probe) runs against metadata BEFORE any data IO, so
+        callers with preconditions (copy's if-match) never pay a tier
+        recall just to discard it."""
+        opts = GetObjectOptions(vid)
+        probe = self.layer.get_object_info(bucket, key, opts)
+        if pre_check is not None:
+            pre_check(probe)
         if self.tiering is not None and tiering_mod.is_transitioned(probe.internal):
-            data = self.tiering.read_object(self.layer, bucket, zip_key, probe)
+            data = self.tiering.read_object(self.layer, bucket, key, probe)
             oi = probe
         else:
-            oi, data = self.layer.get_object(bucket, zip_key, opts)
-        return self._transform_get(bucket, zip_key, data, oi, request)
+            oi, data = self.layer.get_object(bucket, key, opts)
+        return oi, self._transform_get(
+            bucket, key, data, oi, request, ssec_prefix=ssec_prefix
+        )
+
+    def _read_zip_archive(self, bucket: str, zip_key: str, request: web.Request) -> bytes:
+        """Whole archive in logical bytes."""
+        return self._read_logical(bucket, zip_key, request)[1]
 
     def _get_object_in_zip(
         self, bucket: str, key: str, request: web.Request, head: bool
@@ -2414,12 +2418,12 @@ class S3Server:
             return select_err(e)
 
         def get_data(_off, _ln) -> bytes:
-            info, data = self.layer.get_object(bucket, key, GetObjectOptions())
-            return self._transform_get(bucket, key, data, info, request)
+            return self._read_logical(bucket, key, request)[1]
 
-        # Probe existence first so NoSuchKey surfaces as a plain S3 error
-        # (the event stream has not started yet).
-        self.layer.get_object_info(bucket, key, GetObjectOptions())
+        # No separate existence probe: the response is fully buffered below,
+        # so a NoSuchKey raised by the first get_data still surfaces as a
+        # plain S3 error via the dispatcher (no event stream has started) —
+        # and _read_logical already probes once per read.
         try:
             frames = list(run_select(sreq, get_data))
         except SelectError as e:

@@ -231,3 +231,30 @@ def test_copy_of_transitioned_object(srv):
         node.pools.get_object_info("arch", "cp-tiered.bin").internal
     )
     assert c.get_object("arch", "cp-tiered.bin").content == body
+
+
+def test_select_on_transitioned_object(srv):
+    """S3 Select over a transitioned object recalls it from the tier (the
+    shared logical-read path) instead of 5xx-ing on freed shards."""
+    node, c = srv["node"], srv["client"]
+    assert c.make_bucket("arch").status_code in (200, 409)
+    csv = b"a,b\n" + b"".join(b"%d,%d\n" % (i, i) for i in range(50000))
+    c.put_object("arch", "sel.csv", csv)
+    node.tiering.transition(node.pools, "arch", "sel.csv", "", "COLD")
+    sel = c.request(
+        "POST", "/arch/sel.csv", query=[("select", ""), ("select-type", "2")],
+        body=b"""<?xml version="1.0"?><SelectObjectContentRequest>
+          <Expression>SELECT count(*) FROM S3Object</Expression>
+          <ExpressionType>SQL</ExpressionType>
+          <InputSerialization><CSV><FileHeaderInfo>USE</FileHeaderInfo></CSV></InputSerialization>
+          <OutputSerialization><CSV/></OutputSerialization>
+        </SelectObjectContentRequest>""",
+    )
+    assert sel.status_code == 200, sel.text
+    from minio_tpu.s3select import decode_messages
+
+    recs = b"".join(
+        m["payload"] for m in decode_messages(sel.content)
+        if m["headers"].get(":event-type") == "Records"
+    )
+    assert recs.strip() == b"50000", recs
