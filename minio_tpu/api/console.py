@@ -364,6 +364,73 @@ def make_console_app(ctx) -> web.Application:
         names = sorted({*policy_mod.CANNED, *ctx.iam.custom_policies})
         return _json({"policies": names})
 
+    async def groups_list(request: web.Request) -> web.Response:
+        _authed(request)
+        out = []
+        for g in ctx.iam.list_groups():
+            try:
+                out.append(ctx.iam.group_info(g))
+            except oerr.StorageError:
+                continue  # deleted between snapshot and info: skip, not 500
+        return _json({"groups": out})
+
+    async def group_update(request: web.Request) -> web.Response:
+        # Members add/remove (creates on first add) + policy attach, the
+        # console face of the admin /groups handlers. Every field validates
+        # BEFORE any mutation: a bad later field must not leave an earlier
+        # one half-applied with the peer fanout skipped.
+        _authed(request)
+        doc = await _body(request)
+        name = doc.get("name", "")
+        if not isinstance(name, str) or not name:
+            return _json({"error": "name required"}, 400)
+        members = None
+        if "members" in doc:
+            members = doc.get("members", [])
+            if not isinstance(members, list) or not all(
+                isinstance(m, str) for m in members
+            ):
+                return _json({"error": "members must be a list of strings"}, 400)
+        policies = _policies_field(doc) if "policies" in doc else None
+        status = None
+        if "status" in doc:
+            status = doc["status"]
+            if status not in ("enabled", "disabled"):
+                # Anything else persists and silently disables the group's
+                # grants (only the exact string 'enabled' confers policies).
+                return _json({"error": "status must be enabled|disabled"}, 400)
+
+        def work():
+            if members is not None:
+                ctx.iam.update_group_members(
+                    name, members, remove=bool(doc.get("isRemove", False))
+                )
+            if policies is not None:
+                ctx.iam.attach_group_policy(name, policies)
+            if status is not None:
+                ctx.iam.set_group_status(name, status)
+            _iam_fanout("group", ctx.iam.group_info(name))
+
+        try:
+            await asyncio.to_thread(work)
+        except oerr.StorageError as e:
+            return _json({"error": str(e)}, 400)
+        return _json({"ok": True})
+
+    async def group_delete(request: web.Request) -> web.Response:
+        _authed(request)
+        name = request.rel_url.query.get("name", "")
+
+        def work():
+            ctx.iam.remove_group(name)
+            _iam_fanout("group-delete", {"name": name})
+
+        try:
+            await asyncio.to_thread(work)
+        except oerr.StorageError as e:
+            return _json({"error": str(e)}, 400)
+        return _json({"ok": True})
+
     async def index(request: web.Request) -> web.Response:
         return web.Response(text=_PAGE, content_type="text/html")
 
@@ -380,6 +447,9 @@ def make_console_app(ctx) -> web.Application:
     app.router.add_put("/api/users/policy", user_policy)
     app.router.add_post("/api/service-accounts", sa_create)
     app.router.add_get("/api/policies", policies_list)
+    app.router.add_get("/api/groups", groups_list)
+    app.router.add_post("/api/groups", group_update)
+    app.router.add_delete("/api/groups", group_delete)
     app.router.add_get("", index)
     app.router.add_get("/", index)
     return app
@@ -417,7 +487,8 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <header><h1>minio_tpu</h1><span>console</span>
  <nav id="nav" class="hide" style="margin-left:24px">
-  <a id="nav-b">buckets</a> &nbsp; <a id="nav-u">users</a> &nbsp; <a id="nav-p">policies</a>
+  <a id="nav-b">buckets</a> &nbsp; <a id="nav-u">users</a> &nbsp;
+  <a id="nav-g">groups</a> &nbsp; <a id="nav-p">policies</a>
  </nav>
  <span style="margin-left:auto"><a id="logout" class="hide">sign out</a></span></header>
 <main>
@@ -509,6 +580,7 @@ async function boot() {
 }
 $('#nav-b').onclick = () => showBuckets();
 $('#nav-u').onclick = () => showUsers();
+$('#nav-g').onclick = () => showGroups();
 $('#nav-p').onclick = () => showPolicies();
 async function showBuckets() {
   $('#crumbs').replaceChildren(el('a', 'buckets', showBuckets));
@@ -570,6 +642,54 @@ async function showUsers() {
       }));
     body.append(row([u.accessKey, u.status, u.policies.join(', ') || '\\u2013',
       u.parentUser || '\\u2013', actions]));
+  }
+}
+async function showGroups() {
+  $('#crumbs').replaceChildren(el('b', 'groups'));
+  const gn = input('group name'), gm = input('members (comma-sep)');
+  $('#actions').replaceChildren(gn, gm,
+    btn('add members', async () => {
+      await act('POST', '/groups', {name: gn.value,
+        members: gm.value.split(',').map(s => s.trim()).filter(Boolean)});
+      showGroups();
+    }));
+  const d = await (await api('/groups')).json();
+  head(['group', 'status', 'members', 'policies', '']);
+  const body = $('#tbl tbody');
+  if (!d.groups.length) body.append(row(['no groups', '', '', '', '']));
+  for (const g of d.groups) {
+    const actions = el('span');
+    actions.append(
+      el('a', 'policies', async () => {
+        const p = prompt('Policies for ' + g.name + ' (comma-sep):',
+          g.policies.join(','));
+        if (p == null) return;
+        await act('POST', '/groups', {name: g.name,
+          policies: p.split(',').map(s => s.trim()).filter(Boolean)});
+        showGroups();
+      }),
+      el('span', ' \\u00b7 '),
+      el('a', g.status === 'enabled' ? 'disable' : 'enable', async () => {
+        await act('POST', '/groups', {name: g.name,
+          status: g.status === 'enabled' ? 'disabled' : 'enabled'});
+        showGroups();
+      }),
+      el('span', ' \\u00b7 '),
+      el('a', 'remove members', async () => {
+        const m = prompt('Members to REMOVE from ' + g.name + ':', g.members.join(','));
+        if (m == null) return;
+        await act('POST', '/groups', {name: g.name, isRemove: true,
+          members: m.split(',').map(s => s.trim()).filter(Boolean)});
+        showGroups();
+      }),
+      el('span', ' \\u00b7 '),
+      el('a', 'delete', async () => {
+        if (!confirm('Delete group ' + g.name + '? (must be empty)')) return;
+        await act('DELETE', '/groups?' + new URLSearchParams({name: g.name}));
+        showGroups();
+      }));
+    body.append(row([g.name, g.status, g.members.join(', ') || '\\u2013',
+      g.policies.join(', ') || '\\u2013', actions]));
   }
 }
 async function showPolicies() {
