@@ -391,6 +391,11 @@ def make_console_app(ctx) -> web.Application:
                 isinstance(m, str) for m in members
             ):
                 return _json({"error": "members must be a list of strings"}, 400)
+        is_remove = doc.get("isRemove", False)
+        if not isinstance(is_remove, bool):
+            # bool("false") is True: a stringly-typed flag would silently
+            # flip an add into a removal.
+            return _json({"error": "isRemove must be a boolean"}, 400)
         policies = _policies_field(doc) if "policies" in doc else None
         status = None
         if "status" in doc:
@@ -399,12 +404,12 @@ def make_console_app(ctx) -> web.Application:
                 # Anything else persists and silently disables the group's
                 # grants (only the exact string 'enabled' confers policies).
                 return _json({"error": "status must be enabled|disabled"}, 400)
+        if members is None and policies is None and status is None:
+            return _json({"error": "nothing to change (members/policies/status)"}, 400)
 
         def work():
             if members is not None:
-                ctx.iam.update_group_members(
-                    name, members, remove=bool(doc.get("isRemove", False))
-                )
+                ctx.iam.update_group_members(name, members, remove=is_remove)
             if policies is not None:
                 ctx.iam.attach_group_policy(name, policies)
             if status is not None:

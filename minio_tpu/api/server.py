@@ -1662,16 +1662,26 @@ class S3Server:
         if compression_on and compress_mod.is_compressible(key, opts.content_type):
             body, cmeta = compress_mod.compress(body)
             opts.user_defined.update(cmeta)
+        def merge_sse_meta(res_metadata: dict) -> None:
+            # Compression (above) already recorded the ORIGINAL actual
+            # size; the SSE layer's view of "actual" is the compressed
+            # length and must not clobber it — every metadata consumer
+            # (listing Size, events, GetObjectAttributes) would report the
+            # compressed size for compress+SSE objects.
+            prior = opts.user_defined.get(crypto_mod.META_ACTUAL_SIZE)
+            opts.user_defined.update(res_metadata)
+            if prior is not None:
+                opts.user_defined[crypto_mod.META_ACTUAL_SIZE] = prior
+
         if ssec_key is not None:
             res = crypto_mod.sse_c_encrypt(body, ssec_key, bucket, key)
-            opts.user_defined.update(res.metadata)
-            opts.user_defined.setdefault(crypto_mod.META_ACTUAL_SIZE, res.metadata[crypto_mod.META_ACTUAL_SIZE])
+            merge_sse_meta(res.metadata)
             return res.data
         if wants_sse_s3:
             if self.kms is None:
                 raise S3Error("NotImplemented", "no KMS configured")
             res = crypto_mod.sse_s3_encrypt(body, self.kms, bucket, key)
-            opts.user_defined.update(res.metadata)
+            merge_sse_meta(res.metadata)
             return res.data
         return body
 

@@ -285,10 +285,16 @@ def test_elasticsearch_namespace():
 def test_spool_survives_broker_outage(tmp_path):
     # No broker listening: event spools to disk; a new target instance with
     # a live broker drains it (queuestore.go recovery semantics).
-    dead_port = FakeRedis()  # allocate then close to get a dead port
-    dead_port.sock.close()
+    # The dead port stays BOUND (not listening) for the whole test: a
+    # merely-freed port could be re-bound by a concurrent test's server,
+    # silently turning "broker down" into "broker up" (observed flake).
+    import socket
+
+    holder = socket.socket()
+    holder.bind(("127.0.0.1", 0))  # bound + never listen = connection refused
+    dead_port = holder.getsockname()[1]
     qdir = str(tmp_path / "spool")
-    t = et.RedisEventTarget("redis", f"127.0.0.1:{dead_port.port}", "k", queue_dir=qdir)
+    t = et.RedisEventTarget("redis", f"127.0.0.1:{dead_port}", "k", queue_dir=qdir)
     t.send(RECORD)
     assert _wait(lambda: t.queue.pending() == 1)
     t.close()
@@ -301,6 +307,7 @@ def test_spool_survives_broker_outage(tmp_path):
     assert _wait(lambda: broker.commands)
     assert _wait(lambda: not os.listdir(qdir))  # spool drained + removed
     t2.close()
+    holder.close()
 
 
 # -- gating -------------------------------------------------------------------

@@ -1,0 +1,140 @@
+"""Kitchen-sink integration: every round-5 subsystem live in ONE stack.
+
+Per-feature suites prove features in isolation; this boots a single node
+with compression + KES KMS + LDAP identity + groups + quotas +
+notifications all configured at once, exercises the cross-feature flows,
+then restarts the process-equivalent (fresh Node over the same drives)
+and asserts the durable state all came back.
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import pytest
+
+from minio_tpu.api.server import ThreadedServer
+from minio_tpu.dist.node import Node
+from minio_tpu.object.codec import HostCodec
+from tests.ldapstub import StubLDAP
+from tests.s3client import S3TestClient
+from tests.test_sse_compress import _StubKES
+
+ROOT, SECRET = "sinkroot1", "sink-secret-key1"
+ALICE_DN = "uid=alice,ou=people,dc=sink,dc=org"
+
+
+@pytest.fixture()
+def stack(tmp_path, monkeypatch):
+    kes = _StubKES()
+    ldap = StubLDAP(
+        directory={ALICE_DN: {"uid": ["alice"], "objectclass": ["person"]}},
+        passwords={ALICE_DN: "alice-pw"},
+    )
+    monkeypatch.setenv("MINIO_TPU_KMS_KES_ENDPOINT", kes.endpoint)
+    dirs = [str(tmp_path / f"d{i}") for i in range(4)]
+    node = Node(dirs, root_user=ROOT, root_password=SECRET, codec=HostCodec())
+    ts = ThreadedServer(SimpleNamespace(app=node.make_app()))
+    base = ts.start()
+    node.build()
+    c = S3TestClient(base, ROOT, SECRET)
+    node.config.set("compression", "enable", "on")
+    for k, v in {
+        "server_addr": ldap.addr,
+        "lookup_bind_dn": "",
+        "lookup_bind_password": "",
+        "user_dn_search_base_dn": "ou=people,dc=sink,dc=org",
+        "user_dn_search_filter": "(uid=%s)",
+    }.items():
+        node.config.set("identity_ldap", k, v)
+    yield {"node": node, "ts": ts, "c": c, "base": base, "dirs": dirs,
+           "kes": kes, "ldap": ldap}
+    ts.stop()
+    kes.close()
+    ldap.close()
+
+
+def test_everything_together_and_survives_restart(stack, tmp_path):
+    c, node, base = stack["c"], stack["node"], stack["base"]
+
+    # IAM: user in a group whose policy grants readwrite; LDAP mapping too.
+    assert c.request(
+        "POST", "/mtpu/admin/v1/users",
+        body=json.dumps({"accessKey": "sinkuser", "secretKey": "sinksecret12"}).encode(),
+    ).status_code == 200
+    assert c.request("PUT", "/mtpu/admin/v1/groups/team",
+                     body=json.dumps({"members": ["sinkuser"]}).encode()).status_code == 200
+    assert c.request("PUT", "/mtpu/admin/v1/groups/team/policy",
+                     body=json.dumps({"policies": ["readwrite"]}).encode()).status_code == 200
+    assert c.request("PUT", "/mtpu/admin/v1/idp/ldap/policy",
+                     body=json.dumps({"dn": ALICE_DN, "policies": ["readonly"]}).encode()
+                     ).status_code == 200
+
+    # Bucket with notification config; a compressed + SSE-KMS object.
+    c.make_bucket("sink")
+    xml = (
+        '<NotificationConfiguration xmlns="http://s3.amazonaws.com/doc/2006-03-01/">'
+        "<QueueConfiguration><Id>q</Id><Queue>arn:minio:sqs::1:sinktgt</Queue>"
+        "<Event>s3:ObjectCreated:*</Event></QueueConfiguration>"
+        "</NotificationConfiguration>"
+    )
+    assert c.request("PUT", "/sink", query=[("notification", "")], body=xml.encode()
+                     ).status_code in (200, 204)
+    events = []
+    node.notifier.register_target(
+        type("T", (), {"id": "sinktgt", "send": lambda self, r: events.append(r)})()
+    )
+    body = (b"sink payload %04d\n" * 3000) % tuple(range(3000))
+    r = c.request("PUT", "/sink/data.txt", body=body,
+                  headers={"x-amz-server-side-encryption": "aws:kms"})
+    assert r.status_code == 200, r.text
+    assert c.get_object("sink", "data.txt").content == body
+    assert any("/v1/key/" in p for p in stack["kes"].requests), "KES never consulted"
+    assert events and events[0]["Records"][0]["s3"]["object"]["size"] == len(body)
+
+    # Group member writes via group policy; LDAP identity reads via STS.
+    gu = S3TestClient(base, "sinkuser", "sinksecret12")
+    assert gu.request("PUT", "/sink/by-group.txt", body=b"g").status_code == 200
+    import re
+
+    import requests
+
+    sts = requests.post(base + "/", data={
+        "Action": "AssumeRoleWithLDAPIdentity", "LDAPUsername": "alice",
+        "LDAPPassword": "alice-pw", "Version": "2011-06-15"}, timeout=10)
+    assert sts.status_code == 200, sts.text
+    ak = re.search(r"<AccessKeyId>([^<]+)</AccessKeyId>", sts.text).group(1)
+    sk = re.search(r"<SecretAccessKey>([^<]+)</SecretAccessKey>", sts.text).group(1)
+    lu = S3TestClient(base, ak, sk)
+    assert lu.get_object("sink", "data.txt").content == body  # readonly works
+    assert lu.request("PUT", "/sink/denied.txt", body=b"x").status_code == 403
+
+    # Copy the transformed object; attributes + listing agree on size.
+    assert c.request("PUT", "/sink/copy.txt",
+                     headers={"x-amz-copy-source": "/sink/data.txt"}).status_code == 200
+    assert c.get_object("sink", "copy.txt").content == body
+    lst = c.request("GET", "/sink", query=[("list-type", "2"), ("prefix", "data.txt")])
+    assert f"<Size>{len(body)}</Size>" in lst.text
+
+    # Restart: fresh Node over the same drives (same env). Everything
+    # durable must come back — users, groups, LDAP map, notification rules.
+    stack["ts"].stop()
+    node2 = Node(stack["dirs"], root_user=ROOT, root_password=SECRET, codec=HostCodec())
+    ts2 = ThreadedServer(SimpleNamespace(app=node2.make_app()))
+    base2 = ts2.start()
+    try:
+        node2.build()
+        c2 = S3TestClient(base2, ROOT, SECRET)
+        assert c2.get_object("sink", "data.txt").content == body
+        users = c2.request("GET", "/mtpu/admin/v1/users").json()
+        assert "sinkuser" in users
+        info = c2.request("GET", "/mtpu/admin/v1/groups/team").json()
+        assert info["members"] == ["sinkuser"] and info["policies"] == ["readwrite"]
+        assert c2.request("GET", "/mtpu/admin/v1/idp/ldap/policy").json() == {
+            ALICE_DN: ["readonly"]
+        }
+        assert node2.notifier.bucket_rules.get("sink"), "notification rules lost"
+        gu2 = S3TestClient(base2, "sinkuser", "sinksecret12")
+        assert gu2.request("PUT", "/sink/after-restart.txt", body=b"x").status_code == 200
+    finally:
+        ts2.stop()
