@@ -305,21 +305,11 @@ def make_console_app(ctx) -> web.Application:
         ak = request.rel_url.query.get("accessKey", "")
 
         def work():
-            # Cascade to the user's service accounts: an orphan SA would
-            # silently revive if the access key is ever recreated.
-            children = [
-                sak for sak, ident in ctx.iam.list_users().items()
-                if ident.parent_user == ak
-            ]
+            # remove_user cascades to the user's service accounts / STS
+            # creds inside one persisted mutation; one fanout reloads the
+            # whole IAM store on every peer.
             ctx.iam.remove_user(ak)
-            for sak in children:
-                try:
-                    ctx.iam.remove_user(sak)
-                except oerr.StorageError:
-                    pass
             _iam_fanout("user-delete", {"access_key": ak})
-            for sak in children:
-                _iam_fanout("user-delete", {"access_key": sak})
 
         try:
             await asyncio.to_thread(work)

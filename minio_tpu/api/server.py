@@ -35,6 +35,7 @@ from ..control import objectlock as ol
 from ..control import tiering as tiering_mod
 from ..control.iam import IAMSys
 from ..control import policy as policy_mod
+from ..control import tracing
 from ..object.pools import ServerPools
 from ..object.types import (
     DeleteObjectOptions,
@@ -329,18 +330,31 @@ class S3Server:
     async def _entry(self, request: web.Request) -> web.Response:
         request_id = secrets.token_hex(8).upper()
         t0 = _time.perf_counter()
-        try:
-            resp = await self._dispatch(request, request_id)
-        except S3Error as e:
-            resp = _xml(e.to_xml(request_id), e.api.http_status)
-        except (oerr.StorageError, ValueError) as e:
-            bucket, key = self._split_path(request)
-            s3e = (
-                from_object_error(e, bucket, key)
-                if isinstance(e, oerr.StorageError)
-                else S3Error("InvalidArgument", str(e))
-            )
-            resp = _xml(s3e.to_xml(request_id), s3e.api.http_status)
+        bucket, key = self._split_path(request)
+        api_name = _api_name(request.method, bucket, key, request.rel_url.query)
+        # The request root span: trace id == x-amz-request-id, so trace and
+        # audit records join on one key. No-op when nobody subscribes.
+        root = tracing.root_span(
+            api_name,
+            "api",
+            request_id,
+            sys=self.trace,
+            method=request.method,
+            path=request.path,
+        )
+        with root:
+            try:
+                resp = await self._dispatch(request, request_id)
+            except S3Error as e:
+                resp = _xml(e.to_xml(request_id), e.api.http_status)
+            except (oerr.StorageError, ValueError) as e:
+                s3e = (
+                    from_object_error(e, bucket, key)
+                    if isinstance(e, oerr.StorageError)
+                    else S3Error("InvalidArgument", str(e))
+                )
+                resp = _xml(s3e.to_xml(request_id), s3e.api.http_status)
+            root.set(status=resp.status)
         duration = _time.perf_counter() - t0
         if not resp.prepared:  # streamed responses already sent their headers
             resp.headers["x-amz-request-id"] = request_id
@@ -349,8 +363,6 @@ class S3Server:
             resp.headers.setdefault("Server", "MinIO-TPU")
         if self.metrics is not None:
             self.metrics.record_http(request.method, resp.status)
-            bucket, key = self._split_path(request)
-            api_name = _api_name(request.method, bucket, key, request.rel_url.query)
             self.metrics.record_api(api_name, duration, resp.status < 400)
         if self.trace is not None and self.trace.enabled():
             self.trace.publish(
@@ -362,9 +374,8 @@ class S3Server:
                 request_id=request_id,
             )
         if self.logger is not None:
-            bucket, key = self._split_path(request)
             self.logger.audit(
-                api=_api_name(request.method, bucket, key, request.rel_url.query),
+                api=api_name,
                 bucket=bucket,
                 object_name=key,
                 status_code=resp.status,
@@ -566,10 +577,18 @@ class S3Server:
                     "Vary": "Origin",
                 },
             )
-        if request.path in ("/minio/v2/metrics/cluster", "/minio/v2/metrics/node"):
+        if request.path == "/minio/v2/metrics/node":
             if self.metrics is None:
                 raise S3Error("NotImplemented")
-            return web.Response(text=self.metrics.render(), content_type="text/plain")
+            return web.Response(
+                text=self.metrics.render_node(), content_type="text/plain"
+            )
+        if request.path == "/minio/v2/metrics/cluster":
+            if self.metrics is None:
+                raise S3Error("NotImplemented")
+            # Cluster view fans out HTTP calls to peers -> off the event loop.
+            text = await asyncio.to_thread(self.metrics.render_cluster)
+            return web.Response(text=text, content_type="text/plain")
         bucket, key = self._split_path(request)
         # Object PUTs (plain and upload-part) stream: auth from headers, the
         # body flows through verified readers into the erasure pipeline

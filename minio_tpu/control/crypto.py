@@ -18,7 +18,10 @@ import hashlib
 import secrets
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # gated: unencrypted paths must work without the lib
+    AESGCM = None
 
 from ..utils import errors
 from .kms import KMS
@@ -38,9 +41,15 @@ ALGO_SSE_S3 = "SSE-S3"
 ALGO_SSE_C = "SSE-C"
 
 
+def _aead(key: bytes):
+    if AESGCM is None:
+        raise errors.StorageError("SSE unavailable: cryptography not installed")
+    return AESGCM(key)
+
+
 def encrypt_stream(data: bytes, object_key: bytes) -> bytes:
     """Package-encrypt a whole buffer with the per-object key."""
-    aead = AESGCM(object_key)
+    aead = _aead(object_key)
     out = bytearray()
     for i, off in enumerate(range(0, len(data), PACKAGE_SIZE)):
         chunk = data[off : off + PACKAGE_SIZE]
@@ -54,7 +63,7 @@ def encrypt_stream(data: bytes, object_key: bytes) -> bytes:
 
 
 def decrypt_stream(blob: bytes, object_key: bytes) -> bytes:
-    aead = AESGCM(object_key)
+    aead = _aead(object_key)
     out = bytearray()
     pos = 0
     i = 0
@@ -73,12 +82,13 @@ def decrypt_stream(blob: bytes, object_key: bytes) -> bytes:
 
 def _seal_key(object_key: bytes, kek: bytes, context: bytes) -> bytes:
     nonce = secrets.token_bytes(12)
-    return nonce + AESGCM(kek).encrypt(nonce, object_key, context)
+    return nonce + _aead(kek).encrypt(nonce, object_key, context)
 
 
 def _unseal_key(sealed: bytes, kek: bytes, context: bytes) -> bytes:
+    aead = _aead(kek)
     try:
-        return AESGCM(kek).decrypt(sealed[:12], sealed[12:], context)
+        return aead.decrypt(sealed[:12], sealed[12:], context)
     except Exception:
         raise errors.PreconditionFailed(msg="SSE key unseal failed")
 

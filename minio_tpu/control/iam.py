@@ -104,7 +104,13 @@ class IAMSys:
         return hashlib.sha256(b"minio_tpu-iam-store:" + self.root.secret_key.encode()).digest()
 
     def _seal(self, data: bytes) -> bytes:
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        try:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        except ImportError:
+            # No cryptography available: persist plaintext (no magic prefix),
+            # which _unseal reads back unchanged. Sealed-at-rest resumes as
+            # soon as the library exists.
+            return data
 
         nonce = secrets.token_bytes(12)
         ct = AESGCM(self._seal_key()).encrypt(nonce, data, b"iam")
@@ -114,7 +120,12 @@ class IAMSys:
         if not blob.startswith(self._SEAL_MAGIC):
             return blob  # pre-encryption plaintext blob: readable once,
             # re-sealed on the next persist
-        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        try:
+            from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        except ImportError as e:
+            raise errors.FileCorrupt(
+                "IAM store is sealed but cryptography is not installed"
+            ) from e
 
         try:
             return AESGCM(self._seal_key()).decrypt(
@@ -327,6 +338,14 @@ class IAMSys:
             if access_key not in self.users:
                 raise errors.InvalidArgument(msg=f"no such user {access_key}")
             del self.users[access_key]
+            # Cascade: service accounts and STS creds derived from this user
+            # die with it, in the SAME persisted mutation -- an orphan child
+            # credential would silently revive if the key is ever recreated.
+            for child_ak in [
+                ak for ak, ident in self.users.items()
+                if ident.parent_user == access_key
+            ]:
+                del self.users[child_ak]
             for g in self.groups.values():
                 if access_key in g["members"]:
                     g["members"].remove(access_key)

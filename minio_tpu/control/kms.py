@@ -15,7 +15,10 @@ import secrets
 import threading
 from dataclasses import dataclass
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+try:
+    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+except ImportError:  # gated: a node without SSE must still boot
+    AESGCM = None
 
 from ..utils import errors
 
@@ -57,6 +60,8 @@ class StaticKeyKMS(KMS):
         return cls(name, key)
 
     def generate_key(self, key_id: str = "", context: str = "") -> DataKey:
+        if AESGCM is None:
+            raise errors.StorageError("SSE unavailable: cryptography not installed")
         key_id = key_id or self.name
         if key_id != self.name:
             raise errors.InvalidArgument(msg=f"unknown KMS key {key_id}")
@@ -66,6 +71,8 @@ class StaticKeyKMS(KMS):
         return DataKey(key_id=key_id, plaintext=plaintext, ciphertext=sealed)
 
     def decrypt_key(self, key_id: str, ciphertext: bytes, context: str = "") -> bytes:
+        if AESGCM is None:
+            raise errors.StorageError("SSE unavailable: cryptography not installed")
         if key_id != self.name:
             raise errors.InvalidArgument(msg=f"unknown KMS key {key_id}")
         nonce, ct = ciphertext[:12], ciphertext[12:]
@@ -115,7 +122,11 @@ class KESClient(KMS):
         self._cache: "dict[tuple[str, bytes, str], bytes]" = {}
         self._cache_size = cache_size
         self._lock = threading.Lock()
-        self._conn = None  # persistent connection (guarded by _conn_lock)
+        # Small pool of persistent keep-alive connections. The lock guards
+        # only checkout/checkin, never the network round-trip, so concurrent
+        # SSE-KMS requests don't convoy behind one socket.
+        self._pool: list = []
+        self._pool_cap = 4
         self._conn_lock = threading.Lock()
 
     @classmethod
@@ -148,28 +159,37 @@ class KESClient(KMS):
         if self._api_key:
             headers["Authorization"] = f"Bearer {self._api_key}"
         payload_out = json_mod.dumps(body).encode() if body is not None else None
-        # One persistent keep-alive connection: generate_key sits on every
+        # Persistent keep-alive connections: generate_key sits on every
         # encrypted PUT, and a fresh TCP+TLS handshake per upload would
         # dominate the call. A stale/broken connection gets one reopen+retry.
-        with self._conn_lock:
-            last_err: Exception | None = None
-            for attempt in (0, 1):
-                if self._conn is None:
-                    self._conn = self._open()
+        last_err: Exception | None = None
+        for attempt in (0, 1):
+            with self._conn_lock:
+                conn = self._pool.pop() if self._pool else None
+            if conn is None:
+                conn = self._open()
+            try:
+                conn.request(method, path, body=payload_out, headers=headers)
+                resp = conn.getresponse()
+                data = resp.read()
+            except (OSError, http.client.HTTPException) as e:
                 try:
-                    self._conn.request(method, path, body=payload_out, headers=headers)
-                    resp = self._conn.getresponse()
-                    data = resp.read()
-                    break
-                except (OSError, http.client.HTTPException) as e:
-                    try:
-                        self._conn.close()
-                    except Exception:  # noqa: BLE001
-                        pass
-                    self._conn = None
-                    last_err = e
-            else:
-                raise errors.StorageError(f"KES unreachable: {last_err}") from last_err
+                    conn.close()
+                except Exception:  # noqa: BLE001
+                    pass
+                last_err = e
+                continue
+            # Healthy connection goes back for the next caller; beyond the
+            # cap it closes (a burst must not pin sockets forever).
+            with self._conn_lock:
+                if len(self._pool) < self._pool_cap:
+                    self._pool.append(conn)
+                    conn = None
+            if conn is not None:
+                conn.close()
+            break
+        else:
+            raise errors.StorageError(f"KES unreachable: {last_err}") from last_err
         if resp.status == 404:
             raise errors.InvalidArgument(msg=f"KES: unknown key ({path})")
         if resp.status in (401, 403):

@@ -66,6 +66,13 @@ class MetricsSys:
         self.start_time = time.time()
         self.layer = None  # set by the server for storage gauges
         self.replication = None  # ReplicationSys for replication gauges
+        # Node-level sources (wired by Node.build; None outside a server):
+        self.node_url = ""  # this node's URL, the cluster-view server label
+        self.notification = None  # NotificationSys: peer metrics fetch
+        self.scanner = None  # DataScanner progress counters
+        self.healmgr = None  # HealManager sequence counters
+        self.mrf = None  # MRFQueue heal backlog
+        self.disk_heal = None  # DiskHealMonitor completed trackers
 
     # -- recording -----------------------------------------------------------
 
@@ -99,12 +106,25 @@ class MetricsSys:
     # -- exposition ----------------------------------------------------------
 
     def render(self) -> str:
-        lines: list[str] = []
+        """Back-compat alias: the full node exposition."""
+        return self.render_node()
 
-        def metric(name: str, value, labels: dict | None = None, help_: str = ""):
-            if help_:
+    def render_node(self) -> str:
+        lines: list[str] = []
+        helped: set[str] = set()
+
+        def metric(
+            name: str,
+            value,
+            labels: dict | None = None,
+            help_: str = "",
+            type_: str = "counter",
+        ):
+            # HELP/TYPE go out once per series, before its first sample.
+            if help_ and name not in helped:
+                helped.add(name)
                 lines.append(f"# HELP {name} {help_}")
-                lines.append(f"# TYPE {name} counter")
+                lines.append(f"# TYPE {name} {type_}")
             if labels:
                 lab = ",".join(f'{k}="{v}"' for k, v in labels.items())
                 lines.append(f"{name}{{{lab}}} {value}")
@@ -119,19 +139,22 @@ class MetricsSys:
             enc = (self.encode_batches, self.encode_blocks, self.encode_device_ns)
 
         metric("minio_tpu_uptime_seconds", round(time.time() - self.start_time, 1),
-               help_="Server uptime.")
+               help_="Server uptime.", type_="gauge")
         metric("minio_tpu_s3_traffic_received_bytes", rx, help_="Total S3 bytes received.")
         metric("minio_tpu_s3_traffic_sent_bytes", tx, help_="Total S3 bytes sent.")
         lines.append("# HELP minio_tpu_http_requests_total HTTP requests by method/status.")
         lines.append("# TYPE minio_tpu_http_requests_total counter")
+        helped.add("minio_tpu_http_requests_total")
         for (method, status), n in sorted(http.items()):
             metric("minio_tpu_http_requests_total", n, {"method": method, "status": status})
         lines.append("# HELP minio_tpu_s3_requests_total S3 API calls.")
         lines.append("# TYPE minio_tpu_s3_requests_total counter")
+        helped.add("minio_tpu_s3_requests_total")
         for api, n in sorted(calls.items()):
             metric("minio_tpu_s3_requests_total", n, {"api": api})
         for api, n in sorted(errs.items()):
-            metric("minio_tpu_s3_requests_errors_total", n, {"api": api})
+            metric("minio_tpu_s3_requests_errors_total", n, {"api": api},
+                   help_="S3 API calls that returned an error.")
         for api, lat in self.api_latency.items():
             n, t = lat.stats()
             if n:
@@ -139,6 +162,8 @@ class MetricsSys:
                     "minio_tpu_s3_request_seconds_last_minute",
                     round(t / n, 6),
                     {"api": api},
+                    help_="Mean request latency over the trailing minute.",
+                    type_="gauge",
                 )
         lines.append(
             "# HELP minio_tpu_s3_request_duration_seconds Request duration distribution."
@@ -163,8 +188,14 @@ class MetricsSys:
             lines.append(f'minio_tpu_s3_request_duration_seconds_count{{api="{api}"}} {cum}')
         metric("minio_tpu_encode_batches_total", enc[0],
                help_="Device encode batches run.")
-        metric("minio_tpu_encode_blocks_total", enc[1])
-        metric("minio_tpu_encode_device_seconds_total", round(enc[2] / 1e9, 6))
+        metric("minio_tpu_encode_blocks_total", enc[1],
+               help_="Blocks encoded via record_encode.")
+        metric("minio_tpu_encode_device_seconds_total", round(enc[2] / 1e9, 6),
+               help_="Device encode wall time via record_encode.")
+
+        self._render_drives(metric)
+        self._render_codec(metric)
+        self._render_heal_scanner(metric)
 
         if self.layer is not None:
             total = free = 0
@@ -182,31 +213,248 @@ class MetricsSys:
                     except Exception:  # noqa: BLE001
                         offline += 1
             metric("minio_tpu_cluster_capacity_raw_total_bytes", total,
-                   help_="Total raw capacity.")
-            metric("minio_tpu_cluster_capacity_raw_free_bytes", free)
-            metric("minio_tpu_cluster_drives_online_total", online)
-            metric("minio_tpu_cluster_drives_offline_total", offline)
+                   help_="Total raw capacity.", type_="gauge")
+            metric("minio_tpu_cluster_capacity_raw_free_bytes", free,
+                   help_="Free raw capacity.", type_="gauge")
+            metric("minio_tpu_cluster_drives_online_total", online,
+                   help_="Online drives.", type_="gauge")
+            metric("minio_tpu_cluster_drives_offline_total", offline,
+                   help_="Offline drives.", type_="gauge")
 
         repl = self.replication
         if repl is not None:
             st = repl.stats
             metric("minio_tpu_replication_completed_total", st.completed,
                    help_="Replica operations completed.")
-            metric("minio_tpu_replication_failed_total", st.failed)
-            metric("minio_tpu_replication_sent_bytes", st.replicated_bytes)
-            metric("minio_tpu_replication_pending_total", repl.pending)
+            metric("minio_tpu_replication_failed_total", st.failed,
+                   help_="Replica operations failed.")
+            metric("minio_tpu_replication_sent_bytes", st.replicated_bytes,
+                   help_="Bytes replicated to targets.")
+            metric("minio_tpu_replication_pending_total", repl.pending,
+                   help_="Replica operations pending.", type_="gauge")
             for bucket, targets in repl.bandwidth.report().items():
                 for arn, row in targets.items():
                     labels = {"bucket": bucket, "arn": arn}
                     metric(
                         "minio_tpu_replication_link_limit_bytes_per_second",
                         row["limitInBytesPerSecond"], labels,
+                        help_="Configured replication bandwidth limit.",
+                        type_="gauge",
                     )
                     metric(
                         "minio_tpu_replication_link_bytes_per_second",
                         row["currentBandwidthInBytesPerSecond"], labels,
+                        help_="Observed replication bandwidth.",
+                        type_="gauge",
                     )
         return "\n".join(lines) + "\n"
+
+    # -- node series sections ------------------------------------------------
+
+    def _render_drives(self, metric) -> None:
+        """Per-drive per-API series from MeteredDrive EWMAs (the seed
+        collected these and never exported them)."""
+        if self.layer is None:
+            return
+        for p in self.layer.pools:
+            for d in p.disks:
+                lat_fn = getattr(d, "api_latencies", None)
+                ep_fn = getattr(d, "endpoint", None)
+                if lat_fn is None or ep_fn is None:
+                    continue
+                try:
+                    drive = ep_fn()
+                    rows = lat_fn()
+                except Exception:  # noqa: BLE001 - one bad drive, not the scrape
+                    continue
+                for api, row in rows.items():
+                    labels = {"drive": drive, "api": api}
+                    metric("minio_tpu_drive_latency_ms", row["ewma_ms"], labels,
+                           help_="Per-drive per-API latency EWMA.", type_="gauge")
+                    metric("minio_tpu_drive_calls_total", row["count"], labels,
+                           help_="Per-drive StorageAPI calls.")
+                    metric("minio_tpu_drive_errors_total", row["errors"], labels,
+                           help_="Per-drive StorageAPI call failures.")
+
+    def _render_codec(self, metric) -> None:
+        """Device/codec series: batch occupancy, queue depth, device-vs-host
+        routing, per-kernel wall time, and the device probe outcome."""
+        from .. import runtime
+        from ..object import codec as codec_mod
+
+        probe = runtime.probe_status()
+        metric(
+            "minio_tpu_device_probe_done", 1 if probe is not None else 0,
+            help_="1 once the bounded device-init probe has run.", type_="gauge",
+        )
+        if probe is not None:
+            metric(
+                "minio_tpu_device_probe_ok", 1 if probe.ok else 0,
+                {"platform": probe.platform or "none"},
+                help_="1 when the probe found a usable accelerator.",
+                type_="gauge",
+            )
+        codec = codec_mod._default  # read-only peek: a scrape must not install
+        stats_fn = getattr(codec, "stats", None)
+        if stats_fn is None:
+            return
+        st = stats_fn()
+        metric("minio_tpu_codec_blocks_encoded_total", st["blocks_encoded"],
+               help_="Blocks encoded on the device pipeline.")
+        metric("minio_tpu_codec_encode_batches_total", st["batches_run"],
+               help_="Device encode batches launched.")
+        metric("minio_tpu_codec_blocks_reconstructed_total", st["blocks_reconstructed"],
+               help_="Blocks rebuilt on the device pipeline.")
+        metric("minio_tpu_codec_recon_batches_total", st["recon_batches_run"],
+               help_="Device reconstruct batches launched.")
+        metric("minio_tpu_codec_digests_verified_total", st["digests_verified"],
+               help_="Chunks digest-verified on the device pipeline.")
+        metric("minio_tpu_codec_verify_batches_total", st["verify_batches_run"],
+               help_="Device verify batches launched.")
+        padded = st["blocks_padded"]
+        metric(
+            "minio_tpu_codec_batch_occupancy",
+            round(st["blocks_encoded"] / padded, 4) if padded else 0.0,
+            help_="Real blocks per padded device-batch slot (1.0 = no padding waste).",
+            type_="gauge",
+        )
+        for kind, key in (
+            ("encode", "host_fallback_blocks"),
+            ("reconstruct", "host_fallback_recon_blocks"),
+            ("digest", "host_fallback_digest_chunks"),
+        ):
+            metric("minio_tpu_codec_host_fallback_total", st[key], {"kind": kind},
+                   help_="Work routed to the host codec instead of the device.")
+        for kernel, key in (
+            ("encode", "device_encode_seconds"),
+            ("reconstruct", "device_recon_seconds"),
+            ("verify", "device_verify_seconds"),
+        ):
+            metric(
+                "minio_tpu_codec_device_seconds_total", round(st[key], 6),
+                {"kernel": kernel},
+                help_="Wall time inside device kernels.",
+            )
+        depths_fn = getattr(codec, "queue_depths", None)
+        if depths_fn is not None:
+            for geom, depth in sorted(depths_fn().items()):
+                metric("minio_tpu_codec_queue_depth", depth, {"geometry": geom},
+                       help_="Pending encode requests per batch worker.",
+                       type_="gauge")
+
+    def _render_heal_scanner(self, metric) -> None:
+        """Heal + scanner progress counters (healmgr/MRF/disk-heal/scanner)."""
+        mrf = self.mrf
+        if mrf is not None:
+            metric("minio_tpu_heal_mrf_healed_total", mrf.healed,
+                   help_="Objects healed from the MRF queue.")
+            metric("minio_tpu_heal_mrf_failed_total", mrf.failed,
+                   help_="MRF heal attempts that failed.")
+            metric("minio_tpu_heal_mrf_pending", mrf.pending(),
+                   help_="Objects queued for MRF heal.", type_="gauge")
+        hm = self.healmgr
+        if hm is not None:
+            seqs = list(getattr(hm, "sequences", {}).values())
+            metric("minio_tpu_heal_sequences_running",
+                   sum(1 for s in seqs if s.running),
+                   help_="Heal sequences currently running.", type_="gauge")
+            metric("minio_tpu_heal_objects_scanned_total",
+                   sum(s.scanned for s in seqs),
+                   help_="Objects scanned by heal sequences.")
+            metric("minio_tpu_heal_objects_healed_total",
+                   sum(s.healed for s in seqs),
+                   help_="Objects healed by heal sequences.")
+            metric("minio_tpu_heal_objects_failed_total",
+                   sum(s.failed for s in seqs),
+                   help_="Objects heal sequences failed to heal.")
+        dh = self.disk_heal
+        if dh is not None:
+            metric("minio_tpu_heal_drives_completed_total",
+                   len(getattr(dh, "completed", ())),
+                   help_="Fresh-drive heals completed since boot.")
+        sc = self.scanner
+        if sc is not None:
+            metric("minio_tpu_scanner_cycles_completed_total", sc.cycles_completed,
+                   help_="Data scanner full cycles completed.")
+            metric("minio_tpu_scanner_objects_healed_total", sc.objects_healed,
+                   help_="Objects queued for heal by the scanner.")
+            metric("minio_tpu_scanner_objects_expired_total", sc.objects_expired,
+                   help_="Objects expired by ILM rules.")
+            metric("minio_tpu_scanner_uploads_aborted_total", sc.uploads_aborted,
+                   help_="Stale multipart uploads aborted.")
+            metric("minio_tpu_scanner_objects_transitioned_total",
+                   sc.objects_transitioned,
+                   help_="Objects transitioned to a remote tier.")
+            usage = getattr(sc, "usage", None)
+            if usage is not None:
+                metric("minio_tpu_scanner_usage_last_update",
+                       round(getattr(usage, "last_update", 0.0), 3),
+                       help_="Unix time of the last usage snapshot.",
+                       type_="gauge")
+
+    # -- cluster view --------------------------------------------------------
+
+    def render_cluster(self) -> str:
+        """Own node text plus every reachable peer's, each sample labeled
+        server=<url> (the reference's /minio/v2/metrics/cluster role: one
+        scrape sees the whole deployment). Unreachable peers surface as
+        minio_tpu_node_scrape_ok 0 rather than silently vanishing."""
+        texts: list[tuple[str, str, bool]] = [
+            (self.node_url or "local", self.render_node(), True)
+        ]
+        notification = self.notification
+        if notification is not None:
+            for p in notification.peers:
+                try:
+                    texts.append((p.url, p.node_metrics(timeout=5.0), True))
+                except Exception:  # noqa: BLE001 - peer down is data, not an error
+                    texts.append((p.url, "", False))
+        return merge_node_texts(texts)
+
+
+def _label_sample(line: str, server: str) -> str:
+    """Prefix a sample line's label set with server="...". """
+    esc = server.replace("\\", "\\\\").replace('"', '\\"')
+    name_end = len(line)
+    for i, ch in enumerate(line):
+        if ch in ("{", " "):
+            name_end = i
+            break
+    name = line[:name_end]
+    rest = line[name_end:]
+    if rest.startswith("{"):
+        return f'{name}{{server="{esc}",{rest[1:]}'
+    return f'{name}{{server="{esc}"}}{rest}'
+
+
+def merge_node_texts(texts: list[tuple[str, str, bool]]) -> str:
+    """Merge per-node exposition texts: HELP/TYPE emitted once per series,
+    every sample labeled with its origin server."""
+    out: list[str] = []
+    seen_meta: set[str] = set()
+    for server, text, ok in texts:
+        esc = server.replace("\\", "\\\\").replace('"', '\\"')
+        if "minio_tpu_node_scrape_ok" not in seen_meta:
+            out.append(
+                "# HELP minio_tpu_node_scrape_ok 1 when the node's metrics were fetched."
+            )
+            out.append("# TYPE minio_tpu_node_scrape_ok gauge")
+            seen_meta.add("minio_tpu_node_scrape_ok")
+        out.append(f'minio_tpu_node_scrape_ok{{server="{esc}"}} {1 if ok else 0}')
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("#"):
+                # "# HELP <name> ..." / "# TYPE <name> ..." -- once per series.
+                parts = line.split(None, 3)
+                key = " ".join(parts[:3])
+                if key in seen_meta:
+                    continue
+                seen_meta.add(key)
+                out.append(line)
+            else:
+                out.append(_label_sample(line, server))
+    return "\n".join(out) + "\n"
 
 
 GLOBAL_METRICS = MetricsSys()

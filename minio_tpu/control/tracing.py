@@ -1,0 +1,252 @@
+"""Request-scoped distributed tracing: span trees over the pub/sub trace hub.
+
+Role of the reference's madmin trace verbosity levels (`mc admin trace -v`
+shows per-layer breakdowns: handler, object layer, storage calls per drive,
+internode hops). Here every S3 request gets a trace id (== its
+x-amz-request-id), each layer opens spans under the current one, and the
+finished spans are published to the SAME hub the admin /trace stream serves
+-- a subscriber reassembles the span tree of a request from its
+(trace, span, parent) ids.
+
+Context rules:
+  * The current span rides a contextvar, so it survives `asyncio.to_thread`
+    (which copies the caller's context) for free.
+  * Fan-out thread pools do NOT inherit contextvars -- the drive-IO pool in
+    object/metadata.py copies the caller's context per task explicitly.
+  * Remote hops carry `trace:span` in the X-Mtpu-Trace header
+    (dist/transport.py injects, dist/storage_rest.py + dist/peer.py adopt),
+    so a distributed PUT yields ONE tree across nodes.
+
+Overhead discipline matches pubsub.py: when nobody subscribes to the hub,
+`span()` returns a shared no-op and no ids are generated.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import secrets
+import time
+from typing import Iterator
+
+from .pubsub import GLOBAL_TRACE, TraceSys
+
+# Trace context header for internode REST (alongside X-Mtpu-Token).
+TRACE_HEADER = "X-Mtpu-Trace"
+
+_current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "minio_tpu_span", default=None
+)
+
+
+def _new_id() -> str:
+    return secrets.token_hex(8).upper()
+
+
+class Span:
+    """One timed unit of work. Publishes itself to the hub on close.
+
+    Usable as a context manager; `set(k=v)` attaches tags that ride the
+    published record (status codes, byte counts, batch sizes...).
+    """
+
+    __slots__ = (
+        "trace_id",
+        "span_id",
+        "parent_id",
+        "name",
+        "layer",
+        "sys",
+        "start",
+        "tags",
+        "_token",
+        "_closed",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        layer: str,
+        trace_id: str,
+        parent_id: str,
+        sys: TraceSys,
+        **tags,
+    ):
+        self.name = name
+        self.layer = layer
+        self.trace_id = trace_id
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.sys = sys
+        self.start = time.perf_counter()
+        self.tags = tags
+        self._token = None
+        self._closed = False
+
+    def set(self, **tags) -> None:
+        self.tags.update(tags)
+
+    def header(self) -> str:
+        """Wire form for X-Mtpu-Trace: children on the far side parent here."""
+        return f"{self.trace_id}:{self.span_id}"
+
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        self.finish(error=exc_type.__name__ if exc_type is not None else None)
+        return False
+
+    def finish(self, error: str | None = None) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        fields = dict(self.tags)
+        if error:
+            fields["error"] = error
+        self.sys.publish(
+            "span",
+            name=self.name,
+            layer=self.layer,
+            trace=self.trace_id,
+            span=self.span_id,
+            parent=self.parent_id,
+            duration_ms=round((time.perf_counter() - self.start) * 1e3, 3),
+            **fields,
+        )
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the nobody-watching fast path."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+    parent_id = ""
+
+    def set(self, **tags) -> None:
+        pass
+
+    def header(self) -> str:
+        return ""
+
+    def finish(self, error: str | None = None) -> None:
+        pass
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        return False
+
+
+NOOP = _NoopSpan()
+
+
+def current() -> Span | None:
+    """The active span of this context, or None outside any trace."""
+    return _current.get()
+
+
+def current_header() -> str:
+    """Wire value propagating the ACTIVE span, '' when not tracing."""
+    cur = _current.get()
+    return cur.header() if cur is not None else ""
+
+
+def span(name: str, layer: str, sys: TraceSys | None = None, **tags):
+    """Open a child span of the current context (or a fresh root).
+
+    Returns the shared no-op when the hub has no subscribers AND no trace
+    is active -- the zero-overhead publish guard, lifted to span granularity.
+    """
+    tsys = sys or GLOBAL_TRACE
+    parent = _current.get()
+    if parent is None and not tsys.enabled():
+        return NOOP
+    if parent is not None:
+        return Span(name, layer, parent.trace_id, parent.span_id, tsys, **tags)
+    return Span(name, layer, _new_id(), "", tsys, **tags)
+
+
+def root_span(name: str, layer: str, trace_id: str, sys: TraceSys | None = None, **tags):
+    """Open a request root span with an EXPLICIT trace id (the S3 entry point
+    uses the x-amz-request-id, so trace and audit records join on one key)."""
+    tsys = sys or GLOBAL_TRACE
+    if not tsys.enabled():
+        return NOOP
+    return Span(name, layer, trace_id, "", tsys, **tags)
+
+
+class _RemoteParent:
+    """Placeholder for a span living on the calling node: children opened on
+    this node chain under it, but it is never published here (the caller
+    publishes the real one)."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: str, span_id: str):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+class bind_header:
+    """Adopt a wire trace context for the current (coroutine) context.
+
+    Used by the internode REST servers around their to-thread dispatch:
+    `asyncio.to_thread` copies the coroutine's context, so spans opened by
+    the handler body parent under the remote caller's span.
+    """
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, header_value: str | None):
+        self._ctx = parse_header(header_value)
+        self._token = None
+
+    def __enter__(self) -> "bind_header":
+        if self._ctx is not None:
+            self._token = _current.set(self._ctx)  # type: ignore[arg-type]
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        return False
+
+
+def parse_header(value: str | None) -> _RemoteParent | None:
+    if not value or ":" not in value:
+        return None
+    trace_id, _, span_id = value.partition(":")
+    if not trace_id or not span_id:
+        return None
+    return _RemoteParent(trace_id, span_id)
+
+
+# -- tree assembly (admin tooling + tests) -----------------------------------
+
+
+def build_tree(records: list[dict], trace_id: str) -> dict[str, list[dict]]:
+    """Group one trace's span records into parent -> children adjacency.
+
+    Key '' holds the roots. Input records are hub dicts (type == 'span');
+    records of other traces/types are ignored.
+    """
+    tree: dict[str, list[dict]] = {}
+    for rec in records:
+        if rec.get("type") != "span" or rec.get("trace") != trace_id:
+            continue
+        tree.setdefault(rec.get("parent", ""), []).append(rec)
+    return tree
+
+
+def walk_tree(tree: dict[str, list[dict]], parent: str = "") -> Iterator[dict]:
+    """Depth-first iteration over an adjacency built by build_tree."""
+    for rec in tree.get(parent, ()):
+        yield rec
+        yield from walk_tree(tree, rec.get("span", ""))

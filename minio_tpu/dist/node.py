@@ -370,6 +370,14 @@ class Node:
         self.s3.trace = self.trace
         self.s3.logger = self.logger
         self.s3.notifier = self.notifier
+        # Metrics sources for the node exposition (drive series come through
+        # metrics.layer; these feed heal/scanner progress and cluster fan-out).
+        self.metrics.node_url = self.url
+        self.metrics.notification = self.notification
+        self.metrics.scanner = self.scanner
+        self.metrics.healmgr = self.healmgr
+        self.metrics.mrf = self.mrf
+        self.metrics.disk_heal = self.disk_heal
         # Rehydrate notification rules from persisted bucket metadata: the
         # notifier starts empty, and without this pass a restart silently
         # stops event delivery for every configured bucket until an

@@ -13,6 +13,7 @@ import time
 import msgpack
 from aiohttp import web
 
+from ..control import tracing
 from ..utils import errors
 from .transport import ERROR_HEADER, TOKEN_HEADER, RestClient
 
@@ -32,7 +33,8 @@ def make_peer_app(node, token: str) -> web.Application:
             body = await request.read()
             a = msgpack.unpackb(body, raw=False) if body else {}
             try:
-                result = await asyncio.to_thread(fn, a)
+                with tracing.bind_header(request.headers.get(tracing.TRACE_HEADER)):
+                    result = await asyncio.to_thread(fn, a)
                 return web.Response(
                     body=msgpack.packb(result, use_bin_type=True),
                     content_type="application/x-msgpack",
@@ -148,6 +150,14 @@ def make_peer_app(node, token: str) -> web.Application:
             return {}
         return repl.bandwidth.report(a.get("bucket", ""))
 
+    def h_node_metrics(a):
+        """This node's Prometheus exposition text; the serving node merges
+        peer texts into /minio/v2/metrics/cluster with a server label."""
+        metrics = getattr(node, "metrics", None)
+        if metrics is None:
+            return {"text": ""}
+        return {"text": metrics.render_node()}
+
     # Streaming endpoints: this node's live event / trace records as NDJSON
     # (peer-rest-server.go:985 role) -- the serving node merges these into
     # its watcher responses so `mc watch` / `mc admin trace` see the whole
@@ -186,6 +196,7 @@ def make_peer_app(node, token: str) -> web.Application:
         "profilestart": h_profile_start,
         "profilestop": h_profile_stop,
         "bandwidth": h_bandwidth,
+        "metrics": h_node_metrics,
     }.items():
         app.router.add_post(f"/{name}", handler(fn))
     app.router.add_post("/listen", h_listen_stream)
@@ -208,11 +219,17 @@ class PeerClient:
     def server_info(self) -> dict:
         return self.client.call("/serverinfo", {})
 
-    def reload_iam(self) -> None:
-        self.client.call("/reloadiam", {})
+    def reload_iam(self, timeout: float | None = None) -> None:
+        self.client.call("/reloadiam", {}, timeout=timeout)
 
-    def reload_bucket_meta(self, bucket: str = "") -> None:
-        self.client.call("/reloadbucketmeta", {"bucket": bucket})
+    def reload_bucket_meta(
+        self, bucket: str = "", timeout: float | None = None
+    ) -> None:
+        self.client.call("/reloadbucketmeta", {"bucket": bucket}, timeout=timeout)
+
+    def node_metrics(self, timeout: float | None = None) -> str:
+        r = self.client.call("/metrics", {}, timeout=timeout)
+        return r.get("text", "") if r else ""
 
     def top_locks(self) -> list:
         return self.client.call("/toplocks", {})
@@ -245,34 +262,40 @@ class NotificationSys:
     def __init__(self, peers: list[PeerClient]):
         self.peers = peers
 
+    # Peers whose health flag says offline still get ONE quick attempt
+    # with this timeout: the flag can be stale (transient blip already
+    # healed), and a skipped invalidation is a silent consistency hole.
+    OFFLINE_ATTEMPT_TIMEOUT = 2.0
+
     def _fanout(self, call) -> None:
-        """Best-effort broadcast: skip peers already marked offline (their
-        REST client tracks health — a blackholed peer would otherwise add
-        its full connect timeout to the CALLER's request latency) and run
-        the rest concurrently."""
-        live = [p for p in self.peers if p.client.is_online()]
-        if not live:
+        """Best-effort broadcast to EVERY peer. Peers believed online use
+        the endpoint's tuned timeout; peers marked offline are still tried
+        with a short one so a stale is_online() flag can't drop the
+        invalidation, while a genuinely dead peer costs at most ~2s of a
+        concurrent worker, not the caller's whole request."""
+        if not self.peers:
             return
 
         def one(p):
+            timeout = None if p.client.is_online() else self.OFFLINE_ATTEMPT_TIMEOUT
             try:
-                call(p)
+                call(p, timeout)
             except errors.StorageError:
                 pass
 
-        if len(live) == 1:
-            one(live[0])
+        if len(self.peers) == 1:
+            one(self.peers[0])
             return
         from concurrent.futures import ThreadPoolExecutor
 
-        with ThreadPoolExecutor(max_workers=min(8, len(live))) as pool:
-            list(pool.map(one, live))
+        with ThreadPoolExecutor(max_workers=min(8, len(self.peers))) as pool:
+            list(pool.map(one, self.peers))
 
     def reload_iam_all(self) -> None:
-        self._fanout(lambda p: p.reload_iam())
+        self._fanout(lambda p, t: p.reload_iam(timeout=t))
 
     def reload_bucket_meta_all(self, bucket: str = "") -> None:
-        self._fanout(lambda p: p.reload_bucket_meta(bucket))
+        self._fanout(lambda p, t: p.reload_bucket_meta(bucket, timeout=t))
 
     def server_info_all(self) -> list[dict]:
         out = []

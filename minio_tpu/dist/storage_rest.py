@@ -21,6 +21,7 @@ from ..storage.interface import StorageAPI
 from ..storage.local import LocalDrive
 from ..storage.types import DiskInfo, FileInfo, VolInfo
 from ..storage.xlmeta import XLMeta
+from ..control import tracing
 from ..utils import errors
 from .transport import ERROR_HEADER, TOKEN_HEADER, RestClient, error_to_name, name_to_error
 
@@ -69,7 +70,10 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
             try:
                 drive = get_drive(request)
                 body = await request.read()
-                result = await asyncio.to_thread(fn, drive, request, body)
+                # Adopt the caller's trace context: to_thread copies this
+                # coroutine's context, so drive spans parent under the hop.
+                with tracing.bind_header(request.headers.get(tracing.TRACE_HEADER)):
+                    result = await asyncio.to_thread(fn, drive, request, body)
                 if isinstance(result, bytes):
                     return web.Response(body=result)
                 return web.Response(
@@ -197,12 +201,14 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
         def next_batch(it):
             return list(itertools.islice(it, 256))
 
+        binder = tracing.bind_header(request.headers.get(tracing.TRACE_HEADER))
         try:
             drive = get_drive(request)
             body = await request.read()
             a = args(request, body)
-            it = drive.walk_dir(a["volume"], a.get("base", ""), bool(a.get("recursive", True)))
-            first = await asyncio.to_thread(next_batch, it)
+            with binder:
+                it = drive.walk_dir(a["volume"], a.get("base", ""), bool(a.get("recursive", True)))
+                first = await asyncio.to_thread(next_batch, it)
         except web.HTTPException:
             raise
         except Exception as e:  # noqa: BLE001 - typed error transport
@@ -219,7 +225,8 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
                 )
                 if len(batch) < 256:
                     break
-                batch = await asyncio.to_thread(next_batch, it)
+                with binder:
+                    batch = await asyncio.to_thread(next_batch, it)
         except (ConnectionError, asyncio.CancelledError):
             raise  # client went away: nothing to tell it
         except Exception as e:  # noqa: BLE001

@@ -17,10 +17,12 @@ import time
 import msgpack
 import requests
 
+from ..control import tracing
 from ..utils import errors
 
 ERROR_HEADER = "X-Mtpu-Error"
 TOKEN_HEADER = "X-Mtpu-Token"
+TRACE_HEADER = tracing.TRACE_HEADER
 
 
 def cluster_token(secret: str) -> str:
@@ -158,6 +160,10 @@ class RestClient:
         effective = timeout if timeout is not None else (
             self.timeout if stream else dt.timeout()
         )
+        # The hop is a span of the caller's trace; its id rides the trace
+        # header so spans opened on the far side chain under this hop.
+        rpc = tracing.span(f"rpc{path}", "rpc", peer=self.base_url)
+        trace_hdr = rpc.header()
         t0 = time.monotonic()
         try:
             if body is not None:
@@ -165,19 +171,24 @@ class RestClient:
                     url,
                     params={k: str(v) for k, v in (args or {}).items()},
                     data=body,
+                    headers={TRACE_HEADER: trace_hdr} if trace_hdr else None,
                     timeout=effective,
                     stream=stream,
                 )
             else:
+                headers = {"Content-Type": "application/x-msgpack"}
+                if trace_hdr:
+                    headers[TRACE_HEADER] = trace_hdr
                 r = self.session.post(
                     url,
                     data=msgpack.packb(args or {}, use_bin_type=True),
-                    headers={"Content-Type": "application/x-msgpack"},
+                    headers=headers,
                     timeout=effective,
                     stream=stream,
                 )
         except requests.RequestException as e:
             self._mark(False)
+            rpc.finish(error=type(e).__name__)
             # Only READ timeouts are evidence the timeout is too small; a
             # down peer (connection-refused = ConnectionError, blackholed =
             # ConnectTimeout) says nothing about sizing and must not
@@ -189,6 +200,8 @@ class RestClient:
             ):
                 dt.log_failure()
             raise errors.DiskNotFound(f"{url}: {e}")
+        rpc.set(status=r.status_code)
+        rpc.finish()
         self._mark(True)
         if dt is not None:
             dt.log_success(time.monotonic() - t0)

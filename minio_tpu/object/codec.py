@@ -21,6 +21,7 @@ import abc
 
 import numpy as np
 
+from ..control import tracing
 from ..ops import highwayhash as hh
 from ..ops import rs_matrix, rs_ref
 
@@ -123,17 +124,20 @@ class HostCodec(BlockCodec):
         return hh.hash256_batch(shards)
 
     def encode(self, blocks, k, m):
-        out = []
-        for block in blocks:
-            shards = self._encode_one(_split_block(block, k), m)  # [K+M, S]
-            digests = self._digests(shards)
-            out.append(
-                (
-                    [shards[i].tobytes() for i in range(k + m)],
-                    [digests[i].tobytes() for i in range(k + m)],
+        with tracing.span(
+            "erasure.encode", "erasure", blocks=len(blocks), k=k, m=m, host=True
+        ):
+            out = []
+            for block in blocks:
+                shards = self._encode_one(_split_block(block, k), m)  # [K+M, S]
+                digests = self._digests(shards)
+                out.append(
+                    (
+                        [shards[i].tobytes() for i in range(k + m)],
+                        [digests[i].tobytes() for i in range(k + m)],
+                    )
                 )
-            )
-        return out
+            return out
 
     def encode_frames(self, blocks, k, m):
         """Uniform block groups: split + parity are written straight into one
@@ -150,15 +154,18 @@ class HostCodec(BlockCodec):
             or len(blocks[0]) == 0  # split() rejects empty -- keep paths identical
         ):
             return super().encode_frames(blocks, k, m)
-        pm = np.ascontiguousarray(rs_matrix.parity_matrix(k, m))
-        s = rs_matrix.shard_size(len(blocks[0]), k)
-        stacked = np.empty((len(blocks), k + m, s), dtype=np.uint8)
-        for i, block in enumerate(blocks):
-            flat = stacked[i, :k].reshape(-1)
-            flat[: len(block)] = np.frombuffer(block, dtype=np.uint8)
-            flat[len(block):] = 0  # zero-pad the tail shard (Split semantics)
-            self._native.rs_encode(stacked[i, :k], pm, out=stacked[i, k:])
-        return self._native.hh256_frame_rows(stacked, hh.MAGIC_KEY)
+        with tracing.span(
+            "erasure.encode_frames", "erasure", blocks=len(blocks), k=k, m=m, host=True
+        ):
+            pm = np.ascontiguousarray(rs_matrix.parity_matrix(k, m))
+            s = rs_matrix.shard_size(len(blocks[0]), k)
+            stacked = np.empty((len(blocks), k + m, s), dtype=np.uint8)
+            for i, block in enumerate(blocks):
+                flat = stacked[i, :k].reshape(-1)
+                flat[: len(block)] = np.frombuffer(block, dtype=np.uint8)
+                flat[len(block):] = 0  # zero-pad the tail shard (Split semantics)
+                self._native.rs_encode(stacked[i, :k], pm, out=stacked[i, k:])
+            return self._native.hh256_frame_rows(stacked, hh.MAGIC_KEY)
 
     def reconstruct(self, shards, k, m, want):
         arrs: list[np.ndarray | None] = [
@@ -182,6 +189,14 @@ class HostCodec(BlockCodec):
         plan = uniform_recon_plan(rows_batch, k) if len(rows_batch) > 1 else None
         if plan is None or self._native is None:
             return super().reconstruct_batch(rows_batch, k, m, want, with_digests)
+        with tracing.span(
+            "erasure.reconstruct", "erasure", blocks=len(rows_batch), k=k, m=m, host=True
+        ):
+            return self._reconstruct_batch_slab(
+                rows_batch, k, m, want, with_digests, plan
+            )
+
+    def _reconstruct_batch_slab(self, rows_batch, k, m, want, with_digests, plan):
         present, surv, s = plan
         b = len(rows_batch)
         survivors = np.empty((k, b * s), dtype=np.uint8)

@@ -25,6 +25,7 @@ import time
 import uuid
 from typing import Iterator
 
+from ..control import tracing
 from ..ops import bitrot as bitrot_mod
 from ..storage.interface import StorageAPI
 from ..storage.types import ErasureInfo, FileInfo, ObjectPartInfo, now
@@ -581,15 +582,21 @@ class ErasureObjects:
 
         reader = _as_reader(data)
         head = _read_full(reader, SMALL_FILE_THRESHOLD)
-        # Whole-file bitrot objects always take the streaming (shard-file)
-        # path: the legacy layout has no inline representation.
-        if len(head) < SMALL_FILE_THRESHOLD and not wants_whole:
-            return self._put_inline(
-                bucket, object_name, head, opts, k, m, distribution, version_id, mod_time
-            )
-        return self._put_streaming(
-            bucket, object_name, reader, head, opts, k, m, distribution, version_id, mod_time
-        )
+        with tracing.span(
+            "object.PutObject", "object", bucket=bucket, object=object_name
+        ) as sp:
+            # Whole-file bitrot objects always take the streaming (shard-file)
+            # path: the legacy layout has no inline representation.
+            if len(head) < SMALL_FILE_THRESHOLD and not wants_whole:
+                oi = self._put_inline(
+                    bucket, object_name, head, opts, k, m, distribution, version_id, mod_time
+                )
+            else:
+                oi = self._put_streaming(
+                    bucket, object_name, reader, head, opts, k, m, distribution, version_id, mod_time
+                )
+            sp.set(size=oi.size)
+            return oi
 
     def _make_put_fi(
         self,
@@ -911,7 +918,12 @@ class ErasureObjects:
         erasure-decode.go:31-202). Memory is O(GROUP_BLOCKS x BLOCK_SIZE)."""
         opts = opts or GetObjectOptions()
         self._check_bucket(bucket)
-        fi, metas, disks = self._read_quorum_fi(bucket, object_name, opts.version_id)
+        # The object span covers the quorum metadata read; per-drive shard
+        # reads during streaming publish storage spans as the body flows.
+        with tracing.span(
+            "object.GetObject", "object", bucket=bucket, object=object_name
+        ):
+            fi, metas, disks = self._read_quorum_fi(bucket, object_name, opts.version_id)
         if fi.deleted:
             raise (
                 errors.MethodNotAllowed(bucket, object_name)
@@ -1321,6 +1333,14 @@ class ErasureObjects:
     def delete_object(
         self, bucket: str, object_name: str, opts: DeleteObjectOptions | None = None
     ) -> ObjectInfo:
+        with tracing.span(
+            "object.DeleteObject", "object", bucket=bucket, object=object_name
+        ):
+            return self._delete_object(bucket, object_name, opts)
+
+    def _delete_object(
+        self, bucket: str, object_name: str, opts: DeleteObjectOptions | None = None
+    ) -> ObjectInfo:
         opts = opts or DeleteObjectOptions()
         self._check_bucket(bucket)
         disks = self._online()
@@ -1385,6 +1405,14 @@ class ErasureObjects:
     ) -> HealResultItem:
         """Reconstruct missing/corrupt shards onto stale drives
         (cmd/erasure-healing.go:257 healObject equivalent)."""
+        with tracing.span(
+            "object.HealObject", "object", bucket=bucket, object=object_name
+        ):
+            return self._heal_object(bucket, object_name, version_id, dry_run)
+
+    def _heal_object(
+        self, bucket: str, object_name: str, version_id: str = "", dry_run: bool = False
+    ) -> HealResultItem:
         disks = self._online()
         metas, errs = meta_mod.read_all_file_info(disks, bucket, object_name, version_id)
         read_quorum, _ = meta_mod.object_quorum_from_meta(metas, errs, self.parity)

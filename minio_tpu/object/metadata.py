@@ -8,6 +8,7 @@ decide per-drive freshness for healing.
 
 from __future__ import annotations
 
+import contextvars
 from concurrent.futures import ThreadPoolExecutor
 
 from ..storage.interface import StorageAPI
@@ -20,10 +21,16 @@ _POOL = ThreadPoolExecutor(max_workers=64, thread_name_prefix="drive-io")
 
 
 def parallel_map(fn, items):
-    """Run fn over items concurrently; return ordered [(result, error)]."""
+    """Run fn over items concurrently; return ordered [(result, error)].
+
+    Each task runs under a copy of the CALLER's contextvars (pool threads
+    don't inherit them), so the request trace context follows the fan-out
+    into per-drive storage calls."""
+    ctx = contextvars.copy_context()
+
     def wrap(item):
         try:
-            return fn(item), None
+            return ctx.copy().run(fn, item), None
         except Exception as e:  # noqa: BLE001 - error values are the contract
             return None, e
 
@@ -34,10 +41,11 @@ def parallel_submit(fn, items):
     """Like parallel_map but returns futures of (result, error) immediately
     — the read-ahead primitive (klauspost/readahead's role: issue the next
     window's drive reads while the current one decodes)."""
+    ctx = contextvars.copy_context()
 
     def wrap(item):
         try:
-            return fn(item), None
+            return ctx.copy().run(fn, item), None
         except Exception as e:  # noqa: BLE001
             return None, e
 

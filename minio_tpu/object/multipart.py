@@ -19,6 +19,7 @@ import hashlib
 import json
 import uuid
 
+from ..control import tracing
 from ..storage.types import ErasureInfo, FileInfo, ObjectPartInfo, now
 from ..utils import errors
 from ..utils.hashes import hash_order
@@ -93,6 +94,17 @@ class MultipartManager:
     # -- parts ---------------------------------------------------------------
 
     def put_object_part(
+        self, bucket: str, object_name: str, upload_id: str, part_number: int, data
+    ) -> ObjectPartInfo:
+        with tracing.span(
+            "object.PutObjectPart", "object",
+            bucket=bucket, object=object_name, part=part_number,
+        ):
+            return self._put_object_part(
+                bucket, object_name, upload_id, part_number, data
+            )
+
+    def _put_object_part(
         self, bucket: str, object_name: str, upload_id: str, part_number: int, data
     ) -> ObjectPartInfo:
         """Streaming part upload: `data` is bytes or a .read(n) stream.
@@ -217,6 +229,17 @@ class MultipartManager:
     # -- complete / abort ----------------------------------------------------
 
     def complete_multipart_upload(
+        self, bucket: str, object_name: str, upload_id: str, parts: list[tuple[int, str]]
+    ) -> ObjectInfo:
+        with tracing.span(
+            "object.CompleteMultipartUpload", "object",
+            bucket=bucket, object=object_name, parts=len(parts),
+        ):
+            return self._complete_multipart_upload(
+                bucket, object_name, upload_id, parts
+            )
+
+    def _complete_multipart_upload(
         self, bucket: str, object_name: str, upload_id: str, parts: list[tuple[int, str]]
     ) -> ObjectInfo:
         meta_doc = self._upload_meta(bucket, object_name, upload_id)

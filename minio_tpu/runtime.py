@@ -96,6 +96,14 @@ def probe_device(timeout_s: float, use_cache: bool = True) -> ProbeResult:
         return _probe_uncached(timeout_s)
 
 
+def probe_status() -> ProbeResult | None:
+    """The cached probe outcome WITHOUT triggering a probe (metrics reads
+    this: a scrape must never fork a device-init subprocess). None until a
+    probe has run."""
+    with _probe_lock:
+        return _probe_cache
+
+
 def _probe_uncached(timeout_s: float) -> ProbeResult:
     global _probe_cache
     out_f = tempfile.TemporaryFile(mode="w+b")

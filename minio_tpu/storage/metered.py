@@ -56,12 +56,32 @@ class MeteredDrive:
                 self._counts[name] = self._counts.get(name, 0) + 1
             trace = self.trace
             if trace is not None and trace.enabled():
-                trace.publish(
-                    "storage",
-                    call=name,
-                    drive=self.inner.endpoint(),
-                    duration_ms=round(ms, 3),
-                )
+                from ..control import tracing
+
+                # When a request trace is active, the storage call is a span
+                # in its tree (per-drive children of the object-layer span);
+                # otherwise it stays a flat storage record.
+                cur = tracing.current()
+                if cur is not None:
+                    trace.publish(
+                        "span",
+                        name=f"storage.{name}",
+                        layer="storage",
+                        trace=cur.trace_id,
+                        span=tracing._new_id(),
+                        parent=cur.span_id,
+                        call=name,
+                        drive=self.inner.endpoint(),
+                        duration_ms=round(ms, 3),
+                        error=failed or None,
+                    )
+                else:
+                    trace.publish(
+                        "storage",
+                        call=name,
+                        drive=self.inner.endpoint(),
+                        duration_ms=round(ms, 3),
+                    )
 
         if inspect.isgeneratorfunction(getattr(type(self.inner), name, None)):
             # Generators (walk_dir): time the FULL iteration and count errors

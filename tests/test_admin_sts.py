@@ -175,7 +175,12 @@ class TestAdmin:
 
         r = requests.get(srv["url"] + "/minio/v2/metrics/cluster")
         assert r.status_code == 200
-        assert "minio_tpu_cluster_drives_online_total 4" in r.text
+        # Cluster view stamps every sample with the reporting node.
+        import re
+
+        assert re.search(
+            r'minio_tpu_cluster_drives_online_total\{server="[^"]*"\} 4\b', r.text
+        ), r.text[:500]
 
     def test_trace_stream(self, srv):
         c = srv["client"]

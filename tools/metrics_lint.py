@@ -1,0 +1,239 @@
+#!/usr/bin/env python3
+"""Prometheus exposition-format validator + lint for the minio_tpu metrics.
+
+Pure stdlib on purpose: the tier-1 suite runs this over /minio/v2/metrics/node
+and /minio/v2/metrics/cluster output so the hand-rendered exposition in
+control/metrics.py cannot silently regress (a scrape that Prometheus rejects
+is observability that does not exist).
+
+Checks (validate_exposition):
+  * every line parses as a comment, HELP, TYPE, or `name[{labels}] value`
+  * HELP/TYPE pairing: a family with HELP also declares TYPE (and vice
+    versa), each at most once, before the family's first sample
+  * no duplicate samples (same name + identical label set)
+  * histograms: bucket counts are monotone over increasing `le`, the +Inf
+    bucket exists and equals `_count`, and `_sum` is present
+
+Lints (lint_exposition):
+  * duplicate series (a family declared or emitted under two TYPE lines)
+  * unlabeled high-cardinality families: more samples than `max_series`
+    with at least one unlabeled sample -- per-entity series must carry the
+    entity as a label, not explode the name space
+
+Usage:
+    python tools/metrics_lint.py FILE [FILE...]   # or - for stdin
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r"\s+(?P<value>[^\s]+)(?:\s+\d+)?$"
+)
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+# A histogram/summary sample's family is its name minus these suffixes.
+_FAMILY_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _family(name: str) -> str:
+    for suf in _FAMILY_SUFFIXES:
+        if name.endswith(suf):
+            return name[: -len(suf)]
+    return name
+
+
+def _parse_value(raw: str) -> float | None:
+    if raw in ("+Inf", "Inf"):
+        return float("inf")
+    if raw == "-Inf":
+        return float("-inf")
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def parse_samples(text: str):
+    """Yield (lineno, name, labels: dict, value: float) for sample lines."""
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            continue
+        labels = dict(_LABEL_RE.findall(m.group("labels") or ""))
+        value = _parse_value(m.group("value"))
+        if value is None:
+            continue
+        yield i, m.group("name"), labels, value
+
+
+def validate_exposition(text: str) -> list[str]:
+    """Return a list of format problems; empty means valid."""
+    problems: list[str] = []
+    help_names: dict[str, int] = {}
+    type_names: dict[str, str] = {}
+    samples_seen: dict[tuple[str, tuple[tuple[str, str], ...]], int] = {}
+    family_started: set[str] = set()
+
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            if len(parts) < 3 or not _NAME_RE.match(parts[2]):
+                problems.append(f"line {i}: malformed HELP: {line!r}")
+                continue
+            name = parts[2]
+            if name in help_names:
+                problems.append(f"line {i}: duplicate HELP for {name}")
+            if name in family_started:
+                problems.append(f"line {i}: HELP for {name} after its samples")
+            help_names[name] = i
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 4)
+            if len(parts) < 4 or not _NAME_RE.match(parts[2]):
+                problems.append(f"line {i}: malformed TYPE: {line!r}")
+                continue
+            name = parts[2]
+            if name in type_names:
+                problems.append(f"line {i}: duplicate TYPE for {name}")
+            if name in family_started:
+                problems.append(f"line {i}: TYPE for {name} after its samples")
+            if parts[3] not in ("counter", "gauge", "histogram", "summary", "untyped"):
+                problems.append(f"line {i}: unknown TYPE {parts[3]!r} for {name}")
+            type_names[name] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # free-form comment
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            problems.append(f"line {i}: unparseable sample: {line!r}")
+            continue
+        name = m.group("name")
+        if _parse_value(m.group("value")) is None:
+            problems.append(f"line {i}: bad value in: {line!r}")
+            continue
+        labels = tuple(sorted(_LABEL_RE.findall(m.group("labels") or "")))
+        raw_labels = m.group("labels")
+        if raw_labels and _LABEL_RE.sub("", raw_labels).strip(", ") != "":
+            problems.append(f"line {i}: malformed label set: {line!r}")
+        family_started.add(_family(name))
+        key = (name, labels)
+        if key in samples_seen:
+            problems.append(
+                f"line {i}: duplicate sample {name}{dict(labels)} "
+                f"(first at line {samples_seen[key]})"
+            )
+        else:
+            samples_seen[key] = i
+
+    # HELP <-> TYPE pairing.
+    for name in help_names:
+        if name not in type_names:
+            problems.append(f"{name}: HELP without TYPE")
+    for name in type_names:
+        if name not in help_names:
+            problems.append(f"{name}: TYPE without HELP")
+
+    problems.extend(_check_histograms(text, type_names))
+    return problems
+
+
+def _check_histograms(text: str, type_names: dict[str, str]) -> list[str]:
+    problems: list[str] = []
+    hist_families = {n for n, t in type_names.items() if t == "histogram"}
+    # group: family -> series-labels-without-le -> {le: value}, _sum, _count
+    buckets: dict[tuple[str, tuple], dict[float, float]] = {}
+    sums: dict[tuple[str, tuple], float] = {}
+    counts: dict[tuple[str, tuple], float] = {}
+    for _, name, labels, value in parse_samples(text):
+        fam = _family(name)
+        if fam not in hist_families:
+            continue
+        base = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        if name.endswith("_bucket"):
+            le = _parse_value(labels.get("le", ""))
+            if le is None:
+                problems.append(f"{fam}{dict(base)}: bucket without numeric le")
+                continue
+            buckets.setdefault((fam, base), {})[le] = value
+        elif name.endswith("_sum"):
+            sums[(fam, base)] = value
+        elif name.endswith("_count"):
+            counts[(fam, base)] = value
+    for key, series in buckets.items():
+        fam, base = key
+        ordered = sorted(series.items())
+        values = [v for _, v in ordered]
+        if any(b > a for a, b in zip(values[1:], values)):
+            problems.append(f"{fam}{dict(base)}: bucket counts not monotone")
+        if float("inf") not in series:
+            problems.append(f"{fam}{dict(base)}: missing +Inf bucket")
+        elif key in counts and counts[key] != series[float("inf")]:
+            problems.append(
+                f"{fam}{dict(base)}: _count {counts[key]} != +Inf bucket "
+                f"{series[float('inf')]}"
+            )
+        if key not in sums:
+            problems.append(f"{fam}{dict(base)}: missing _sum")
+        if key not in counts:
+            problems.append(f"{fam}{dict(base)}: missing _count")
+    return problems
+
+
+def lint_exposition(text: str, max_series: int = 200) -> list[str]:
+    """Style lints beyond format validity; empty means clean."""
+    problems: list[str] = []
+    fam_samples: dict[str, int] = {}
+    fam_unlabeled: dict[str, int] = {}
+    for _, name, labels, _v in parse_samples(text):
+        fam = _family(name)
+        fam_samples[fam] = fam_samples.get(fam, 0) + 1
+        if not labels:
+            fam_unlabeled[fam] = fam_unlabeled.get(fam, 0) + 1
+    for fam, n in sorted(fam_samples.items()):
+        if n > max_series and fam_unlabeled.get(fam):
+            problems.append(
+                f"{fam}: {n} series with {fam_unlabeled[fam]} unlabeled samples "
+                f"-- high-cardinality metrics must carry the entity as a label"
+            )
+    # Families whose samples appear in two disjoint runs separated by another
+    # family's TYPE line usually indicate a name collision between sections.
+    type_lines: dict[str, int] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) >= 3:
+                if parts[2] in type_lines:
+                    problems.append(
+                        f"{parts[2]}: declared twice (lines {type_lines[parts[2]]} "
+                        f"and {i}) -- duplicate series name"
+                    )
+                else:
+                    type_lines[parts[2]] = i
+    return problems
+
+
+def main(argv: list[str]) -> int:
+    paths = argv or ["-"]
+    rc = 0
+    for path in paths:
+        text = sys.stdin.read() if path == "-" else open(path).read()
+        problems = validate_exposition(text) + lint_exposition(text)
+        for p in problems:
+            print(f"{path}: {p}")
+        if problems:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
