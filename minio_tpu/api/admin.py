@@ -453,6 +453,48 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             "failed": st.failed,
         }
 
+    # -- chaos (fault injection; minio_tpu/chaos/) ---------------------------
+    # POST arms a fault (body = FaultSpec JSON + optional "cluster": false),
+    # GET lists armed faults per node, DELETE disarms one (?fault-id=) or
+    # all. Arm/disarm apply locally first, then fan out to every peer so one
+    # admin call breaks (and un-breaks) the whole cluster deterministically.
+
+    def _chaos_registry():
+        from ..chaos.faults import REGISTRY
+
+        return REGISTRY
+
+    def h_chaos_arm(request, body):
+        from ..chaos.faults import FaultSpec
+
+        doc = json.loads(body) if body else {}
+        cluster = bool(doc.pop("cluster", True))
+        try:
+            spec = FaultSpec.from_dict(doc)
+        except (ValueError, TypeError) as e:
+            raise S3Error("InvalidArgument", str(e))
+        fid = _chaos_registry().arm(spec)
+        if cluster and ctx.notification is not None:
+            ctx.notification.chaos_all("arm", spec={**spec.to_dict(), "fault_id": fid})
+        return {"fault_id": fid}
+
+    def h_chaos_list(request, body):
+        out = {"local": _chaos_registry().list()}
+        for peer in _peer_clients():
+            try:
+                out[peer.url] = peer.chaos("list").get("faults", [])
+            except oerr.StorageError:
+                out[peer.url] = None  # unreachable peer is data, not a 500
+        return out
+
+    def h_chaos_disarm(request, body):
+        fid = request.rel_url.query.get("fault-id", "")
+        reg = _chaos_registry()
+        removed = int(reg.disarm(fid)) if fid else reg.disarm_all()
+        if request.rel_url.query.get("cluster", "") != "false" and ctx.notification is not None:
+            ctx.notification.chaos_all("disarm", fault_id=fid)
+        return {"removed": removed}
+
     # -- locks / service -----------------------------------------------------
 
     def h_top_locks(request, body):
@@ -837,6 +879,9 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_put("/policies/{name}", handler(h_put_policy))
     app.router.add_delete("/policies/{name}", handler(h_delete_policy))
     app.router.add_post("/service-accounts", handler(h_service_account))
+    app.router.add_post("/chaos", handler(h_chaos_arm))
+    app.router.add_get("/chaos", handler(h_chaos_list))
+    app.router.add_delete("/chaos", handler(h_chaos_disarm))
     app.router.add_post("/heal", handler(h_heal_start))
     app.router.add_get("/heal/{seq}", handler(h_heal_status))
     app.router.add_get("/toplocks", handler(h_top_locks))

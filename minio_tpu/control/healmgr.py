@@ -13,6 +13,7 @@ Role of the reference's heal trio (SURVEY.md section 2.7 Healing):
 from __future__ import annotations
 
 import json
+import logging
 import queue
 import threading
 import time
@@ -23,6 +24,8 @@ from ..storage.format import SYS_DIR
 from ..utils import errors
 
 HEALING_FILE = "healing.bin"
+
+log = logging.getLogger("minio_tpu.heal")
 
 
 @dataclass
@@ -36,20 +39,47 @@ class MRFEntry:
 class MRFQueue:
     """Async repair queue for partially-failed writes."""
 
-    def __init__(self, layer, maxsize: int = 100_000):
+    def __init__(self, layer, maxsize: int = 100_000, start: bool = True):
         self.layer = layer
+        self.maxsize = maxsize
         self.q: queue.Queue[MRFEntry] = queue.Queue(maxsize=maxsize)
         self.healed = 0
         self.failed = 0
+        self.dropped = 0  # exported as minio_tpu_heal_mrf_dropped_total
+        self._overflowing = False
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True, name="mrf-heal")
-        self._thread.start()
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="mrf-heal"
+            )
+            self._thread.start()
 
     def add(self, bucket: str, object_name: str, version_id: str = "") -> None:
         try:
             self.q.put_nowait(MRFEntry(bucket, object_name, version_id))
         except queue.Full:
-            pass  # the scanner sweep will find it later
+            # The scanner sweep will find it later, but a silent drop hides
+            # a saturated repair plane: count every one and log once per
+            # overflow EPISODE (first drop after a successful enqueue), not
+            # once per drop -- a wedged healer would otherwise spam the log.
+            self.dropped += 1
+            if not self._overflowing:
+                self._overflowing = True
+                log.warning(
+                    "MRF queue full (%d entries); dropping heal request for "
+                    "%s/%s (scanner sweep will re-find dropped objects)",
+                    self.maxsize, bucket, object_name,
+                )
+        else:
+            self._overflowing = False
+
+    def _heal_one(self, entry: MRFEntry) -> None:
+        try:
+            self.layer.heal_object(entry.bucket, entry.object_name, entry.version_id)
+            self.healed += 1
+        except errors.StorageError:
+            self.failed += 1
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -57,11 +87,20 @@ class MRFQueue:
                 entry = self.q.get(timeout=0.5)
             except queue.Empty:
                 continue
+            self._heal_one(entry)
+
+    def drain(self, limit: int | None = None) -> int:
+        """Synchronously heal queued entries (tests + shutdown path); returns
+        the number of entries processed."""
+        n = 0
+        while limit is None or n < limit:
             try:
-                self.layer.heal_object(entry.bucket, entry.object_name, entry.version_id)
-                self.healed += 1
-            except errors.StorageError:
-                self.failed += 1
+                entry = self.q.get_nowait()
+            except queue.Empty:
+                break
+            self._heal_one(entry)
+            n += 1
+        return n
 
     def stop(self) -> None:
         self._stop.set()
@@ -320,6 +359,17 @@ class DiskHealMonitor:
             except errors.StorageError:
                 pass
             for name, version_ids in self._iter_set_versions(eo, disk, bucket):
+                if self._stop.is_set():
+                    # stop() mid-sweep: persist the cursor NOW so a restart
+                    # resumes from this object instead of rescanning the
+                    # whole namespace (a large-drive heal can take hours;
+                    # losing the cursor on every rolling restart means the
+                    # heal never converges).
+                    try:
+                        tracker.save(disk)
+                    except errors.StorageError:
+                        pass
+                    return
                 if (
                     bucket == tracker.resume_bucket
                     and tracker.resume_object

@@ -196,6 +196,7 @@ class MetricsSys:
         self._render_drives(metric)
         self._render_codec(metric)
         self._render_heal_scanner(metric)
+        self._render_chaos(metric)
 
         if self.layer is not None:
             total = free = 0
@@ -352,6 +353,9 @@ class MetricsSys:
                    help_="MRF heal attempts that failed.")
             metric("minio_tpu_heal_mrf_pending", mrf.pending(),
                    help_="Objects queued for MRF heal.", type_="gauge")
+            metric("minio_tpu_heal_mrf_dropped_total", getattr(mrf, "dropped", 0),
+                   help_="Heal requests dropped because the MRF queue was full "
+                         "(the scanner sweep must find these later).")
         hm = self.healmgr
         if hm is not None:
             seqs = list(getattr(hm, "sequences", {}).values())
@@ -391,6 +395,24 @@ class MetricsSys:
                        round(getattr(usage, "last_update", 0.0), 3),
                        help_="Unix time of the last usage snapshot.",
                        type_="gauge")
+
+    def _render_chaos(self, metric) -> None:
+        """Fault-injection plane counters (chaos/faults.py): how many faults
+        each armed schedule has fired, by kind and target scope. Nothing is
+        emitted on a node that never armed a fault."""
+        from ..chaos.faults import REGISTRY
+
+        counts = REGISTRY.injected_counts()
+        armed = REGISTRY.list()
+        if not counts and not armed:
+            return
+        metric("minio_tpu_chaos_faults_armed", len(armed),
+               help_="Fault specs currently armed in the chaos registry.",
+               type_="gauge")
+        for (kind, target), n in sorted(counts.items()):
+            metric("minio_tpu_chaos_injected_total", n,
+                   {"kind": kind, "target": target},
+                   help_="Faults injected by the chaos plane.")
 
     # -- cluster view --------------------------------------------------------
 

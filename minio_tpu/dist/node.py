@@ -86,6 +86,7 @@ class Node:
         self.local_drives: dict[str, StorageAPI] = {}
         self.pool_drives: list[list[StorageAPI]] = []
         peer_urls: set[str] = set()
+        from ..chaos.disk import FaultyDisk
         from ..control.pubsub import GLOBAL_TRACE
         from ..storage.metered import MeteredDrive
 
@@ -94,8 +95,11 @@ class Node:
             for ep in pool:
                 if ep.is_local_path or ep.url == self.url:
                     # Local drives are metered (per-API latency EWMAs +
-                    # storage traces, xl-storage-disk-id-check.go role).
-                    d = MeteredDrive(LocalDrive(ep.path), trace=GLOBAL_TRACE)
+                    # storage traces, xl-storage-disk-id-check.go role) over
+                    # the fault-injection seam (admin /chaos arms faults in
+                    # the process-global registry; disarmed, FaultyDisk
+                    # resolves to the inner bound method -- no extra frame).
+                    d = MeteredDrive(FaultyDisk(LocalDrive(ep.path)), trace=GLOBAL_TRACE)
                     self.local_drives[ep.path] = d
                     drives.append(d)
                 else:
@@ -349,6 +353,12 @@ class Node:
         configure_targets(self.notifier, self.config, spool_root, on_error=_target_err)
         self.healmgr = HealManager(self.pools)
         self.mrf = MRFQueue(self.pools)
+        # Feed the MRF from every erasure set: a put that met quorum but
+        # missed drives queues an async repair instead of waiting for the
+        # scanner sweep (erasure-object.go:1430 addPartial -> mrf queue).
+        for pool in self.pools.pools:
+            for s in pool.sets:
+                s.on_partial = self.mrf.add
         from ..control.healmgr import DiskHealMonitor
 
         self.disk_heal = DiskHealMonitor(self.pools)

@@ -158,6 +158,22 @@ def make_peer_app(node, token: str) -> web.Application:
             return {"text": ""}
         return {"text": metrics.render_node()}
 
+    def h_chaos(a):
+        """Peer side of the admin chaos fanout: arm/disarm/list faults in
+        THIS node's process-global registry (chaos/faults.py). The arming
+        admin node passes the fault_id through so a later cluster-wide
+        disarm removes the same fault everywhere."""
+        from ..chaos.faults import REGISTRY, FaultSpec
+
+        op = a.get("op", "list")
+        if op == "arm":
+            return {"fault_id": REGISTRY.arm(FaultSpec.from_dict(a.get("spec", {})))}
+        if op == "disarm":
+            fid = a.get("fault_id", "")
+            removed = REGISTRY.disarm(fid) if fid else REGISTRY.disarm_all()
+            return {"removed": int(removed)}
+        return {"faults": REGISTRY.list()}
+
     # Streaming endpoints: this node's live event / trace records as NDJSON
     # (peer-rest-server.go:985 role) -- the serving node merges these into
     # its watcher responses so `mc watch` / `mc admin trace` see the whole
@@ -197,6 +213,7 @@ def make_peer_app(node, token: str) -> web.Application:
         "profilestop": h_profile_stop,
         "bandwidth": h_bandwidth,
         "metrics": h_node_metrics,
+        "chaos": h_chaos,
     }.items():
         app.router.add_post(f"/{name}", handler(fn))
     app.router.add_post("/listen", h_listen_stream)
@@ -239,6 +256,14 @@ class PeerClient:
 
     def bandwidth(self, bucket: str = "") -> dict:
         return self.client.call("/bandwidth", {"bucket": bucket})
+
+    def chaos(self, op: str, spec: dict | None = None, fault_id: str = "",
+              timeout: float | None = None) -> dict:
+        return self.client.call(
+            "/chaos",
+            {"op": op, "spec": spec or {}, "fault_id": fault_id},
+            timeout=timeout,
+        )
 
     def profile_start(self) -> dict:
         return self.client.call("/profilestart", {})
@@ -293,6 +318,11 @@ class NotificationSys:
 
     def reload_iam_all(self) -> None:
         self._fanout(lambda p, t: p.reload_iam(timeout=t))
+
+    def chaos_all(self, op: str, spec: dict | None = None, fault_id: str = "") -> None:
+        """Cluster-wide fault arm/disarm (the admin /chaos handlers call
+        this after applying locally)."""
+        self._fanout(lambda p, t: p.chaos(op, spec=spec, fault_id=fault_id, timeout=t))
 
     def reload_bucket_meta_all(self, bucket: str = "") -> None:
         self._fanout(lambda p, t: p.reload_bucket_meta(bucket, timeout=t))

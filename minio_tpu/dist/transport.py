@@ -11,18 +11,29 @@ from __future__ import annotations
 
 import hashlib
 import hmac
+import random
 import threading
 import time
 
 import msgpack
 import requests
 
+from ..chaos import net as chaos_net
+from ..chaos.faults import REGISTRY as _CHAOS
 from ..control import tracing
 from ..utils import errors
 
 ERROR_HEADER = "X-Mtpu-Error"
 TOKEN_HEADER = "X-Mtpu-Token"
 TRACE_HEADER = tracing.TRACE_HEADER
+
+
+def jitter(seconds: float, frac: float = 0.10) -> float:
+    """Spread a retry/probe interval by ±frac. Peers partitioned at the same
+    instant otherwise reconnect in lockstep, hammering the healed link on
+    exact HEALTH_INTERVAL boundaries (the thundering-herd the reference
+    avoids with randomized backoff in dsync and rest retries)."""
+    return seconds * (1.0 + random.uniform(-frac, frac))
 
 
 def cluster_token(secret: str) -> str:
@@ -116,14 +127,17 @@ class RestClient:
         self.session.headers[TOKEN_HEADER] = token
         self._online = True
         self._last_failure = 0.0
+        self._probe_interval = self.HEALTH_INTERVAL
         self._lock = threading.Lock()
 
     def is_online(self) -> bool:
         with self._lock:
             if self._online:
                 return True
-            # Off-line: allow a probe every HEALTH_INTERVAL.
-            return (time.monotonic() - self._last_failure) > self.HEALTH_INTERVAL
+            # Off-line: allow a probe every ~HEALTH_INTERVAL. The interval is
+            # re-jittered on each failure so a fleet of clients that lost the
+            # same peer together doesn't re-probe it in lockstep.
+            return (time.monotonic() - self._last_failure) > self._probe_interval
 
     def _mark(self, ok: bool) -> None:
         with self._lock:
@@ -132,6 +146,7 @@ class RestClient:
             else:
                 self._online = False
                 self._last_failure = time.monotonic()
+                self._probe_interval = jitter(self.HEALTH_INTERVAL)
 
     def call(
         self,
@@ -145,6 +160,10 @@ class RestClient:
         """POST base/path. args -> msgpack body (or query when body given).
         Returns the msgpack-decoded object, raw bytes if raw_response, or
         the live response when stream=True (caller iterates + closes)."""
+        # Chaos plane: one None check when disarmed. Covers storage-REST,
+        # peer fanout, and lock clients -- everything rides this method.
+        if _CHAOS.net is not None:
+            chaos_net.before_rpc(self.base_url, path)
         url = self.base_url + path
         # Explicit timeouts win; plain calls ride the endpoint's self-tuned
         # timeout. Streams are long-lived by design and excluded from tuning.

@@ -392,6 +392,11 @@ class ErasureObjects:
         # installed at boot (runtime.install_data_plane_codec) serves layers
         # built before it landed.
         self._codec = codec
+        # Partial-write hook: called (bucket, object, version_id) when a put
+        # met quorum but missed some drives, so the node can queue an async
+        # repair (the reference's addPartial -> MRF feed,
+        # cmd/erasure-object.go:1430). Node.build points it at MRFQueue.add.
+        self.on_partial = None
         # Namespace lock: serializes writers per object. Defaults to a
         # process-local locker; Node.build swaps in the dsync quorum lockers
         # (reference: NSLock via dsync, cmd/erasure-object.go:933-941).
@@ -685,6 +690,8 @@ class ErasureObjects:
             raise errors.ErasureWriteQuorum(
                 bucket, object_name, f"write quorum {write_quorum} not met ({n_ok} ok)"
             )
+        if n_ok < len(errs) and self.on_partial is not None:
+            self.on_partial(bucket, object_name, version_id)
         fi = self._make_put_fi(
             bucket,
             object_name,
@@ -822,6 +829,8 @@ class ErasureObjects:
             raise errors.ErasureWriteQuorum(
                 bucket, object_name, f"write quorum {write_quorum} not met ({n_ok} ok)"
             )
+        if n_ok < len(errs) and self.on_partial is not None:
+            self.on_partial(bucket, object_name, version_id)
         fi = self._make_put_fi(
             bucket,
             object_name,

@@ -1,0 +1,606 @@
+"""Chaos scenario harness: deterministic fault schedules against the real
+erasure/heal/lock stack.
+
+The analogue of the reference's chaos tooling (buildscripts/verify-healing.sh
+kills server processes; minio/mint drives black-box scenarios): arm a seeded
+FaultRegistry (minio_tpu/chaos/) under a live object layer, break drives /
+links / lock servers mid-operation, and assert the invariants the paper's
+recovery story promises -- quorum reads keep succeeding, MRF re-drives
+partial writes, heal converges, and post-heal reads are bit-identical.
+
+Collected via tests/test_chaos_scenarios.py (pytest only picks up test_*.py);
+tools/chaos_check.py runs this file directly, including the `slow` scenarios
+tier-1 skips.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+from aiohttp import web
+
+from minio_tpu.chaos.disk import FaultyDisk, flip_byte
+from minio_tpu.chaos.faults import (
+    BITROT,
+    DRIVE_ERROR,
+    DRIVE_HANG,
+    DRIVE_LATENCY,
+    LOCK_DEATH,
+    PARTITION,
+    REGISTRY,
+    SLOW_RPC,
+    FaultRegistry,
+    FaultSpec,
+)
+from minio_tpu.control.healmgr import (
+    DiskHealMonitor,
+    HealingTracker,
+    MRFQueue,
+    mark_drive_for_healing,
+)
+from minio_tpu.dist.locks import LOCK_PREFIX, DRWMutex, LocalLocker, RemoteLocker, make_lock_app
+from minio_tpu.dist.transport import RestClient, cluster_token, jitter
+from minio_tpu.object.pools import ServerPools
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.utils import errors
+from tests.harness import ErasureHarness
+from tests.test_healing_tracker import _replace_drive
+
+TOKEN = cluster_token("chaos-secret")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _has_xl(drive, bucket: str, name: str) -> bool:
+    try:
+        return drive.read_xl(bucket, name) is not None
+    except errors.StorageError:
+        return False
+
+
+def chaos_harness(tmp_path, n_disks: int = 8, parity: int = 2):
+    """ErasureHarness whose drives are wrapped in FaultyDisk over a PRIVATE
+    registry (the process-global one is the admin plane's; tests isolate)."""
+    reg = FaultRegistry()
+    hz = ErasureHarness(tmp_path, n_disks=n_disks, parity=parity)
+    hz.layer.disks = [FaultyDisk(d, reg) for d in hz.drives]
+    return hz, reg
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics: validation, determinism, budgets, zero overhead
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            FaultSpec(kind="melt-the-cpu")
+        with pytest.raises(ValueError):
+            FaultSpec(kind=DRIVE_ERROR, probability=0.0)
+        with pytest.raises(ValueError):
+            FaultSpec.from_dict({"target": "no-kind"})
+        spec = FaultSpec.from_dict({"kind": BITROT, "count": 3, "seed": 7})
+        # Bitrot defaults to the write side (corruption at rest).
+        assert spec.ops == ("create_file", "append_file")
+        assert FaultSpec.from_dict(spec.to_dict()).ops == spec.ops
+
+    def test_fixed_seed_reproduces_schedule(self):
+        def pattern(seed: int) -> list[bool]:
+            reg = FaultRegistry()
+            reg.arm(FaultSpec(kind=DRIVE_ERROR, probability=0.5, seed=seed))
+            return [
+                reg.match_disk("/x/disk0", "read_all", "b", f"o{i}") is not None
+                for i in range(64)
+            ]
+
+        first = pattern(99)
+        assert pattern(99) == first  # same seed, same call sequence => replay
+        assert pattern(100) != first
+        assert any(first) and not all(first)
+
+    def test_budget_exhaustion_restores_passthrough(self, tmp_path):
+        hz, reg = chaos_harness(tmp_path, n_disks=4, parity=2)
+        fd = hz.layer.disks[0]
+        reg.arm(FaultSpec(kind=DRIVE_ERROR, count=2))
+        for _ in range(2):
+            with pytest.raises(errors.FaultyDisk):
+                fd.disk_info()
+        # Budget spent: the snapshot empties and calls flow through again.
+        assert reg.disk is None
+        assert fd.disk_info().total > 0
+        assert reg.list()[0]["remaining"] == 0
+        assert reg.injected_counts()[(DRIVE_ERROR, "*")] == 2
+
+    def test_disarmed_passthrough_is_identity(self, tmp_path):
+        hz, reg = chaos_harness(tmp_path, n_disks=4, parity=2)
+        fd, inner = hz.layer.disks[1], hz.drives[1]
+        # Disarmed: the wrapper returns the INNER bound method itself -- the
+        # "one None check" zero-overhead contract from the issue.
+        assert fd.read_all.__self__ is inner
+        fid = reg.arm(FaultSpec(kind=DRIVE_LATENCY, delay_ms=1))
+        assert getattr(fd.read_all, "__self__", None) is not inner
+        reg.disarm(fid)
+        assert fd.read_all.__self__ is inner
+
+    def test_latency_and_hang(self, tmp_path):
+        hz, reg = chaos_harness(tmp_path, n_disks=4, parity=2)
+        fd = hz.layer.disks[0]
+        fd.make_vol("lat")
+        fd.write_all("lat", "a", b"x")
+        fid = reg.arm(FaultSpec(kind=DRIVE_LATENCY, delay_ms=60, ops=("read_all",)))
+        t0 = time.monotonic()
+        assert fd.read_all("lat", "a") == b"x"  # delayed, not broken
+        assert time.monotonic() - t0 >= 0.05
+        reg.disarm(fid)
+        reg.arm(FaultSpec(kind=DRIVE_HANG, delay_ms=20, ops=("read_all",)))
+        t0 = time.monotonic()
+        with pytest.raises(errors.FaultyDisk):
+            fd.read_all("lat", "a")
+        assert time.monotonic() - t0 >= 0.015
+
+    def test_flip_byte_changes_exactly_one_byte(self):
+        buf = bytes(range(256))
+        out = flip_byte(buf)
+        assert len(out) == len(buf)
+        assert sum(1 for a, b in zip(buf, out) if a != b) == 1
+        assert flip_byte(b"") == b""
+
+
+# ---------------------------------------------------------------------------
+# Scenario: corrupt shard (bitrot at rest) -> GET reconstructs, heal converges
+# ---------------------------------------------------------------------------
+
+
+class TestBitrotScenario:
+    def test_bitrot_then_get_then_heal_bit_identical(self, tmp_path):
+        """The fast tier-1 smoke scenario: one drive writes a corrupt shard,
+        reads still verify+reconstruct, heal rewrites it, and the healed
+        shard alone serves bit-identical bytes."""
+        hz, reg = chaos_harness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("cb")
+        data = bytes(i % 251 for i in range(300_000))  # > inline threshold
+        reg.arm(FaultSpec(kind=BITROT, target="disk3", count=1, seed=1))
+        hz.layer.put_object("cb", "obj", data)
+        assert reg.disk is None  # budget spent during the put
+        assert reg.injected_counts()[(BITROT, "disk3")] == 1
+
+        # Read with the corruption at rest: frame digests flag the bad shard
+        # and the decoder reconstructs from the healthy rows.
+        _, got = hz.layer.get_object("cb", "obj")
+        assert got == data
+
+        res = hz.layer.heal_object("cb", "obj")
+        assert res.disks_healed >= 1
+
+        # Reads after heal are bit-identical THROUGH the healed shard: drop
+        # the full parity budget elsewhere so disk3's row must participate.
+        others = [i for i in range(8) if i != 3][:2]
+        hz.take_offline(*others)
+        _, got = hz.layer.get_object("cb", "obj")
+        assert got == data
+
+
+# ---------------------------------------------------------------------------
+# Scenario: kill k drives mid-PUT -> quorum holds, heal converges
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+class TestDriveLossScenario:
+    def test_kill_four_drives_mid_put_quorum_reads_and_heal(self, tmp_path):
+        """The issue's n=12+4 acceptance scenario: the full parity budget of
+        drives dies during a streaming PUT; the write lands at quorum, reads
+        succeed while the drives are still dead, heal re-protects, and the
+        healed shards alone are bit-identical."""
+        hz, reg = chaos_harness(tmp_path, n_disks=16, parity=4)
+        hz.layer.make_bucket("kb")
+        data = bytes((i * 31) % 256 for i in range(3 << 20))
+        dead = [2, 3, 4, 5]  # disk2..disk5: no substring collision with 10-15
+        fids = [
+            reg.arm(FaultSpec(kind=DRIVE_ERROR, target=f"disk{i}", seed=i))
+            for i in dead
+        ]
+        oi = hz.layer.put_object("kb", "big", data)
+        assert oi.size == len(data)
+
+        # Quorum reads succeed with the faults still armed.
+        _, got = hz.layer.get_object("kb", "big")
+        assert got == data
+
+        for fid in fids:
+            reg.disarm(fid)
+        assert reg.disk is None
+        res = hz.layer.heal_object("kb", "big")
+        assert res.disks_healed == len(dead)
+
+        # Force reads through the healed rows: take four HEALTHY drives away.
+        hz.take_offline(6, 7, 8, 9)
+        _, got = hz.layer.get_object("kb", "big")
+        assert got == data
+        # Heal converged: a re-heal has nothing left to do.
+        assert hz.layer.heal_object("kb", "big").disks_healed == 0
+
+
+# ---------------------------------------------------------------------------
+# Scenario: partial PUT -> MRF re-drives the repair
+# ---------------------------------------------------------------------------
+
+
+class TestMRF:
+    def test_partial_put_feeds_mrf_and_drain_redrives(self, tmp_path):
+        hz, reg = chaos_harness(tmp_path, n_disks=8, parity=2)
+        mrf = MRFQueue(hz.layer, start=False)
+        hz.layer.on_partial = mrf.add
+        hz.layer.make_bucket("mb")
+        reg.arm(FaultSpec(kind=DRIVE_ERROR, target="disk2"))
+        hz.layer.put_object("mb", "part", b"p" * 1000)  # inline, 7/8 drives
+        assert mrf.pending() == 1
+        assert not _has_xl(hz.drives[2], "mb", "part")  # drive missed it
+
+        reg.disarm_all()
+        assert mrf.drain() == 1
+        assert mrf.healed == 1 and mrf.pending() == 0
+        assert _has_xl(hz.drives[2], "mb", "part")  # re-driven
+
+    def test_full_quorum_put_does_not_feed_mrf(self, tmp_path):
+        hz, _ = chaos_harness(tmp_path, n_disks=8, parity=2)
+        mrf = MRFQueue(hz.layer, start=False)
+        hz.layer.on_partial = mrf.add
+        hz.layer.make_bucket("mb")
+        hz.layer.put_object("mb", "clean", b"c" * 1000)
+        assert mrf.pending() == 0
+
+    def test_drop_counter_and_once_per_episode_log(self, caplog):
+        mrf = MRFQueue(None, maxsize=2, start=False)
+        with caplog.at_level("WARNING", logger="minio_tpu.heal"):
+            for i in range(5):
+                mrf.add("b", f"o{i}")
+        assert mrf.pending() == 2
+        assert mrf.dropped == 3
+        # One warning for the whole overflow episode, not one per drop.
+        episode_logs = [r for r in caplog.records if "MRF queue full" in r.message]
+        assert len(episode_logs) == 1
+        # Queue drains -> a successful add closes the episode; the NEXT
+        # overflow logs again.
+        mrf.q.get_nowait()
+        caplog.clear()
+        with caplog.at_level("WARNING", logger="minio_tpu.heal"):
+            mrf.add("b", "ok")      # fits: episode over
+            mrf.add("b", "drop2")   # full again: new episode, new log line
+        assert mrf.dropped == 4
+        assert sum("MRF queue full" in r.message for r in caplog.records) == 1
+
+
+# ---------------------------------------------------------------------------
+# Network faults through the one RestClient seam
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def lock_cluster():
+    """Three in-process lock REST servers (dsync-server_test.go analogue)."""
+    from minio_tpu.api.server import ThreadedServer
+
+    lockers = [LocalLocker() for _ in range(3)]
+    ports = [_free_port() for _ in range(3)]
+    servers = []
+    for lk, port in zip(lockers, ports):
+        app = web.Application()
+        app.add_subapp(LOCK_PREFIX, make_lock_app(lk, TOKEN))
+        ts = ThreadedServer(SimpleNamespace(app=app), port=port)
+        ts.start()
+        servers.append(ts)
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    yield {"lockers": lockers, "urls": urls, "servers": servers}
+    for ts in servers:
+        ts.stop()
+
+
+class TestNetFaults:
+    def test_partition_and_slow_rpc_on_restclient(self, lock_cluster):
+        url = lock_cluster["urls"][0]
+        client = RestClient(url + LOCK_PREFIX, TOKEN)
+        args = {"resource": "net/res", "uid": "u1"}
+        assert client.call("/refresh", args) == {"ok": False}
+
+        port = url.rsplit(":", 1)[1]
+        fid = REGISTRY.arm(
+            FaultSpec(kind=PARTITION, target=f"127.0.0.1:{port}", count=1)
+        )
+        try:
+            with pytest.raises(errors.DiskNotFound, match="chaos"):
+                client.call("/refresh", args)
+            assert client.is_online()  # injected failure, not a marked peer
+            assert client.call("/refresh", args) == {"ok": False}  # budget spent
+        finally:
+            REGISTRY.disarm(fid)
+
+        fid = REGISTRY.arm(
+            FaultSpec(kind=SLOW_RPC, target=f"127.0.0.1:{port}", delay_ms=80, count=1)
+        )
+        try:
+            t0 = time.monotonic()
+            assert client.call("/refresh", args) == {"ok": False}
+            assert time.monotonic() - t0 >= 0.07
+        finally:
+            REGISTRY.disarm(fid)
+
+    def test_injected_counts_surface_in_metrics(self):
+        from minio_tpu.control.metrics import MetricsSys
+
+        # Target matches nothing real: consume the budget directly so the
+        # counter moves without touching live traffic.
+        fid = REGISTRY.arm(FaultSpec(kind=PARTITION, target="metrics-probe", count=1))
+        try:
+            assert REGISTRY.match_net("http://x/", "/metrics-probe") is not None
+            text = MetricsSys().render_node()
+        finally:
+            REGISTRY.disarm(fid)
+        assert "minio_tpu_chaos_injected_total" in text
+        assert 'kind="partition"' in text
+        assert 'target="metrics-probe"' in text
+
+
+class TestLockDeath:
+    def test_quorum_acquire_with_one_lock_server_down(self, lock_cluster):
+        urls = lock_cluster["urls"]
+        dead = f"http://127.0.0.1:{_free_port()}"  # nothing listening
+        lockers = [RemoteLocker(urls[0], TOKEN), RemoteLocker(urls[1], TOKEN),
+                   RemoteLocker(dead, TOKEN)]
+        m = DRWMutex(lockers, "chaos/one-down")
+        assert m.acquire(writer=True, timeout=5)  # 2/3 = write quorum
+        m.release()
+        # Two dead servers: quorum unreachable, acquire must give up.
+        lockers2 = [RemoteLocker(urls[0], TOKEN), RemoteLocker(dead, TOKEN),
+                    RemoteLocker(f"http://127.0.0.1:{_free_port()}", TOKEN)]
+        m2 = DRWMutex(lockers2, "chaos/two-down")
+        assert not m2.acquire(writer=True, timeout=0.8)
+
+    def test_lock_death_fault_fires_on_lost(self, lock_cluster):
+        """Drop the lock quorum mid-hold: the chaos lock-death fault blackholes
+        lock REST only, the refresh round loses quorum, and the holder's
+        on_lost cancellation hook fires (drwmutex.go:221 semantics)."""
+        urls = lock_cluster["urls"]
+        lost_calls = []
+        lockers = [RemoteLocker(u, TOKEN) for u in urls]
+        m = DRWMutex(lockers, "chaos/mid-write", on_lost=lambda: lost_calls.append(1))
+        assert m.acquire(writer=True, timeout=5)
+        assert m._refresh_round()  # healthy refresh first
+
+        fid = REGISTRY.arm(FaultSpec(kind=LOCK_DEATH))
+        try:
+            assert not m._refresh_round()
+        finally:
+            REGISTRY.disarm(fid)
+        assert m.lost.is_set()
+        assert lost_calls == [1]
+        m.release()
+
+    def test_force_unlock_fanout_frees_a_wedged_resource(self, lock_cluster):
+        urls = lock_cluster["urls"]
+        lockers = [RemoteLocker(u, TOKEN) for u in urls]
+        holder = DRWMutex(lockers, "chaos/wedged")
+        assert holder.acquire(writer=True, timeout=5)
+        waiter = DRWMutex(lockers, "chaos/wedged")
+        assert not waiter.acquire(writer=True, timeout=0.4)
+        # Admin force-unlock fans out to every locker (the mc admin
+        # force-unlock story for a crashed holder).
+        for lk in lockers:
+            assert lk.force_unlock("chaos/wedged")
+        assert waiter.acquire(writer=True, timeout=5)
+        waiter.release()
+        holder.release()
+
+
+# ---------------------------------------------------------------------------
+# Retry jitter (dist/transport.py satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_retry_jitter_bounds_and_spread():
+    vals = [jitter(3.0) for _ in range(300)]
+    assert all(2.6999 <= v <= 3.3001 for v in vals)
+    assert max(vals) - min(vals) > 0.01  # actually random, not a constant
+    assert all(0.89999 <= jitter(1.0, frac=0.1) <= 1.10001 for _ in range(50))
+
+
+# ---------------------------------------------------------------------------
+# DiskHealMonitor: stop() checkpoints, restart resumes (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestHealRestartResume:
+    def test_stop_checkpoints_cursor_and_restart_resumes(self, tmp_path):
+        hz = ErasureHarness(tmp_path, n_disks=8)
+        pools = ServerPools([ErasureSets(list(hz.drives), 8)])
+        pools.make_bucket("resume-bkt")
+        names = [f"obj-{i:02d}" for i in range(6)]
+        for n in names:
+            pools.put_object("resume-bkt", n, b"r" * 1000)
+
+        fresh = _replace_drive(hz, 3)
+        for s in pools.pools[0].sets:
+            s.disks[3] = fresh
+        mark_drive_for_healing(fresh)
+
+        eo = pools.pools[0].sets[0]
+        real_heal = eo.heal_object
+        mon = DiskHealMonitor(pools, interval=999, checkpoint_every=100, start=False)
+        first_pass: list[str] = []
+
+        def stopping_heal(bucket, name, vid="", **kw):
+            first_pass.append(name)
+            if len(first_pass) == 3:
+                mon.stop()  # a restart arrives mid-sweep
+            return real_heal(bucket, name, vid, **kw)
+
+        eo.heal_object = stopping_heal
+        assert mon.tick() == 0  # interrupted, not finished
+
+        # The stop checkpointed the cursor at the last healed object.
+        tr = HealingTracker.load(fresh)
+        assert tr is not None and not tr.finished
+        assert (tr.resume_bucket, tr.resume_object) == ("resume-bkt", names[2])
+
+        # "Restart": a new monitor resumes from the cursor and only walks the
+        # tail, then converges and removes the tracker.
+        second_pass: list[str] = []
+
+        def counting_heal(bucket, name, vid="", **kw):
+            second_pass.append(name)
+            return real_heal(bucket, name, vid, **kw)
+
+        eo.heal_object = counting_heal
+        mon2 = DiskHealMonitor(pools, interval=999, start=False)
+        assert mon2.tick() == 1
+        assert second_pass == names[3:]
+        assert HealingTracker.load(fresh) is None
+        for n in names:
+            assert _has_xl(fresh, "resume-bkt", n)
+
+
+# ---------------------------------------------------------------------------
+# Cluster plane: admin /chaos API + partition during multipart complete
+# ---------------------------------------------------------------------------
+
+
+ADMIN = "/mtpu/admin/v1"
+
+
+@pytest.mark.slow
+class TestClusterChaos:
+    @pytest.fixture(scope="class")
+    def cluster(self, tmp_path_factory):
+        from minio_tpu.api.server import ThreadedServer
+        from minio_tpu.dist.node import Node
+        from tests.s3client import S3TestClient
+
+        root, secret = "chaosadmin", "chaos-secret-key"
+        tmp = tmp_path_factory.mktemp("chaoscluster")
+        ports = [_free_port(), _free_port()]
+        urls = [f"http://127.0.0.1:{p}" for p in ports]
+        endpoints = []
+        for ni in range(2):
+            for di in range(4):
+                endpoints.append(f"{urls[ni]}{tmp}/n{ni}d{di}")
+        nodes = [
+            Node(endpoints, url=urls[ni], root_user=root, root_password=secret,
+                 set_drive_count=8)
+            for ni in range(2)
+        ]
+        servers = []
+        for ni, node in enumerate(nodes):
+            ts = ThreadedServer(SimpleNamespace(app=node.make_app()), port=ports[ni])
+            ts.start()
+            servers.append(ts)
+        threads = [threading.Thread(target=n.build) for n in nodes]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert all(n.pools is not None for n in nodes), "cluster failed to build"
+        clients = [S3TestClient(urls[ni], root, secret) for ni in range(2)]
+        yield {"nodes": nodes, "clients": clients, "urls": urls, "ports": ports}
+        REGISTRY.disarm_all()  # never leak armed faults past the fixture
+        for ts in servers:
+            ts.stop()
+
+    def test_admin_arm_list_disarm_lifecycle(self, cluster):
+        c0 = cluster["clients"][0]
+        r = c0.request(
+            "POST", ADMIN + "/chaos",
+            body=json.dumps({"kind": "slow-rpc", "delay_ms": 1}).encode(),
+        )
+        assert r.status_code == 200, r.text
+        fid = r.json()["fault_id"]
+        assert fid
+
+        r = c0.request("GET", ADMIN + "/chaos")
+        assert r.status_code == 200
+        listing = r.json()
+        assert any(f["fault_id"] == fid for f in listing["local"])
+        # Cluster-wide view includes every peer's registry.
+        peer_lists = [v for k, v in listing.items() if k != "local"]
+        assert peer_lists and all(
+            any(f["fault_id"] == fid for f in faults) for faults in peer_lists if faults
+        )
+
+        r = c0.request("POST", ADMIN + "/chaos", body=b"{\"kind\": \"not-a-kind\"}")
+        assert r.status_code == 400  # InvalidArgument, not a 500
+
+        r = c0.request("DELETE", ADMIN + "/chaos", query=[("fault-id", fid)])
+        assert r.status_code == 200
+        r = c0.request("GET", ADMIN + "/chaos")
+        assert not r.json()["local"]
+
+    def test_partition_during_multipart_complete(self, cluster):
+        """Blackhole part of the commit fanout to the peer node DURING
+        complete-multipart: the commit still lands at write quorum and the
+        assembled object reads back bit-identical."""
+        import xml.etree.ElementTree as ET
+
+        NS = "{http://s3.amazonaws.com/doc/2006-03-01/}"
+        c0 = cluster["clients"][0]
+        port1 = cluster["ports"][1]
+        c0.make_bucket("mpchaos")
+        part1 = bytes((i * 7) % 256 for i in range(5 << 20))
+        part2 = b"tail" * 64
+
+        r = c0.request("POST", "/mpchaos/big", query=[("uploads", "")])
+        assert r.status_code == 200, r.text
+        uid = ET.fromstring(r.content).find(f"{NS}UploadId").text
+        e1 = c0.request(
+            "PUT", "/mpchaos/big", query=[("partNumber", "1"), ("uploadId", uid)],
+            body=part1,
+        ).headers["ETag"]
+        e2 = c0.request(
+            "PUT", "/mpchaos/big", query=[("partNumber", "2"), ("uploadId", uid)],
+            body=part2,
+        ).headers["ETag"]
+
+        # Partition exactly the per-drive commit RPCs to the peer node, with
+        # a budget below the parity slack: 2 of the 4 remote rename_data
+        # calls fail, 6/8 drives commit >= the k+1=5 write quorum.
+        r = c0.request(
+            "POST", ADMIN + "/chaos",
+            body=json.dumps({
+                "kind": "partition",
+                "target": f"127.0.0.1:{port1}/mtpu/storage/v1/renamedata",
+                "count": 2,
+                "cluster": False,
+            }).encode(),
+        )
+        assert r.status_code == 200, r.text
+        fid = r.json()["fault_id"]
+        try:
+            body = (
+                f"<CompleteMultipartUpload>"
+                f"<Part><PartNumber>1</PartNumber><ETag>{e1}</ETag></Part>"
+                f"<Part><PartNumber>2</PartNumber><ETag>{e2}</ETag></Part>"
+                f"</CompleteMultipartUpload>"
+            ).encode()
+            r = c0.request("POST", "/mpchaos/big", query=[("uploadId", uid)], body=body)
+            assert r.status_code == 200, r.text
+        finally:
+            c0.request("DELETE", ADMIN + "/chaos", query=[("fault-id", fid)])
+
+        got = c0.get_object("mpchaos", "big")
+        assert got.status_code == 200
+        assert got.content == part1 + part2
+
+        # The injections really happened and are visible on the metrics plane.
+        m = c0.request("GET", "/minio/v2/metrics/node")
+        assert m.status_code == 200
+        assert "minio_tpu_chaos_injected_total" in m.text

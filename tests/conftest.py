@@ -53,6 +53,14 @@ if os.environ.get("MINIO_TPU_RACE") == "1":
     sys.setswitchinterval(2e-6)
 
 
+def pytest_configure(config):
+    # Tier-1 runs `-m "not slow"`; the full chaos matrix (tools/chaos_check.py)
+    # includes slow scenarios.
+    config.addinivalue_line(
+        "markers", "slow: long-running scenario tests excluded from tier-1"
+    )
+
+
 def _child_pids() -> set[int]:
     try:
         out = subprocess.run(

@@ -1,0 +1,6 @@
+"""Collection shim: pytest only collects test_*.py, but the chaos scenario
+harness lives at tests/chaos_scenarios.py (the path the reliability docs and
+tools/chaos_check.py reference). Importing * re-exports every scenario so the
+normal suite runs them; markers (slow) ride along with the objects."""
+
+from tests.chaos_scenarios import *  # noqa: F401,F403

@@ -1,0 +1,49 @@
+"""Chaos gate: run the fault-injection scenario matrix (tests/chaos_scenarios.py).
+
+The analogue of the reference's verify-healing.sh / verify-resiliency CI legs:
+exercise the deterministic fault plane (minio_tpu/chaos/) end to end -- drives
+dying mid-PUT, shards corrupted at rest, peers partitioned during multipart
+commit, lock servers dropping quorum mid-hold -- and assert the recovery
+invariants (quorum reads, MRF re-drive, heal convergence, bit-identical reads
+after heal).
+
+    python tools/chaos_check.py           # full matrix, including `slow`
+    python tools/chaos_check.py --fast    # tier-1 smoke slice only
+
+Exit status is pytest's, so this drops straight into CI. Scenarios are
+collected from the scenario file directly (pytest accepts an explicit path
+regardless of its test-file naming convention).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+TIMEOUT_S = int(os.environ.get("CHAOS_CHECK_TIMEOUT_S", "900"))
+
+
+def main() -> int:
+    fast = "--fast" in sys.argv[1:]
+    extra = [a for a in sys.argv[1:] if a != "--fast"]
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [
+        sys.executable, "-m", "pytest", "-q",
+        "-p", "no:cacheprovider", "-p", "no:randomly",
+        os.path.join("tests", "chaos_scenarios.py"),
+    ]
+    if fast:
+        cmd += ["-m", "not slow"]
+    cmd += extra
+    try:
+        proc = subprocess.run(cmd, cwd=root, env=env, timeout=TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        print(f"chaos_check: timed out after {TIMEOUT_S}s", file=sys.stderr)
+        return 124
+    return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())
