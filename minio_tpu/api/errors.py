@@ -118,6 +118,9 @@ def from_object_error(e: Exception, bucket: str = "", key: str = "") -> S3Error:
         (oerr.InsufficientWriteQuorum, "SlowDownWrite"),
         (oerr.ErasureReadQuorum, "SlowDownRead"),
         (oerr.ErasureWriteQuorum, "SlowDownWrite"),
+        # A spent budget means the cluster is slower than the client's
+        # patience: answer 503 SlowDown (retryable) rather than 500.
+        (oerr.DeadlineExceeded, "SlowDownRead"),
         (oerr.InvalidArgument, "InvalidArgument"),
     ]
     for etype, code in mapping:

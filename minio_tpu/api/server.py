@@ -31,6 +31,7 @@ from aiohttp import web
 
 from ..control.bucket_meta import BucketMetadataSys
 from ..control.compress import META_ACTUAL_SIZE
+from ..control.degrade import GLOBAL_DEGRADE
 from ..control import objectlock as ol
 from ..control import tiering as tiering_mod
 from ..control.iam import IAMSys
@@ -43,6 +44,7 @@ from ..object.types import (
     ObjectInfo,
     PutObjectOptions,
 )
+from ..utils import deadline
 from ..utils import errors as oerr
 from . import zipext
 from .auth import SigV4Verifier, UNSIGNED_PAYLOAD
@@ -277,6 +279,13 @@ class S3Server:
             if self._cors_allow == "*"
             else {a.strip() for a in self._cors_allow.split(",")}
         )
+        # Node-level admission control (the reference's MINIO_API_REQUESTS_MAX
+        # throttle, cmd/generic-handlers.go maxClients): requests past the cap
+        # are shed IMMEDIATELY with a retryable 503 instead of queueing until
+        # every one of them times out. 0 disables the gate.
+        self._max_requests = int(_os.environ.get("MTPU_API_REQUESTS_MAX", "512"))
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
         self.app = web.Application(client_max_size=MAX_OBJECT_SIZE)
         self.app.router.add_route("*", "/{tail:.*}", self._entry)
         # Hooks filled in by the control plane (events, metrics, trace).
@@ -332,6 +341,7 @@ class S3Server:
         t0 = _time.perf_counter()
         bucket, key = self._split_path(request)
         api_name = _api_name(request.method, bucket, key, request.rel_url.query)
+        is_write = request.method in ("PUT", "POST", "DELETE")
         # The request root span: trace id == x-amz-request-id, so trace and
         # audit records join on one key. No-op when nobody subscribes.
         root = tracing.root_span(
@@ -342,25 +352,65 @@ class S3Server:
             method=request.method,
             path=request.path,
         )
-        with root:
-            try:
-                resp = await self._dispatch(request, request_id)
-            except S3Error as e:
-                resp = _xml(e.to_xml(request_id), e.api.http_status)
-            except (oerr.StorageError, ValueError) as e:
-                s3e = (
-                    from_object_error(e, bucket, key)
-                    if isinstance(e, oerr.StorageError)
-                    else S3Error("InvalidArgument", str(e))
-                )
-                resp = _xml(s3e.to_xml(request_id), s3e.api.http_status)
-            root.set(status=resp.status)
+        # Admission gate BEFORE any work: an overloaded node answers in
+        # microseconds so clients back off onto healthier nodes.
+        admitted = True
+        if self._max_requests > 0:
+            with self._inflight_lock:
+                if self._inflight >= self._max_requests:
+                    admitted = False
+                else:
+                    self._inflight += 1
+        if not admitted:
+            GLOBAL_DEGRADE.record_shed("write" if is_write else "read")
+            shed = S3Error(
+                "SlowDownWrite" if is_write else "SlowDownRead",
+                resource=f"/{bucket}/{key}" if bucket else "/",
+            )
+            resp = _xml(shed.to_xml(request_id), shed.api.http_status)
+            resp.headers["x-amz-request-id"] = request_id
+            resp.headers["Retry-After"] = "1"
+            with root:
+                root.set(status=resp.status, shed=True)
+            if self.metrics is not None:
+                self.metrics.record_http(request.method, resp.status)
+            return resp
+        # The client's remaining budget (X-Mtpu-Deadline, seconds) binds the
+        # whole dispatch: every internal RPC below inherits and decrements it.
+        dl = deadline.bind_header(request.headers.get(deadline.DEADLINE_HEADER))
+        try:
+            with root, dl:
+                try:
+                    resp = await self._dispatch(request, request_id)
+                except S3Error as e:
+                    resp = _xml(e.to_xml(request_id), e.api.http_status)
+                except (oerr.StorageError, ValueError) as e:
+                    if isinstance(e, oerr.DeadlineExceeded):
+                        # By method: reads shed as SlowDownRead, writes as
+                        # SlowDownWrite (both 503, both retryable).
+                        s3e = S3Error(
+                            "SlowDownWrite" if is_write else "SlowDownRead",
+                            resource=f"/{bucket}/{key}",
+                        )
+                    elif isinstance(e, oerr.StorageError):
+                        s3e = from_object_error(e, bucket, key)
+                    else:
+                        s3e = S3Error("InvalidArgument", str(e))
+                    resp = _xml(s3e.to_xml(request_id), s3e.api.http_status)
+                root.set(status=resp.status)
+        finally:
+            if self._max_requests > 0:
+                with self._inflight_lock:
+                    self._inflight -= 1
         duration = _time.perf_counter() - t0
         if not resp.prepared:  # streamed responses already sent their headers
             resp.headers["x-amz-request-id"] = request_id
             for hk, hv in self._cors_headers(request).items():
                 resp.headers.setdefault(hk, hv)
             resp.headers.setdefault("Server", "MinIO-TPU")
+            if resp.status == 503:
+                # Every throttle answer carries the back-off hint.
+                resp.headers.setdefault("Retry-After", "1")
         if self.metrics is not None:
             self.metrics.record_http(request.method, resp.status)
             self.metrics.record_api(api_name, duration, resp.status < 400)
@@ -2215,6 +2265,14 @@ class S3Server:
         """Build the streaming GET plan: decoded blocks flow to the socket
         without materializing the object (the reference's writeDataBlocks ->
         ResponseWriter path, erasure-decode.go:206)."""
+        # Last chance for a clean 503: once the plan is prepared the status
+        # line and Content-Length are on the wire and a spent budget can
+        # only abort the connection, not change the answer.
+        try:
+            deadline.check("streaming get")
+        except oerr.DeadlineExceeded:
+            GLOBAL_DEGRADE.record_deadline_abort("api-get")
+            raise
         oi, it = stream_fn(bucket, key, opts, offset=offset, length=length)
         headers = self._object_headers(oi)
         headers.update(self._sse_response_headers(oi))
@@ -2243,7 +2301,20 @@ class S3Server:
                 if chunk is None:
                     break
                 await resp.write(chunk)
-        finally:
+        except Exception as e:
+            # Headers (and a Content-Length promise) are already on the
+            # wire: substituting an error response here would interleave
+            # a second set of headers into the half-sent body and leave
+            # the client waiting out the original length. Close the
+            # connection instead so the client fails fast on truncation.
+            cur = tracing.current()
+            if cur is not None:
+                cur.set(stream_aborted=type(e).__name__)
+            with contextlib.suppress(Exception):
+                it.close()
+            if request.transport is not None:
+                request.transport.close()
+        else:
             with contextlib.suppress(Exception):
                 await resp.write_eof()
         return resp

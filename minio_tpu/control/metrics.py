@@ -12,6 +12,8 @@ import threading
 import time
 from collections import defaultdict, deque
 
+from .degrade import GLOBAL_DEGRADE
+
 
 class LastMinuteLatency:
     """Sliding 60s window of (count, total_seconds) per second bucket
@@ -197,6 +199,7 @@ class MetricsSys:
         self._render_codec(metric)
         self._render_heal_scanner(metric)
         self._render_chaos(metric)
+        self._render_degrade(metric)
 
         if self.layer is not None:
             total = free = 0
@@ -276,6 +279,50 @@ class MetricsSys:
                            help_="Per-drive StorageAPI calls.")
                     metric("minio_tpu_drive_errors_total", row["errors"], labels,
                            help_="Per-drive StorageAPI call failures.")
+
+    _BREAKER_STATES = {"closed": 0, "open": 1, "half-open": 2}
+
+    def _render_degrade(self, metric) -> None:
+        """Degradation-ladder counters (hedges, deadline aborts, sheds,
+        breaker trips) plus per-drive breaker state gauges."""
+        snap = GLOBAL_DEGRADE.snapshot()
+        metric("minio_tpu_hedge_launched_total", snap["hedge_launched"],
+               help_="Hedge reads armed against slow erasure shards.")
+        metric("minio_tpu_hedge_wins_total", snap["hedge_wins"],
+               help_="Hedge reads that beat their straggling primary.")
+        for stage, n in sorted(snap["deadline_aborts"].items()):
+            metric("minio_tpu_deadline_aborts_total", n, {"stage": stage},
+                   help_="Operations aborted by an expired request deadline.")
+        for kind, n in sorted(snap["sheds"].items()):
+            metric("minio_tpu_requests_shed_total", n, {"kind": kind},
+                   help_="Work refused by admission control (read/write/drive).")
+        metric("minio_tpu_breaker_trips_total", snap["breaker_trips"],
+               help_="Drive circuit breakers tripped open.")
+        metric("minio_tpu_breaker_closes_total", snap["breaker_closes"],
+               help_="Drive circuit breakers re-closed after a probe.")
+        if self.layer is None:
+            return
+        for p in self.layer.pools:
+            for d in p.disks:
+                state_fn = getattr(d, "breaker_state", None)
+                ep_fn = getattr(d, "endpoint", None)
+                if state_fn is None or ep_fn is None:
+                    continue
+                try:
+                    st = state_fn()
+                    drive = ep_fn()
+                except Exception:  # noqa: BLE001 - one bad drive, not the scrape
+                    continue
+                metric(
+                    "minio_tpu_drive_breaker_state",
+                    self._BREAKER_STATES.get(st["state"], -1),
+                    {"drive": drive},
+                    help_="Breaker state: 0 closed, 1 open, 2 half-open.",
+                    type_="gauge",
+                )
+                metric("minio_tpu_drive_breaker_trips_total", st["trips"],
+                       {"drive": drive},
+                       help_="Times this drive's breaker tripped open.")
 
     def _render_codec(self, metric) -> None:
         """Device/codec series: batch occupancy, queue depth, device-vs-host

@@ -12,6 +12,7 @@ so crashed holders don't wedge the namespace.
 
 from __future__ import annotations
 
+import hmac
 import random
 import threading
 import time
@@ -21,7 +22,7 @@ from dataclasses import dataclass, field
 import msgpack
 from aiohttp import web
 
-from ..utils import errors
+from ..utils import deadline, errors
 from .transport import ERROR_HEADER, TOKEN_HEADER, RestClient
 
 LOCK_PREFIX = "/mtpu/lock/v1"
@@ -115,12 +116,14 @@ def make_lock_app(locker: LocalLocker, token: str) -> web.Application:
 
     def handler(fn):
         async def wrapped(request: web.Request):
-            if request.headers.get(TOKEN_HEADER) != token:
+            # Constant-time compare, like every api/ auth path.
+            if not hmac.compare_digest(request.headers.get(TOKEN_HEADER, ""), token):
                 return web.Response(status=403)
             body = await request.read()
             a = msgpack.unpackb(body, raw=False) if body else {}
             try:
-                ok = fn(a)
+                with deadline.bind_header(request.headers.get(deadline.DEADLINE_HEADER)):
+                    ok = fn(a)
                 return web.Response(
                     body=msgpack.packb({"ok": ok}), content_type="application/x-msgpack"
                 )

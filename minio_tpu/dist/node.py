@@ -88,6 +88,7 @@ class Node:
         peer_urls: set[str] = set()
         from ..chaos.disk import FaultyDisk
         from ..control.pubsub import GLOBAL_TRACE
+        from ..storage.breaker import HealthGatedDrive
         from ..storage.metered import MeteredDrive
 
         for pool in self.pool_endpoints:
@@ -96,10 +97,17 @@ class Node:
                 if ep.is_local_path or ep.url == self.url:
                     # Local drives are metered (per-API latency EWMAs +
                     # storage traces, xl-storage-disk-id-check.go role) over
-                    # the fault-injection seam (admin /chaos arms faults in
-                    # the process-global registry; disarmed, FaultyDisk
+                    # the circuit breaker + admission gate (storage/breaker.py)
+                    # over the fault-injection seam (admin /chaos arms faults
+                    # in the process-global registry; disarmed, FaultyDisk
                     # resolves to the inner bound method -- no extra frame).
-                    d = MeteredDrive(FaultyDisk(LocalDrive(ep.path)), trace=GLOBAL_TRACE)
+                    # Breaker INSIDE the meter so fail-fast refusals are
+                    # timed/counted; FaultyDisk inside the breaker so injected
+                    # faults trip it exactly like kernel EIOs.
+                    d = MeteredDrive(
+                        HealthGatedDrive(FaultyDisk(LocalDrive(ep.path))),
+                        trace=GLOBAL_TRACE,
+                    )
                     self.local_drives[ep.path] = d
                     drives.append(d)
                 else:

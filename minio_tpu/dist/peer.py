@@ -8,13 +8,14 @@ health pings, lock listing, and admin fan-out. Data never rides this channel
 
 from __future__ import annotations
 
+import hmac
 import time
 
 import msgpack
 from aiohttp import web
 
 from ..control import tracing
-from ..utils import errors
+from ..utils import deadline, errors
 from .transport import ERROR_HEADER, TOKEN_HEADER, RestClient
 
 PEER_PREFIX = "/mtpu/peer/v1"
@@ -24,16 +25,21 @@ START_TIME = time.time()
 def make_peer_app(node, token: str) -> web.Application:
     app = web.Application()
 
+    def check_token(request: web.Request) -> bool:
+        # Constant-time: equality timing must not leak matched prefixes.
+        return hmac.compare_digest(request.headers.get(TOKEN_HEADER, ""), token)
+
     def handler(fn):
         async def wrapped(request: web.Request):
             import asyncio
 
-            if request.headers.get(TOKEN_HEADER) != token:
+            if not check_token(request):
                 return web.Response(status=403)
             body = await request.read()
             a = msgpack.unpackb(body, raw=False) if body else {}
             try:
-                with tracing.bind_header(request.headers.get(tracing.TRACE_HEADER)):
+                with tracing.bind_header(request.headers.get(tracing.TRACE_HEADER)), \
+                        deadline.bind_header(request.headers.get(deadline.DEADLINE_HEADER)):
                     result = await asyncio.to_thread(fn, a)
                 return web.Response(
                     body=msgpack.packb(result, use_bin_type=True),
@@ -179,7 +185,7 @@ def make_peer_app(node, token: str) -> web.Application:
     # its watcher responses so `mc watch` / `mc admin trace` see the whole
     # cluster, not one node.
     async def h_listen_stream(request: web.Request):
-        if request.headers.get(TOKEN_HEADER) != token:
+        if not check_token(request):
             return web.Response(status=403)
         notifier = getattr(node, "notifier", None)
         if notifier is None:
@@ -191,7 +197,7 @@ def make_peer_app(node, token: str) -> web.Application:
         return await stream_hub_response(request, notifier.listen_hub, _json.dumps)
 
     async def h_trace_stream(request: web.Request):
-        if request.headers.get(TOKEN_HEADER) != token:
+        if not check_token(request):
             return web.Response(status=403)
         trace = getattr(node, "trace", None)
         if trace is None:
@@ -273,12 +279,14 @@ class PeerClient:
 
     def listen_stream(self):
         """Live event stream from this peer (caller iterates lines + closes).
-        Long timeout: the peer writes keep-alives every ~1s."""
-        return self.client.call("/listen", {}, stream=True, timeout=30.0)
+        No static timeout: the endpoint's DynamicTimeout tuner sizes the
+        time-to-headers wait, and the peer's ~1s keep-alives hold the
+        connection open far under the 5s tuner floor."""
+        return self.client.call("/listen", {}, stream=True)
 
     def trace_stream(self):
         """Live trace stream from this peer."""
-        return self.client.call("/trace", {}, stream=True, timeout=30.0)
+        return self.client.call("/trace", {}, stream=True)
 
 
 class NotificationSys:

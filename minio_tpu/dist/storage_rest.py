@@ -11,6 +11,7 @@ and server here).
 
 from __future__ import annotations
 
+import hmac
 import itertools
 import urllib.parse
 
@@ -22,7 +23,7 @@ from ..storage.local import LocalDrive
 from ..storage.types import DiskInfo, FileInfo, VolInfo
 from ..storage.xlmeta import XLMeta
 from ..control import tracing
-from ..utils import errors
+from ..utils import deadline, errors
 from .transport import ERROR_HEADER, TOKEN_HEADER, RestClient, error_to_name, name_to_error
 
 PREFIX = "/mtpu/storage/v1"
@@ -47,7 +48,10 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
     app = web.Application(client_max_size=1 << 31)
 
     def get_drive(request: web.Request) -> LocalDrive:
-        if request.headers.get(TOKEN_HEADER) != token:
+        # Constant-time compare: the timing of an equality mismatch must not
+        # leak how much of a guessed token matched (same discipline as the
+        # api/ signature checks).
+        if not hmac.compare_digest(request.headers.get(TOKEN_HEADER, ""), token):
             raise web.HTTPForbidden(text="bad cluster token")
         dpath = request.query.get("disk", "")
         d = drives.get(dpath)
@@ -70,9 +74,12 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
             try:
                 drive = get_drive(request)
                 body = await request.read()
-                # Adopt the caller's trace context: to_thread copies this
-                # coroutine's context, so drive spans parent under the hop.
-                with tracing.bind_header(request.headers.get(tracing.TRACE_HEADER)):
+                # Adopt the caller's trace context AND its deadline budget:
+                # to_thread copies this coroutine's context, so drive spans
+                # parent under the hop and the remaining budget keeps
+                # shrinking through nested RPCs.
+                with tracing.bind_header(request.headers.get(tracing.TRACE_HEADER)), \
+                        deadline.bind_header(request.headers.get(deadline.DEADLINE_HEADER)):
                     result = await asyncio.to_thread(fn, drive, request, body)
                 if isinstance(result, bytes):
                     return web.Response(body=result)
@@ -202,11 +209,12 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
             return list(itertools.islice(it, 256))
 
         binder = tracing.bind_header(request.headers.get(tracing.TRACE_HEADER))
+        dl_binder = deadline.bind_header(request.headers.get(deadline.DEADLINE_HEADER))
         try:
             drive = get_drive(request)
             body = await request.read()
             a = args(request, body)
-            with binder:
+            with binder, dl_binder:
                 it = drive.walk_dir(a["volume"], a.get("base", ""), bool(a.get("recursive", True)))
                 first = await asyncio.to_thread(next_batch, it)
         except web.HTTPException:
@@ -225,7 +233,7 @@ def make_storage_app(drives: dict[str, "StorageAPI"], token: str) -> web.Applica
                 )
                 if len(batch) < 256:
                     break
-                with binder:
+                with binder, dl_binder:
                     batch = await asyncio.to_thread(next_batch, it)
         except (ConnectionError, asyncio.CancelledError):
             raise  # client went away: nothing to tell it

@@ -21,11 +21,13 @@ import requests
 from ..chaos import net as chaos_net
 from ..chaos.faults import REGISTRY as _CHAOS
 from ..control import tracing
-from ..utils import errors
+from ..control.degrade import GLOBAL_DEGRADE
+from ..utils import deadline, errors
 
 ERROR_HEADER = "X-Mtpu-Error"
 TOKEN_HEADER = "X-Mtpu-Token"
 TRACE_HEADER = tracing.TRACE_HEADER
+DEADLINE_HEADER = deadline.DEADLINE_HEADER
 
 
 def jitter(seconds: float, frac: float = 0.10) -> float:
@@ -166,8 +168,10 @@ class RestClient:
             chaos_net.before_rpc(self.base_url, path)
         url = self.base_url + path
         # Explicit timeouts win; plain calls ride the endpoint's self-tuned
-        # timeout. Streams are long-lived by design and excluded from tuning.
-        tune = timeout is None and not stream
+        # timeout. Streams tune too: session.post(stream=True) returns at
+        # response HEADERS, so the tuner times time-to-headers, and the 5s
+        # tuner floor sits above the ~1s keep-alives long streams emit.
+        tune = timeout is None
         dt: DynamicTimeout | None = None
         if tune:
             with self._tuners_lock:
@@ -176,13 +180,29 @@ class RestClient:
                     dt = self._tuners[path] = DynamicTimeout(
                         self.timeout, minimum=min(5.0, self.timeout)
                     )
-        effective = timeout if timeout is not None else (
-            self.timeout if stream else dt.timeout()
-        )
+        effective = timeout if timeout is not None else dt.timeout()
+        # Deadline propagation: the remaining budget caps this hop's socket
+        # timeout and rides the wire so the far side inherits it. Checked
+        # AFTER the chaos hook -- an injected slow-rpc consumes budget like
+        # a real slow link would.
+        rem = deadline.remaining()
+        capped = False
+        if rem is not None:
+            if rem < deadline.MIN_BUDGET:
+                GLOBAL_DEGRADE.record_deadline_abort("rpc")
+                raise errors.DeadlineExceeded(f"rpc{path}: budget spent before send")
+            if rem < effective:
+                effective = rem
+                capped = True
         # The hop is a span of the caller's trace; its id rides the trace
         # header so spans opened on the far side chain under this hop.
         rpc = tracing.span(f"rpc{path}", "rpc", peer=self.base_url)
         trace_hdr = rpc.header()
+        headers: dict[str, str] = {}
+        if trace_hdr:
+            headers[TRACE_HEADER] = trace_hdr
+        if rem is not None:
+            headers[DEADLINE_HEADER] = f"{max(rem, 0.0):.3f}"
         t0 = time.monotonic()
         try:
             if body is not None:
@@ -190,14 +210,12 @@ class RestClient:
                     url,
                     params={k: str(v) for k, v in (args or {}).items()},
                     data=body,
-                    headers={TRACE_HEADER: trace_hdr} if trace_hdr else None,
+                    headers=headers or None,
                     timeout=effective,
                     stream=stream,
                 )
             else:
-                headers = {"Content-Type": "application/x-msgpack"}
-                if trace_hdr:
-                    headers[TRACE_HEADER] = trace_hdr
+                headers["Content-Type"] = "application/x-msgpack"
                 r = self.session.post(
                     url,
                     data=msgpack.packb(args or {}, use_bin_type=True),
@@ -208,6 +226,14 @@ class RestClient:
         except requests.RequestException as e:
             self._mark(False)
             rpc.finish(error=type(e).__name__)
+            # A timeout on a deadline-capped hop is the BUDGET expiring, not
+            # the channel misbehaving: surface DeadlineExceeded (aborts the
+            # whole request) instead of DiskNotFound (counts against the
+            # drive), and don't feed the tuner -- a capped timeout says
+            # nothing about how the channel should be sized.
+            if capped and isinstance(e, requests.Timeout):
+                GLOBAL_DEGRADE.record_deadline_abort("rpc")
+                raise errors.DeadlineExceeded(f"rpc{path}: budget spent in flight")
             # Only READ timeouts are evidence the timeout is too small; a
             # down peer (connection-refused = ConnectionError, blackholed =
             # ConnectTimeout) says nothing about sizing and must not

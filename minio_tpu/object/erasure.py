@@ -17,6 +17,7 @@ Differences from the reference worth noting (TPU-first design):
 
 from __future__ import annotations
 
+import concurrent.futures as _cf
 import hashlib
 import io
 import os
@@ -26,7 +27,9 @@ import uuid
 from typing import Iterator
 
 from ..control import tracing
+from ..control.degrade import GLOBAL_DEGRADE
 from ..ops import bitrot as bitrot_mod
+from ..utils import deadline
 from ..storage.interface import StorageAPI
 from ..storage.types import ErasureInfo, FileInfo, ObjectPartInfo, now
 from ..storage.xlmeta import SMALL_FILE_THRESHOLD
@@ -51,6 +54,39 @@ DIGEST_LEN = 32
 # a device-batchable [G, K, S] tensor (the reference streams one 1 MiB block
 # at a time, erasure-encode.go:73-109; grouping keeps the TPU batch win).
 GROUP_BLOCKS = 16
+
+# Hedged-read policy: a shard read that has run longer than
+# max(HEDGE_FLOOR, HEDGE_MULT x median completed duration) is presumed
+# straggling and a hedge read is armed on the best unread slot -- the
+# any-k-of-n freedom of the erasure code turned into tail-latency insurance
+# (the regenerating-codes reading discipline, arXiv:1412.3022). The floor
+# keeps microsecond-fast local windows from hedging on scheduler noise.
+HEDGE_FLOOR = 0.05
+HEDGE_MULT = 3.0
+_HEDGE_POLL = 0.01  # gather loop wakeup for hedge decisions, seconds
+
+
+def _rank_read_slots(by_shard: list, k: int) -> list[int]:
+    """Order online shard slots for reading: lowest read_file latency EWMA
+    first (MeteredDrive's tracker, surfaced through the drive stack), data
+    slots before parity on ties, stable by slot index. Slots whose drive is
+    missing or breaker-gated offline are excluded entirely."""
+    scored: list[tuple[float, int, int]] = []
+    for j, d in enumerate(by_shard):
+        if d is None or not d.is_online():
+            continue
+        ewma = 0.0
+        lat_fn = getattr(d, "api_latencies", None)
+        if lat_fn is not None:
+            try:
+                row = lat_fn().get("read_file")
+                if row:
+                    ewma = float(row["ewma_ms"])
+            except Exception:  # noqa: BLE001 - ranking is advisory
+                ewma = 0.0
+        scored.append((ewma, 0 if j < k else 1, j))
+    scored.sort()
+    return [j for _, _, j in scored]
 
 
 def _as_reader(data) -> io.BufferedIOBase:
@@ -760,6 +796,15 @@ class ErasureObjects:
                 size += len(block)
                 group.append(block)
                 if len(group) >= GROUP_BLOCKS:
+                    # Budget check at the group boundary: an expired deadline
+                    # aborts into the cleanup path below (stage shards
+                    # deleted locally, no budget needed), so a slow client
+                    # or slow drives can't stream past the caller's patience.
+                    try:
+                        deadline.check("erasure put")
+                    except errors.DeadlineExceeded:
+                        GLOBAL_DEGRADE.record_deadline_abort("erasure-put")
+                        raise
                     writer.append_group(group)
                     group = []
                     if writer.alive() < write_quorum:
@@ -1009,9 +1054,21 @@ class ErasureObjects:
         part_file = f"part.{part.number}"
         b0, b1 = lo // BLOCK_SIZE, (hi - 1) // BLOCK_SIZE
 
+        # Slot selection: the k lowest-latency ONLINE slots carry the window
+        # (ranked by the metered read_file EWMAs + breaker state); the rest
+        # queue as hedge spares, best first. Inline payloads ride the
+        # metadata already in hand -- no drive IO, nothing to hedge.
+        if inline:
+            primaries = list(range(k))
+            spares = [j for j in range(k, k + mth) if metas_by_shard[j] is not None]
+        else:
+            ranked = _rank_read_slots(by_shard, k)
+            primaries = ranked[:k] if len(ranked) >= k else ranked
+            spares = ranked[len(primaries):]
+
         def make_window(g0: int):
-            """Issue the window's data-row reads immediately (futures); the
-            readahead stage -- window g+1's drive IO overlaps window g's
+            """Issue the window's primary-slot reads immediately (futures);
+            the readahead stage -- window g+1's drive IO overlaps window g's
             verify/decode (klauspost/readahead's role in the reference read
             pipeline, cmd/object-api-utils.go:686)."""
             g1 = min(g0 + GROUP_BLOCKS - 1, b1)
@@ -1046,17 +1103,90 @@ class ErasureObjects:
                 except (errors.DiskError, errors.FileCorrupt):
                     return None
 
-            futures = meta_mod.parallel_submit(read_window, list(range(k)))
-            return g1, read_window, futures
+            issued_at = {j: time.monotonic() for j in primaries}
+            futures = dict(
+                zip(primaries, meta_mod.parallel_submit(read_window, primaries))
+            )
+            return g1, read_window, futures, issued_at
+
+        def gather_hedged(read_window, futures, issued_at, install) -> None:
+            """Collect window reads, arming hedges when a primary straggles.
+
+            Reconstruction needs ANY k of the n rows, so the moment a primary
+            exceeds max(HEDGE_FLOOR, HEDGE_MULT x median completed duration)
+            the best spare slot is launched against it; the first k usable
+            rows win and stragglers are left to finish in their pool thread
+            (results discarded). Spares also replace failed reads outright."""
+            by_future = {f: j for j, f in futures.items()}
+            spare_queue = list(spares)
+            hedged: set[int] = set()
+            covered: set[int] = set()
+            durations: list[float] = []
+            usable: set[int] = set()
+            launched = 0
+
+            def launch(j: int, covering: int | None) -> None:
+                nonlocal launched
+                issued_at[j] = time.monotonic()
+                f = meta_mod.parallel_submit(read_window, [j])[0]
+                by_future[f] = j
+                if covering is not None:
+                    hedged.add(j)
+                    covered.add(covering)
+                    launched += 1
+
+            while len(usable) < k and by_future:
+                try:
+                    deadline.check("hedged erasure read")
+                except errors.DeadlineExceeded:
+                    GLOBAL_DEGRADE.record_deadline_abort("erasure-get")
+                    raise
+                done, _ = _cf.wait(
+                    set(by_future), timeout=_HEDGE_POLL,
+                    return_when=_cf.FIRST_COMPLETED,
+                )
+                now = time.monotonic()
+                for f in done:
+                    j = by_future.pop(f)
+                    result = f.result()[0]
+                    install(j, result)
+                    durations.append(now - issued_at[j])
+                    if result is not None:
+                        usable.add(j)
+                    elif spare_queue:
+                        # Failed read: its replacement is routing, not hedging.
+                        launch(spare_queue.pop(0), covering=None)
+                if len(usable) >= k or not spare_queue:
+                    continue
+                # Hedge decision: need a median worth trusting (at least
+                # half the quorum completed), then every uncovered
+                # outstanding slot past the threshold gets one hedge.
+                if len(durations) * 2 < k:
+                    continue
+                med = sorted(durations)[len(durations) // 2]
+                threshold = max(HEDGE_FLOOR, HEDGE_MULT * med)
+                for j in list(by_future.values()):
+                    if not spare_queue:
+                        break
+                    if j in covered or j in hedged:
+                        continue
+                    if now - issued_at[j] > threshold:
+                        launch(spare_queue.pop(0), covering=j)
+            wins = len(usable & hedged)
+            if launched:
+                GLOBAL_DEGRADE.record_hedge(launched, wins)
+                cur = tracing.current()
+                if cur is not None:
+                    cur.set(hedge_launched=launched, hedge_wins=wins)
 
         starts = list(range(b0, b1 + 1, GROUP_BLOCKS))
         pending = make_window(starts[0])
         for win_i, g0 in enumerate(starts):
-            g1, read_window, futures = pending
+            g1, read_window, futures, issued_at = pending
             # Kick off the NEXT window's reads before decoding this one.
             pending = make_window(starts[win_i + 1]) if win_i + 1 < len(starts) else None
 
-            # Data rows first; parity pulled lazily on any failure (the
+            # Ranked rows first; spares pulled lazily on any failure (the
             # lazy-spare parallelReader discipline, erasure-decode.go:119).
             frames: list[list[tuple[memoryview, memoryview]] | None] = [None] * (k + mth)
             oks: list[list[bool] | None] = [None] * (k + mth)
@@ -1066,8 +1196,7 @@ class ErasureObjects:
                 frames[j], oks[j] = result if result is not None else (None, None)
                 loaded[j] = True
 
-            for j in range(k):
-                install(j, futures[j].result()[0])
+            gather_hedged(read_window, futures, issued_at, install)
 
             def load_spares() -> None:
                 spare = [j for j in range(k + mth) if not loaded[j]]
@@ -1077,7 +1206,7 @@ class ErasureObjects:
                 for idx, j in enumerate(spare):
                     install(j, spare_results[idx][0])
 
-            if any(frames[j] is None for j in range(k)):
+            if sum(1 for j in range(k + mth) if frames[j] is not None) < k:
                 load_spares()
 
             def valid_rows(w: int) -> list[bytes | None]:

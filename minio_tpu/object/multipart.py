@@ -20,8 +20,9 @@ import json
 import uuid
 
 from ..control import tracing
+from ..control.degrade import GLOBAL_DEGRADE
 from ..storage.types import ErasureInfo, FileInfo, ObjectPartInfo, now
-from ..utils import errors
+from ..utils import deadline, errors
 from ..utils.hashes import hash_order
 from . import metadata as meta_mod
 from .erasure import BLOCK_SIZE, META_BUCKET, ErasureObjects
@@ -161,6 +162,13 @@ class MultipartManager:
                 size += len(block)
                 group.append(block)
                 if len(group) >= GROUP_BLOCKS:
+                    # Deadline expiry aborts into cleanup() below -- stage
+                    # files are deleted, nothing leaks into the upload dir.
+                    try:
+                        deadline.check("upload part")
+                    except errors.DeadlineExceeded:
+                        GLOBAL_DEGRADE.record_deadline_abort("multipart-put")
+                        raise
                     writer.append_group(group)
                     group = []
                     if writer.alive() < write_quorum:

@@ -84,6 +84,25 @@ class DiskIDMismatch(DiskError):
     the disk-id check wrapper, cmd/xl-storage-disk-id-check.go:68)."""
 
 
+class CircuitOpen(DiskError):
+    """Fail-fast refusal from a tripped per-drive circuit breaker
+    (storage/breaker.py). A DiskError on purpose: the quorum reducers count
+    the gated drive as failed and the erasure layer routes around it, the
+    same way a dead spindle is handled -- just without burning a timeout."""
+
+
+class DriveBusy(DiskError):
+    """Per-drive admission control rejected the call: the drive's bounded
+    in-flight window is full (errDiskOngoingReq role). Quorum-countable so
+    an overloaded drive sheds to its peers instead of queueing unboundedly."""
+
+
+class DeadlineExceeded(StorageError):
+    """The request's propagated time budget (X-Mtpu-Deadline) is spent.
+    NOT a DiskError: an expired budget says nothing about drive health and
+    must abort the whole request, not count against one drive's quorum."""
+
+
 # ---------------------------------------------------------------------------
 # Object-layer errors (cmd/object-api-errors.go equivalents).
 # ---------------------------------------------------------------------------

@@ -16,6 +16,7 @@ tier-1 skips.
 from __future__ import annotations
 
 import json
+import os
 import socket
 import threading
 import time
@@ -37,6 +38,7 @@ from minio_tpu.chaos.faults import (
     FaultRegistry,
     FaultSpec,
 )
+from minio_tpu.control.degrade import GLOBAL_DEGRADE
 from minio_tpu.control.healmgr import (
     DiskHealMonitor,
     HealingTracker,
@@ -47,7 +49,9 @@ from minio_tpu.dist.locks import LOCK_PREFIX, DRWMutex, LocalLocker, RemoteLocke
 from minio_tpu.dist.transport import RestClient, cluster_token, jitter
 from minio_tpu.object.pools import ServerPools
 from minio_tpu.object.sets import ErasureSets
-from minio_tpu.utils import errors
+from minio_tpu.storage.breaker import CircuitBreaker, HealthGatedDrive
+from minio_tpu.utils import deadline, errors
+from minio_tpu.utils.hashes import hash_order
 from tests.harness import ErasureHarness
 from tests.test_healing_tracker import _replace_drive
 
@@ -469,6 +473,222 @@ class TestHealRestartResume:
         assert HealingTracker.load(fresh) is None
         for n in names:
             assert _has_xl(fresh, "resume-bkt", n)
+
+
+# ---------------------------------------------------------------------------
+# Graceful degradation: hedged reads, circuit breakers, deadline propagation
+# ---------------------------------------------------------------------------
+
+# Fault targets match by SUBSTRING against the drive path, so "disk1" also
+# matches disk10..disk15 on a 16-drive harness; deterministic scenarios pick
+# targets from the collision-free index set.
+SAFE_TARGETS = (0, 2, 3, 4, 5, 6, 7, 8, 9)
+
+
+class TestHedgedReads:
+    def test_slow_drive_get_hedges_within_slo(self, tmp_path):
+        """The issue's acceptance SLO: a 10x-latency fault on ONE of 16
+        drives must not 10x the GET -- the hedge fires after ~3x the median
+        shard read and a parity row covers the straggler, so the wall stays
+        near the fault-free baseline instead of the injected 1s stall."""
+        hz, reg = chaos_harness(tmp_path, n_disks=16, parity=4)
+        hz.layer.make_bucket("hb")
+        data = bytes((i * 13) % 256 for i in range(4 << 20))
+        hz.layer.put_object("hb", "big", data)
+
+        t0 = time.monotonic()
+        _, got = hz.layer.get_object("hb", "big")
+        base = time.monotonic() - t0
+        assert got == data
+
+        # Find a collision-safe drive holding a DATA slot (drive i holds
+        # shard distribution[i]-1; slots < k are data and read first).
+        k = 12
+        dist = hash_order("hb/big", 16)
+        target = next(i for i in SAFE_TARGETS if dist[i] - 1 < k)
+
+        before = GLOBAL_DEGRADE.snapshot()
+        fid = reg.arm(FaultSpec(
+            kind=DRIVE_LATENCY, target=f"disk{target}", delay_ms=1000,
+            ops=("read_file",),
+        ))
+        try:
+            t0 = time.monotonic()
+            _, got = hz.layer.get_object("hb", "big")
+            wall = time.monotonic() - t0
+        finally:
+            reg.disarm(fid)
+        assert got == data
+        after = GLOBAL_DEGRADE.snapshot()
+        # The hedge actually fired AND won (the counter the dashboards watch).
+        assert after["hedge_launched"] > before["hedge_launched"]
+        assert after["hedge_wins"] > before["hedge_wins"]
+        # Wall bounded by the SLO, far under the injected 1s stall.
+        assert wall < max(2 * base, 0.8), f"hedged GET took {wall:.3f}s (base {base:.3f}s)"
+
+
+class TestBreakerScenario:
+    def test_drive_error_trips_breaker_then_recloses(self, tmp_path):
+        """Sustained drive errors trip the breaker within the threshold,
+        reads keep succeeding at quorum while the drive fails fast, and the
+        background probe re-closes the breaker once the fault is gone."""
+        reg = FaultRegistry()
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        gated = [
+            HealthGatedDrive(
+                FaultyDisk(d, reg),
+                breaker=CircuitBreaker(
+                    name=f"disk{i}", error_threshold=3, cooldown=0.2, max_cooldown=1.0
+                ),
+            )
+            for i, d in enumerate(hz.drives)
+        ]
+        hz.layer.disks = gated
+        hz.layer.make_bucket("bb")
+        data = bytes(i % 251 for i in range(300_000))
+        hz.layer.put_object("bb", "obj", data)
+
+        before = GLOBAL_DEGRADE.snapshot()
+        fid = reg.arm(FaultSpec(kind=DRIVE_ERROR, target="disk3"))
+        try:
+            # Each GET scores health errors on disk3; within the threshold
+            # the breaker opens -- and every read still succeeds at quorum.
+            for _ in range(4):
+                _, got = hz.layer.get_object("bb", "obj")
+                assert got == data
+            assert gated[3].breaker_state()["state"] == "open"
+            assert not gated[3].is_online()
+            # Open = fail-fast refusal, not a 30s hang on a sick drive.
+            with pytest.raises(errors.CircuitOpen):
+                gated[3].disk_info()
+        finally:
+            reg.disarm(fid)
+
+        # Fault gone: the jittered background probe re-closes the breaker.
+        wait_until = time.monotonic() + 5.0
+        while time.monotonic() < wait_until and not gated[3].breaker.allows():
+            time.sleep(0.05)
+        assert gated[3].breaker.allows(), "breaker never re-closed after fault removal"
+        assert gated[3].is_online()
+        after = GLOBAL_DEGRADE.snapshot()
+        assert after["breaker_trips"] > before["breaker_trips"]
+        assert after["breaker_closes"] > before["breaker_closes"]
+        _, got = hz.layer.get_object("bb", "obj")
+        assert got == data
+
+
+class TestDeadlinePropagation:
+    def test_deadline_aborts_chaos_stalled_rpc_chain(self, lock_cluster):
+        """The issue's acceptance bound: a propagated 0.5s budget aborts an
+        RPC chain stalled by an injected slow link in well under 2s, instead
+        of riding the channel's full 30s timeout."""
+        url = lock_cluster["urls"][0]
+        client = RestClient(url + LOCK_PREFIX, TOKEN)
+        args = {"resource": "dl/res", "uid": "u1"}
+        assert client.call("/refresh", args) == {"ok": False}  # channel healthy
+
+        port = url.rsplit(":", 1)[1]
+        fid = REGISTRY.arm(
+            FaultSpec(kind=SLOW_RPC, target=f"127.0.0.1:{port}", delay_ms=800)
+        )
+        try:
+            t0 = time.monotonic()
+            with deadline.scope(0.5):
+                with pytest.raises(errors.DeadlineExceeded):
+                    client.call("/refresh", args)
+            wall = time.monotonic() - t0
+        finally:
+            REGISTRY.disarm(fid)
+        assert wall < 2.0, f"deadline abort took {wall:.3f}s"
+        # Outside the scope the budget is gone and the channel still works.
+        assert client.call("/refresh", args) == {"ok": False}
+
+    def test_deadline_caps_socket_timeout_in_flight(self):
+        """A peer that accepts but never answers: the remaining budget caps
+        the socket timeout, and the capped timeout surfaces as
+        DeadlineExceeded (budget spent) rather than DiskNotFound."""
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        s.listen(1)  # handshake completes; no byte is ever answered
+        port = s.getsockname()[1]
+        try:
+            client = RestClient(f"http://127.0.0.1:{port}", TOKEN)
+            t0 = time.monotonic()
+            with deadline.scope(0.4):
+                with pytest.raises(errors.DeadlineExceeded):
+                    client.call("/refresh", {"resource": "x", "uid": "u"})
+            assert time.monotonic() - t0 < 2.0
+        finally:
+            s.close()
+
+    def test_expired_deadline_aborts_erasure_get(self, tmp_path):
+        hz, _ = chaos_harness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("db")
+        hz.layer.put_object("db", "obj", bytes(300_000))  # > inline threshold
+        before = GLOBAL_DEGRADE.snapshot()
+        with deadline.scope(0.001):
+            time.sleep(0.005)  # spend the budget before the read starts
+            with pytest.raises(errors.DeadlineExceeded):
+                hz.layer.get_object("db", "obj")
+        after = GLOBAL_DEGRADE.snapshot()
+        assert (
+            after["deadline_aborts"].get("erasure-get", 0)
+            > before["deadline_aborts"].get("erasure-get", 0)
+        )
+
+    def test_multipart_deadline_expiry_leaks_no_stage_files(self, tmp_path, monkeypatch):
+        """Deadline expiry mid-part-upload aborts with DeadlineExceeded and
+        the staged shard files are cleaned up on every drive (the
+        no-leaked-stage-files invariant of the put cleanup path)."""
+        import minio_tpu.object.erasure as erasure_mod
+
+        monkeypatch.setattr(erasure_mod, "GROUP_BLOCKS", 2)
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("mdb")
+        mp = hz.layer.multipart
+        uid = mp.new_multipart_upload("mdb", "obj")
+        data = bytes(3 << 20)  # 3 blocks: the check fires at the group boundary
+        before = GLOBAL_DEGRADE.snapshot()
+        with deadline.scope(0.001):
+            time.sleep(0.005)
+            with pytest.raises(errors.DeadlineExceeded):
+                mp.put_object_part("mdb", "obj", uid, 1, data)
+        after = GLOBAL_DEGRADE.snapshot()
+        assert (
+            after["deadline_aborts"].get("multipart-put", 0)
+            > before["deadline_aborts"].get("multipart-put", 0)
+        )
+        leaked = [
+            os.path.join(root, f)
+            for d in hz.dirs
+            for root, _, files in os.walk(d)
+            for f in files
+            if ".tmp." in f
+        ]
+        assert not leaked, f"stage files leaked past the deadline abort: {leaked}"
+        # The upload itself survives: only the aborted part was rolled back.
+        assert mp.list_parts("mdb", "obj", uid) == []
+
+    def test_streaming_put_deadline_expiry_cleans_up(self, tmp_path, monkeypatch):
+        import minio_tpu.object.erasure as erasure_mod
+
+        monkeypatch.setattr(erasure_mod, "GROUP_BLOCKS", 2)
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("sdb")
+        with deadline.scope(0.001):
+            time.sleep(0.005)
+            with pytest.raises(errors.DeadlineExceeded):
+                hz.layer.put_object("sdb", "big", bytes(3 << 20))
+        with pytest.raises(errors.ObjectNotFound):
+            hz.layer.get_object("sdb", "big")
+        leaked = [
+            f
+            for d in hz.dirs
+            for _, _, files in os.walk(d)
+            for f in files
+            if ".tmp." in f or f.startswith("part.")
+        ]
+        assert not leaked, f"shards leaked past the deadline abort: {leaked}"
 
 
 # ---------------------------------------------------------------------------

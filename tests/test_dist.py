@@ -598,6 +598,27 @@ class TestDynamicTimeout:
             dt.log_success(0.01)
         assert dt.timeout() == pytest.approx(1.0)
 
+    def test_mid_band_failure_rate_leaves_timeout_unchanged(self):
+        """10-33% failures is the hysteresis band: neither grow nor shrink,
+        so a channel with occasional blips doesn't flap between sizes."""
+        from minio_tpu.dist.transport import DynamicTimeout
+
+        dt = DynamicTimeout(30.0, minimum=1.0)
+        # 3/16 = 18.75% failures -- inside (10%, 33%).
+        for i in range(16):
+            if i % 6 == 0:
+                dt.log_failure()
+            else:
+                dt.log_success(0.05)
+        assert dt.timeout() == pytest.approx(30.0)
+        # The band holds across repeated windows, not just the first.
+        for i in range(32):
+            if i % 8 == 0:
+                dt.log_failure()  # 12.5% failures
+            else:
+                dt.log_success(0.05)
+        assert dt.timeout() == pytest.approx(30.0)
+
     def test_rest_client_uses_tuned_timeout(self, cluster):
         node0 = cluster["nodes"][0]
         peer = PeerClient(cluster["urls"][1], node0.token)

@@ -7,12 +7,16 @@ commit, lock servers dropping quorum mid-hold -- and assert the recovery
 invariants (quorum reads, MRF re-drive, heal convergence, bit-identical reads
 after heal).
 
-    python tools/chaos_check.py           # full matrix, including `slow`
-    python tools/chaos_check.py --fast    # tier-1 smoke slice only
+    python tools/chaos_check.py               # full matrix, including `slow`
+    python tools/chaos_check.py --fast        # tier-1 smoke slice only
+    python tools/chaos_check.py --invariants  # degradation slice: breaker /
+                                              # hedged-read / deadline scenarios
 
 Exit status is pytest's, so this drops straight into CI. Scenarios are
 collected from the scenario file directly (pytest accepts an explicit path
-regardless of its test-file naming convention).
+regardless of its test-file naming convention). Before any scenario runs,
+the deadline-propagation lint (tools/deadline_lint.py) gates the tree: a
+hop that lost the budget plumbing fails here, not in a live cluster.
 """
 
 from __future__ import annotations
@@ -25,9 +29,20 @@ TIMEOUT_S = int(os.environ.get("CHAOS_CHECK_TIMEOUT_S", "900"))
 
 
 def main() -> int:
+    flags = {"--fast", "--invariants"}
     fast = "--fast" in sys.argv[1:]
-    extra = [a for a in sys.argv[1:] if a != "--fast"]
+    invariants = "--invariants" in sys.argv[1:]
+    extra = [a for a in sys.argv[1:] if a not in flags]
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    # Static gate first: the recovery scenarios assume the deadline rides
+    # every hop; don't burn minutes of chaos on a tree that already lost it.
+    from deadline_lint import main as lint_main
+
+    rc = lint_main()
+    if rc != 0:
+        return rc
+
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [
         sys.executable, "-m", "pytest", "-q",
@@ -36,6 +51,8 @@ def main() -> int:
     ]
     if fast:
         cmd += ["-m", "not slow"]
+    if invariants:
+        cmd += ["-k", "breaker or hedged or deadline or Hedged or Breaker or Deadline"]
     cmd += extra
     try:
         proc = subprocess.run(cmd, cwd=root, env=env, timeout=TIMEOUT_S)
