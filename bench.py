@@ -116,6 +116,42 @@ CONCURRENT_PUTS = 8
 CONCURRENT_SIZE = 16 << 20
 
 
+def _stage_breakdown(snap: dict, phase: str, leaves: tuple[str, ...]) -> dict:
+    """Per-stage share of a bench phase from a perf-ledger snapshot.
+
+    `leaves` are DISJOINT object-layer stages; "other" is the end-to-end
+    root-span total minus the leaf sums, so the stage sums equal the
+    measured end-to-end time by construction (an honest remainder, not a
+    fudge factor -- it is the unattributed pipeline cost the ISSUE wants
+    localized)."""
+    from minio_tpu.control.perf import quantile
+
+    stages = snap.get("stages", {})
+    obj = stages.get("object", {})
+    root = stages.get("bench", {}).get(phase)
+    e2e_s = root["sum"] if root else 0.0
+    n = sum(root["counts"]) if root else 0
+    rows: dict[str, dict] = {}
+    leaf_total = 0.0
+    for name in leaves:
+        h = obj.get(name)
+        if not h:
+            continue
+        leaf_total += h["sum"]
+        rows[name] = {
+            "total_ms": round(h["sum"] * 1e3, 1),
+            "count": sum(h["counts"]),
+            "p50_ms": round(quantile(h["counts"], 0.5) * 1e3, 3),
+            "share": round(h["sum"] / e2e_s, 3) if e2e_s else 0.0,
+        }
+    other = max(e2e_s - leaf_total, 0.0)
+    rows["other"] = {
+        "total_ms": round(other * 1e3, 1),
+        "share": round(other / e2e_s, 3) if e2e_s else 0.0,
+    }
+    return {"ops": n, "end_to_end_ms": round(e2e_s * 1e3, 1), "stages": rows}
+
+
 def object_layer_metrics(use_device: bool) -> dict:
     """PutObject / heal / concurrent-PUT throughput through ErasureObjects
     over 16 local drives (runPutObjectBenchmark + verify-healing roles,
@@ -125,6 +161,8 @@ def object_layer_metrics(use_device: bool) -> dict:
     import statistics
     import tempfile
 
+    from minio_tpu.control import tracing
+    from minio_tpu.control.perf import GLOBAL_PERF
     from minio_tpu.object.erasure import ErasureObjects
     from minio_tpu.storage import format as fmt
     from minio_tpu.storage.local import LocalDrive
@@ -159,13 +197,19 @@ def object_layer_metrics(use_device: bool) -> dict:
         layer.delete_object("bench", "warm1")
 
         # --- BASELINE #4: serial PutObject (GiB/s + p50 latency) -----------
+        # Each op runs under a bench root span so the always-on stage ledger
+        # (control/perf.py) attributes where the wall clock went; the ledger
+        # is reset per phase so the breakdown covers exactly these ops.
+        GLOBAL_PERF.ledger.reset()
         lat = []
         for i in range(PUT_OBJECTS):
             t0 = time.perf_counter()
-            layer.put_object("bench", f"o-{i}", body)
+            with tracing.root_span("bench.put", "bench", f"bench-put-{i}"):
+                layer.put_object("bench", f"o-{i}", body)
             lat.append(time.perf_counter() - t0)
             layer.delete_object("bench", f"o-{i}")  # bound disk use, off-clock
         total = sum(lat)
+        put_snap = GLOBAL_PERF.ledger.snapshot()
         out["putobject_gibs"] = round(PUT_OBJECTS * PUT_SIZE / total / (1 << 30), 3)
         out["putobject_p50_ms"] = round(statistics.median(lat) * 1000, 1)
 
@@ -190,13 +234,22 @@ def object_layer_metrics(use_device: bool) -> dict:
                 n += len(c)
             return n
         assert read_once() == PUT_SIZE
+        GLOBAL_PERF.ledger.reset()
         t0 = time.perf_counter()
         get_iters = 4
-        for _ in range(get_iters):
-            read_once()
+        for gi in range(get_iters):
+            with tracing.root_span("bench.get", "bench", f"bench-get-{gi}"):
+                read_once()
         out["getobject_gibs"] = round(
             get_iters * PUT_SIZE / (time.perf_counter() - t0) / (1 << 30), 3
         )
+        get_snap = GLOBAL_PERF.ledger.snapshot()
+        out["stage_breakdown"] = {
+            "put": _stage_breakdown(
+                put_snap, "bench.put", ("encode", "shard-fanout", "commit")
+            ),
+            "get": _stage_breakdown(get_snap, "bench.get", ("shard-read", "decode")),
+        }
         layer.delete_object("bench", "getobj")
 
         # --- 8-concurrent-PUT aggregate (batching fan-in under load) -------

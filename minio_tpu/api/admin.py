@@ -522,6 +522,77 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             raise S3Error("NotImplemented")
         return web.Response(text=ctx.metrics.render(), content_type="text/plain")
 
+    def h_perf(request, body):
+        """Performance attribution surface (the always-on stage ledger):
+        per-(layer, stage) p50/p95/p99 plus drive EWMAs and breaker state.
+        ?cluster=1 merges every peer's ledger into one view; ?reset=1 zeroes
+        the ledger, slow-capture ring, and drive EWMAs for a clean
+        before/after measurement window (fanned out with ?cluster=1)."""
+        from ..control.perf import GLOBAL_PERF, merge_snapshots, summarize
+
+        q = request.rel_url.query
+        reset = q.get("reset", "") in ("1", "true")
+        cluster = q.get("cluster", "") in ("1", "true")
+
+        snap = GLOBAL_PERF.ledger.snapshot()
+        out: dict = {
+            "node": {"stages": summarize(snap)},
+            "slow": GLOBAL_PERF.slow.stats(),
+        }
+
+        drives = {}
+        for p in ctx.layer.pools:
+            for d in p.disks:
+                lat_fn = getattr(d, "api_latencies", None)
+                ep_fn = getattr(d, "endpoint", None)
+                if lat_fn is None or ep_fn is None:
+                    continue
+                try:
+                    row: dict = {"api": lat_fn()}
+                    state_fn = getattr(d, "breaker_state", None)
+                    if state_fn is not None:
+                        row["breaker"] = state_fn()
+                    drives[ep_fn()] = row
+                except oerr.StorageError:
+                    continue
+        out["drives"] = drives
+
+        if cluster:
+            snaps = [snap]
+            peers = {}
+            notification = ctx.notification
+            for p in getattr(notification, "peers", ()) or ():
+                try:
+                    r = p.perf_snapshot(reset=reset, timeout=5.0)
+                    snaps.append(r.get("snapshot", {}))
+                    peers[p.url] = {"ok": True, "slow": r.get("slow", {})}
+                except oerr.StorageError as e:
+                    peers[p.url] = {"ok": False, "error": str(e)}
+            out["cluster"] = {"stages": summarize(merge_snapshots(snaps))}
+            out["peers"] = peers
+
+        if reset:
+            # Reset LAST: the response still reports the window being closed.
+            GLOBAL_PERF.ledger.reset()
+            GLOBAL_PERF.slow.reset()
+            for p in ctx.layer.pools:
+                for d in p.disks:
+                    fn = getattr(d, "reset_api_latencies", None)
+                    if fn is not None:
+                        fn()
+            out["reset"] = True
+        return out
+
+    def h_perf_slow(request, body):
+        """Captured slow-request span trees, newest first, plus the knobs
+        and eviction counters bounding the ring."""
+        from ..control.perf import GLOBAL_PERF
+
+        return {
+            "stats": GLOBAL_PERF.slow.stats(),
+            "traces": GLOBAL_PERF.slow.list(),
+        }
+
     def h_speedtest(request, body):
         """Autotuning self-benchmark (cmd/utils.go:976 speedTest): ramp
         concurrency, doubling while aggregate throughput keeps improving,
@@ -888,6 +959,8 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_post("/force-unlock", handler(h_force_unlock))
     app.router.add_post("/service", handler(h_service))
     app.router.add_get("/metrics", handler(h_metrics))
+    app.router.add_get("/perf", handler(h_perf))
+    app.router.add_get("/perf/slow", handler(h_perf_slow))
     app.router.add_post("/speedtest", handler(h_speedtest))
     app.router.add_post("/profile/start", handler(h_profile_start))
     app.router.add_post("/profile/stop", handler(h_profile_stop))

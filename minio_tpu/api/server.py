@@ -536,9 +536,10 @@ class S3Server:
         if clen is not None and clen > MAX_OBJECT_SIZE + (1 << 20):
             raise S3Error("EntityTooLarge")
         base = _RequestBodyReader(request, asyncio.get_running_loop())
-        access_key, reader = await asyncio.to_thread(
-            self._authenticate_streaming, request, base
-        )
+        with tracing.span("auth", "api"):
+            access_key, reader = await asyncio.to_thread(
+                self._authenticate_streaming, request, base
+            )
         request["access_key"] = access_key
         q = request.rel_url.query
         action = policy_mod.s3_action("PUT", bucket, key, q)
@@ -651,7 +652,8 @@ class S3Server:
             and not ({"tagging", "retention", "legal-hold", "acl"} & set(request.rel_url.query))
         ):
             return await self._streaming_put_entry(request, bucket, key)
-        body = await request.read()
+        with tracing.span("body-read", "api"):
+            body = await request.read()
         # POST policy form uploads authenticate via the policy signature in
         # the form, not request headers (PostPolicyBucketHandler equivalent).
         ctype = request.headers.get("Content-Type", "")
@@ -664,7 +666,8 @@ class S3Server:
             return await asyncio.to_thread(
                 self._post_policy_upload, bucket, body, ctype, request
             )
-        access_key, body = await asyncio.to_thread(self._authenticate, request, body)
+        with tracing.span("auth", "api"):
+            access_key, body = await asyncio.to_thread(self._authenticate, request, body)
         request["access_key"] = access_key
         q = request.rel_url.query
 
@@ -2295,6 +2298,10 @@ class S3Server:
         resp.content_length = plan.content_length
         await resp.prepare(request)
         it = plan.iterator
+        # One span over the whole body stream: covers both pulling chunks
+        # out of the (lazy) erasure read generator and pushing them onto
+        # the socket -- the time a GET spends after headers.
+        wr = tracing.span("response-write", "api", bytes=plan.content_length)
         try:
             while True:
                 chunk = await asyncio.to_thread(next, it, None)
@@ -2307,6 +2314,7 @@ class S3Server:
             # a second set of headers into the half-sent body and leave
             # the client waiting out the original length. Close the
             # connection instead so the client fails fast on truncation.
+            wr.finish(error=type(e).__name__)
             cur = tracing.current()
             if cur is not None:
                 cur.set(stream_aborted=type(e).__name__)
@@ -2315,6 +2323,7 @@ class S3Server:
             if request.transport is not None:
                 request.transport.close()
         else:
+            wr.finish()
             with contextlib.suppress(Exception):
                 await resp.write_eof()
         return resp

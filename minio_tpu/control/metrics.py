@@ -197,6 +197,7 @@ class MetricsSys:
 
         self._render_drives(metric)
         self._render_codec(metric)
+        self._render_perf(lines)
         self._render_heal_scanner(metric)
         self._render_chaos(metric)
         self._render_degrade(metric)
@@ -342,6 +343,18 @@ class MetricsSys:
                 help_="1 when the probe found a usable accelerator.",
                 type_="gauge",
             )
+        # Native host-kernel availability WITHOUT triggering a load: a
+        # scrape must never kick off the g++ build path. Rendered before
+        # the device-codec section so it exists on host-codec nodes too.
+        from ..ops import native
+
+        tried, loaded = native.status()
+        metric("minio_tpu_native_codec_probe_done", 1 if tried else 0,
+               help_="1 once the native host-kernel load was attempted.",
+               type_="gauge")
+        metric("minio_tpu_native_codec_available", 1 if loaded else 0,
+               help_="1 when the native host kernels are loaded (0 = numpy fallback).",
+               type_="gauge")
         codec = codec_mod._default  # read-only peek: a scrape must not install
         stats_fn = getattr(codec, "stats", None)
         if stats_fn is None:
@@ -383,12 +396,47 @@ class MetricsSys:
                 {"kernel": kernel},
                 help_="Wall time inside device kernels.",
             )
+        if "compiled_verify_lens" in st:
+            metric(
+                "minio_tpu_codec_compiled_verify_lengths", st["compiled_verify_lens"],
+                help_="Distinct non-standard chunk lengths admitted to the "
+                      "device verify compile cache (capped at 8).",
+                type_="gauge",
+            )
         depths_fn = getattr(codec, "queue_depths", None)
         if depths_fn is not None:
             for geom, depth in sorted(depths_fn().items()):
                 metric("minio_tpu_codec_queue_depth", depth, {"geometry": geom},
                        help_="Pending encode requests per batch worker.",
                        type_="gauge")
+
+    def _render_perf(self, lines: list[str]) -> None:
+        """Stage-ledger exposition: one Prometheus histogram per
+        (layer, stage) from the always-on perf ledger (control/perf.py).
+        Hand-rendered like the s3 request histogram above -- cumulative
+        buckets, +Inf, _sum/_count."""
+        from .perf import BUCKET_LE_S, GLOBAL_PERF
+
+        snap = GLOBAL_PERF.ledger.snapshot()
+        stages = snap.get("stages", {})
+        if not stages:
+            return
+        name = "minio_tpu_stage_duration_seconds"
+        lines.append(f"# HELP {name} Per-stage latency distribution (perf ledger).")
+        lines.append(f"# TYPE {name} histogram")
+        for layer in sorted(stages):
+            for stage in sorted(stages[layer]):
+                row = stages[layer][stage]
+                counts = row["counts"]
+                lab = f'layer="{layer}",stage="{stage}"'
+                cum = 0
+                for i, le in enumerate(BUCKET_LE_S):
+                    cum += counts[i]
+                    lines.append(f'{name}_bucket{{{lab},le="{le:.6g}"}} {cum}')
+                cum += counts[-1]
+                lines.append(f'{name}_bucket{{{lab},le="+Inf"}} {cum}')
+                lines.append(f'{name}_sum{{{lab}}} {round(row["sum"], 6)}')
+                lines.append(f'{name}_count{{{lab}}} {cum}')
 
     def _render_heal_scanner(self, metric) -> None:
         """Heal + scanner progress counters (healmgr/MRF/disk-heal/scanner)."""

@@ -1,0 +1,371 @@
+"""Always-on performance attribution: stage-latency ledger + slow-request capture.
+
+The trace hub (pubsub.py) is zero-overhead BY DESIGN when nobody subscribes,
+which also means the server normally has no idea where a request's time went
+-- BENCH runs showed the codec sustaining ~9x the end-to-end PUT throughput
+with nothing able to attribute the gap. This module is the always-on
+counterpart: every finished span increments a fixed-size log2-bucket
+histogram keyed by (layer, stage), whether or not anyone is watching the
+hub. Recording is a bucket increment under a sharded lock -- O(microseconds)
+-- so it can stay armed in production.
+
+Three pieces:
+  * StageLedger -- lock-sharded (layer, stage) -> log2 latency histogram
+    (1 us .. ~134 s upper edges, then +Inf), with mergeable/serializable
+    snapshots so peers can aggregate a cluster view and the bench can diff
+    before/after a run.
+  * SlowRequestCapture -- requests whose ROOT span exceeds a budget keep
+    their full span tree in a bounded ring (count + byte capped, evictions
+    counted), dumped to the audit hub when it has listeners.
+  * PerfSys / GLOBAL_PERF -- the process singleton tracing.Span.finish()
+    feeds unconditionally.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+
+# -- bucket scheme ------------------------------------------------------------
+
+# Upper bucket edges in MICROSECONDS: 2^0 .. 2^27 us (1 us .. ~134 s), log2
+# spaced so one fixed array spans storage-call latencies and wedged-request
+# timeouts alike. Values past the last edge land in the +Inf slot.
+N_BUCKETS = 28
+BUCKET_LE_US = tuple(float(1 << i) for i in range(N_BUCKETS))
+BUCKET_LE_S = tuple(us / 1e6 for us in BUCKET_LE_US)
+
+
+def bucket_index(seconds: float) -> int:
+    """Slot for a duration: smallest i with seconds <= 2^i us; N_BUCKETS
+    (the +Inf slot) past the last edge. Negative/zero clamps to slot 0."""
+    us = int(seconds * 1e6)
+    if us <= 1:
+        return 0
+    i = (us - 1).bit_length()  # ceil(log2(us)) for us >= 2
+    return i if i < N_BUCKETS else N_BUCKETS
+
+
+class _Hist:
+    __slots__ = ("counts", "sum")
+
+    def __init__(self):
+        self.counts = [0] * (N_BUCKETS + 1)  # [..edges.., +Inf]
+        self.sum = 0.0
+
+
+# -- stage ledger -------------------------------------------------------------
+
+_N_SHARDS = 8  # power of two: shard pick is a mask
+
+
+class StageLedger:
+    """Fixed-bucket latency histograms keyed by (layer, stage).
+
+    Lock-sharded by key hash so concurrent recorders of different stages
+    (drive fan-out threads, codec workers, the event loop) don't contend on
+    one mutex. A record is: one hash, one lock, two adds.
+    """
+
+    def __init__(self):
+        self._shards: list[dict[tuple[str, str], _Hist]] = [
+            {} for _ in range(_N_SHARDS)
+        ]
+        self._locks = [threading.Lock() for _ in range(_N_SHARDS)]
+
+    def record(self, layer: str, stage: str, seconds: float) -> None:
+        key = (layer, stage)
+        si = hash(key) & (_N_SHARDS - 1)
+        with self._locks[si]:
+            shard = self._shards[si]
+            h = shard.get(key)
+            if h is None:
+                h = shard[key] = _Hist()
+            h.counts[bucket_index(seconds)] += 1
+            h.sum += seconds
+
+    def snapshot(self) -> dict:
+        """JSON/msgpack-able copy: {"buckets_us": [...], "stages":
+        {layer: {stage: {"counts": [...], "sum": s}}}}. Mergeable with
+        merge_snapshots() -- peers ship these for the cluster view."""
+        stages: dict[str, dict[str, dict]] = {}
+        for lock, shard in zip(self._locks, self._shards):
+            with lock:
+                items = [(k, list(h.counts), h.sum) for k, h in shard.items()]
+            for (layer, stage), counts, total in items:
+                stages.setdefault(layer, {})[stage] = {
+                    "counts": counts,
+                    "sum": total,
+                }
+        return {"buckets_us": list(BUCKET_LE_US), "stages": stages}
+
+    def reset(self) -> None:
+        for lock, shard in zip(self._locks, self._shards):
+            with lock:
+                shard.clear()
+
+
+def merge_snapshots(snaps: list[dict]) -> dict:
+    """Element-wise sum of ledger snapshots (associative + commutative --
+    the cluster view must not depend on peer answer order). Snapshots with
+    a different bucket count (version skew) are skipped rather than
+    corrupting the merge."""
+    out: dict[str, dict[str, dict]] = {}
+    for snap in snaps:
+        if not snap or len(snap.get("buckets_us", ())) != N_BUCKETS:
+            continue
+        for layer, stages in snap.get("stages", {}).items():
+            dst_layer = out.setdefault(layer, {})
+            for stage, h in stages.items():
+                dst = dst_layer.get(stage)
+                if dst is None:
+                    dst_layer[stage] = {
+                        "counts": list(h["counts"]),
+                        "sum": float(h["sum"]),
+                    }
+                else:
+                    dst["counts"] = [
+                        a + b for a, b in zip(dst["counts"], h["counts"])
+                    ]
+                    dst["sum"] += h["sum"]
+    return {"buckets_us": list(BUCKET_LE_US), "stages": out}
+
+
+def quantile(counts: list[int], q: float) -> float:
+    """Estimated q-quantile in SECONDS from a bucket array: the upper edge
+    of the bucket holding the q-th observation (correct to within one
+    bucket width by construction). The +Inf slot reports twice the last
+    finite edge -- a sentinel, not a measurement."""
+    total = sum(counts)
+    if total <= 0:
+        return 0.0
+    target = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        cum += c
+        if cum >= target and c > 0 or cum >= total:
+            if i >= N_BUCKETS:
+                return BUCKET_LE_S[-1] * 2
+            return BUCKET_LE_S[i]
+    return BUCKET_LE_S[-1] * 2
+
+
+def summarize(snap: dict) -> dict:
+    """Admin-payload shape: per (layer, stage) count/total plus p50/p95/p99
+    (milliseconds -- the unit operators reason about request stages in)."""
+    out: dict[str, dict[str, dict]] = {}
+    for layer, stages in snap.get("stages", {}).items():
+        for stage, h in stages.items():
+            counts = h["counts"]
+            n = sum(counts)
+            out.setdefault(layer, {})[stage] = {
+                "count": n,
+                "total_ms": round(h["sum"] * 1e3, 3),
+                "mean_ms": round(h["sum"] / n * 1e3, 3) if n else 0.0,
+                "p50_ms": round(quantile(counts, 0.50) * 1e3, 3),
+                "p95_ms": round(quantile(counts, 0.95) * 1e3, 3),
+                "p99_ms": round(quantile(counts, 0.99) * 1e3, 3),
+            }
+    return out
+
+
+# -- slow-request capture -----------------------------------------------------
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class SlowRequestCapture:
+    """Retain the full span tree of requests slower than a budget.
+
+    Spans are buffered per trace while the request runs (only for traces
+    this node ROOTED -- begin_trace()); when the root span finishes, the
+    buffer is either promoted into the capture ring (root duration >= the
+    budget) or discarded. Every buffer and the ring itself is hard-capped
+    (count AND bytes) with eviction counters, so a pathological workload
+    bounds observer memory instead of growing it.
+
+    Knobs (env): MTPU_SLOW_REQUEST_SECONDS (budget, default 1.0),
+    MTPU_SLOW_TRACE_RING (captures kept, default 32),
+    MTPU_SLOW_TRACE_RING_BYTES (approx byte cap, default 4 MiB),
+    MTPU_SLOW_TRACE_SPANS (spans kept per trace, default 512).
+    """
+
+    _APPROX_SPAN_BYTES = 200  # accounting unit: one buffered span record
+
+    def __init__(
+        self,
+        budget_s: float | None = None,
+        max_traces: int | None = None,
+        max_bytes: int | None = None,
+        max_spans_per_trace: int | None = None,
+        max_live_traces: int = 1024,
+    ):
+        self.budget_s = (
+            budget_s
+            if budget_s is not None
+            else _env_float("MTPU_SLOW_REQUEST_SECONDS", 1.0)
+        )
+        self.max_traces = (
+            max_traces if max_traces is not None else _env_int("MTPU_SLOW_TRACE_RING", 32)
+        )
+        self.max_bytes = (
+            max_bytes
+            if max_bytes is not None
+            else _env_int("MTPU_SLOW_TRACE_RING_BYTES", 4 << 20)
+        )
+        self.max_spans_per_trace = (
+            max_spans_per_trace
+            if max_spans_per_trace is not None
+            else _env_int("MTPU_SLOW_TRACE_SPANS", 512)
+        )
+        # In-flight traces are bounded too: a root span that never finishes
+        # (crashed handler, wedged stream) must not pin its buffer forever.
+        self.max_live_traces = max_live_traces
+        self._pending: "OrderedDict[str, list[dict]]" = OrderedDict()
+        self._ring: deque[dict] = deque()
+        self._ring_bytes = 0
+        self._lock = threading.Lock()
+        self.captured_total = 0
+        self.evicted_spans = 0  # spans dropped from over-full trace buffers
+        self.evicted_traces = 0  # buffers/captures dropped by the caps
+
+    def begin_trace(self, trace_id: str) -> None:
+        if not trace_id:
+            return
+        with self._lock:
+            if trace_id in self._pending:
+                return
+            while len(self._pending) >= self.max_live_traces:
+                self._pending.popitem(last=False)
+                self.evicted_traces += 1
+            self._pending[trace_id] = []
+
+    def wants(self, trace_id: str) -> bool:
+        """Lock-free membership peek: the hot path builds a span record
+        only for traces this node is actually buffering."""
+        return trace_id in self._pending
+
+    def observe(self, rec: dict, is_root: bool, duration_s: float) -> None:
+        """Called by Span.finish() for buffered traces. Root spans settle
+        the trace: capture when over budget, drop otherwise."""
+        trace_id = rec.get("trace", "")
+        entry = None
+        with self._lock:
+            buf = self._pending.get(trace_id)
+            if buf is None:
+                return
+            if len(buf) < self.max_spans_per_trace:
+                buf.append(rec)
+            else:
+                self.evicted_spans += 1
+            if not is_root:
+                return
+            del self._pending[trace_id]
+            if duration_s < self.budget_s:
+                return
+            entry = {
+                "trace": trace_id,
+                "root": rec.get("name", ""),
+                "layer": rec.get("layer", ""),
+                "duration_ms": round(duration_s * 1e3, 3),
+                "time": time.time(),
+                "spans": buf,
+            }
+            self.captured_total += 1
+            self._ring.append(entry)
+            self._ring_bytes += self._APPROX_SPAN_BYTES * (len(buf) + 1)
+            while self._ring and (
+                len(self._ring) > self.max_traces or self._ring_bytes > self.max_bytes
+            ):
+                old = self._ring.popleft()
+                self._ring_bytes -= self._APPROX_SPAN_BYTES * (
+                    len(old.get("spans", ())) + 1
+                )
+                self.evicted_traces += 1
+        # Audit dump outside the lock: listeners (audit targets / the live
+        # audit hub) see each capture as one record.
+        if entry is not None:
+            try:
+                from .logging import GLOBAL_LOGGER
+
+                GLOBAL_LOGGER.audit(
+                    api="SlowRequestCapture",
+                    request_id=trace_id,
+                    duration_ms=entry["duration_ms"],
+                    root=entry["root"],
+                    span_count=len(entry["spans"]),
+                )
+            except Exception:  # noqa: BLE001 - capture must never fail a request
+                pass
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            return list(reversed(self._ring))  # newest first
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "budget_ms": round(self.budget_s * 1e3, 3),
+                "captured_total": self.captured_total,
+                "retained": len(self._ring),
+                "retained_bytes_approx": self._ring_bytes,
+                "pending_traces": len(self._pending),
+                "evicted_spans": self.evicted_spans,
+                "evicted_traces": self.evicted_traces,
+                "max_traces": self.max_traces,
+                "max_bytes": self.max_bytes,
+                "max_spans_per_trace": self.max_spans_per_trace,
+            }
+
+    def reset(self) -> None:
+        """Drop retained captures (the ?reset= knob). Cumulative eviction/
+        capture counters survive -- they are rate signals, not state."""
+        with self._lock:
+            self._ring.clear()
+            self._ring_bytes = 0
+
+
+# -- process singleton --------------------------------------------------------
+
+
+class PerfSys:
+    """What tracing.Span.finish() feeds: the ledger unconditionally, the
+    slow capture only for traces rooted on this node."""
+
+    def __init__(self):
+        self.ledger = StageLedger()
+        self.slow = SlowRequestCapture()
+
+    def on_span_finish(self, span, duration_s: float, error: str | None) -> None:
+        self.ledger.record(span.layer, span.name, duration_s)
+        if span.trace_id and self.slow.wants(span.trace_id):
+            rec = {
+                "name": span.name,
+                "layer": span.layer,
+                "trace": span.trace_id,
+                "span": span.span_id,
+                "parent": span.parent_id,
+                "duration_ms": round(duration_s * 1e3, 3),
+            }
+            if span.tags:
+                rec.update(span.tags)
+            if error:
+                rec["error"] = error
+            self.slow.observe(rec, is_root=span.parent_id == "", duration_s=duration_s)
+
+
+GLOBAL_PERF = PerfSys()

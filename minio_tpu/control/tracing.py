@@ -18,7 +18,12 @@ Context rules:
     so a distributed PUT yields ONE tree across nodes.
 
 Overhead discipline matches pubsub.py: when nobody subscribes to the hub,
-`span()` returns a shared no-op and no ids are generated.
+a bare `span()` outside any request returns a shared no-op and no ids are
+generated. Request roots (root_span) are ALWAYS real, because every finished
+span also feeds the stage ledger (control/perf.py) -- a bucket increment
+that stays armed with zero subscribers, so the server can attribute where
+request time went without a live trace watcher. Hub publishing remains
+subscriber-gated.
 """
 
 from __future__ import annotations
@@ -28,6 +33,7 @@ import secrets
 import time
 from typing import Iterator
 
+from .perf import GLOBAL_PERF
 from .pubsub import GLOBAL_TRACE, TraceSys
 
 # Trace context header for internode REST (alongside X-Mtpu-Token).
@@ -104,6 +110,12 @@ class Span:
         if self._closed:
             return
         self._closed = True
+        duration = time.perf_counter() - self.start
+        # The stage ledger records UNCONDITIONALLY -- attribution must not
+        # depend on someone watching the hub (control/perf.py).
+        GLOBAL_PERF.on_span_finish(self, duration, error)
+        if not self.sys.enabled():
+            return
         fields = dict(self.tags)
         if error:
             fields["error"] = error
@@ -114,7 +126,7 @@ class Span:
             trace=self.trace_id,
             span=self.span_id,
             parent=self.parent_id,
-            duration_ms=round((time.perf_counter() - self.start) * 1e3, 3),
+            duration_ms=round(duration * 1e3, 3),
             **fields,
         )
 
@@ -160,8 +172,11 @@ def current_header() -> str:
 def span(name: str, layer: str, sys: TraceSys | None = None, **tags):
     """Open a child span of the current context (or a fresh root).
 
-    Returns the shared no-op when the hub has no subscribers AND no trace
-    is active -- the zero-overhead publish guard, lifted to span granularity.
+    Returns the shared no-op when there is NO parent span and the hub has
+    no subscribers -- orphan spans (background sweeps outside any request)
+    keep the zero-overhead guard. Inside a request there is always a parent
+    (root_span is unconditional), so stage marks on the hot path are real
+    and feed the ledger whether or not anyone watches the hub.
     """
     tsys = sys or GLOBAL_TRACE
     parent = _current.get()
@@ -174,10 +189,13 @@ def span(name: str, layer: str, sys: TraceSys | None = None, **tags):
 
 def root_span(name: str, layer: str, trace_id: str, sys: TraceSys | None = None, **tags):
     """Open a request root span with an EXPLICIT trace id (the S3 entry point
-    uses the x-amz-request-id, so trace and audit records join on one key)."""
+    uses the x-amz-request-id, so trace and audit records join on one key).
+
+    Always a real span: the root is what arms stage attribution for the
+    whole request tree (perf ledger + slow-request capture); publishing to
+    the hub still costs nothing without subscribers."""
     tsys = sys or GLOBAL_TRACE
-    if not tsys.enabled():
-        return NOOP
+    GLOBAL_PERF.slow.begin_trace(trace_id)
     return Span(name, layer, trace_id, "", tsys, **tags)
 
 

@@ -164,6 +164,17 @@ def make_peer_app(node, token: str) -> web.Application:
             return {"text": ""}
         return {"text": metrics.render_node()}
 
+    def h_perf(a):
+        """This node's stage-ledger snapshot (and optionally a reset): the
+        admin /perf?cluster=1 endpoint merges these into the cluster view."""
+        from ..control.perf import GLOBAL_PERF
+
+        if a.get("reset"):
+            GLOBAL_PERF.ledger.reset()
+            GLOBAL_PERF.slow.reset()
+        return {"snapshot": GLOBAL_PERF.ledger.snapshot(),
+                "slow": GLOBAL_PERF.slow.stats()}
+
     def h_chaos(a):
         """Peer side of the admin chaos fanout: arm/disarm/list faults in
         THIS node's process-global registry (chaos/faults.py). The arming
@@ -219,6 +230,7 @@ def make_peer_app(node, token: str) -> web.Application:
         "profilestop": h_profile_stop,
         "bandwidth": h_bandwidth,
         "metrics": h_node_metrics,
+        "perf": h_perf,
         "chaos": h_chaos,
     }.items():
         app.router.add_post(f"/{name}", handler(fn))
@@ -253,6 +265,9 @@ class PeerClient:
     def node_metrics(self, timeout: float | None = None) -> str:
         r = self.client.call("/metrics", {}, timeout=timeout)
         return r.get("text", "") if r else ""
+
+    def perf_snapshot(self, reset: bool = False, timeout: float | None = None) -> dict:
+        return self.client.call("/perf", {"reset": bool(reset)}, timeout=timeout) or {}
 
     def top_locks(self) -> list:
         return self.client.call("/toplocks", {})

@@ -22,6 +22,7 @@ from ..chaos import net as chaos_net
 from ..chaos.faults import REGISTRY as _CHAOS
 from ..control import tracing
 from ..control.degrade import GLOBAL_DEGRADE
+from ..control.perf import GLOBAL_PERF
 from ..utils import deadline, errors
 
 ERROR_HEADER = "X-Mtpu-Error"
@@ -118,6 +119,9 @@ class RestClient:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        # Per-peer ledger label: host:port, not the full prefixed URL --
+        # one histogram per (peer, endpoint path) in the perf ledger.
+        self._peer_label = self.base_url.split("//", 1)[-1].split("/", 1)[0]
         # One tuner PER ENDPOINT PATH: a ping and a bulk shard read must
         # not share a timeout (the reference keeps separate dynamicTimeouts
         # per operation class for the same reason). Floor at 5s so fast
@@ -225,6 +229,12 @@ class RestClient:
                 )
         except requests.RequestException as e:
             self._mark(False)
+            # Per-peer RPC histogram: recorded directly (not via the span,
+            # which is a no-op outside request context) so background RPCs
+            # -- heal, scanner, lock refresh -- are attributed too.
+            GLOBAL_PERF.ledger.record(
+                "rpc-peer", f"{path}@{self._peer_label}", time.monotonic() - t0
+            )
             rpc.finish(error=type(e).__name__)
             # A timeout on a deadline-capped hop is the BUDGET expiring, not
             # the channel misbehaving: surface DeadlineExceeded (aborts the
@@ -245,11 +255,13 @@ class RestClient:
             ):
                 dt.log_failure()
             raise errors.DiskNotFound(f"{url}: {e}")
+        elapsed = time.monotonic() - t0
+        GLOBAL_PERF.ledger.record("rpc-peer", f"{path}@{self._peer_label}", elapsed)
         rpc.set(status=r.status_code)
         rpc.finish()
         self._mark(True)
         if dt is not None:
-            dt.log_success(time.monotonic() - t0)
+            dt.log_success(elapsed)
         if r.status_code != 200:
             name = r.headers.get(ERROR_HEADER, "StorageError")
             text = r.text[:200]

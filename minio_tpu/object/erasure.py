@@ -232,17 +232,21 @@ class ShardStageWriter:
     def append_group(self, group: list[bytes]) -> None:
         if not group:
             return
-        if self._hashers is None:
-            row_frames = self.codec.encode_frames(group, self.k, self.m)
-        else:
-            # Whole-file layout: raw chunks, one running digest per row.
-            encoded = self.codec.encode(group, self.k, self.m)
-            row_frames = []
-            for row in range(self.k + self.m):
-                chunks = [e[0][row] for e in encoded]
-                for c in chunks:
-                    self._hashers[row].update(c)
-                row_frames.append(b"".join(chunks))
+        # Stage marks feed the always-on perf ledger: "encode" is the codec
+        # call, "shard-fanout" the parallel staged appends -- the two halves
+        # of where a streaming PUT's group time goes.
+        with tracing.span("encode", "object", blocks=len(group)):
+            if self._hashers is None:
+                row_frames = self.codec.encode_frames(group, self.k, self.m)
+            else:
+                # Whole-file layout: raw chunks, one running digest per row.
+                encoded = self.codec.encode(group, self.k, self.m)
+                row_frames = []
+                for row in range(self.k + self.m):
+                    chunks = [e[0][row] for e in encoded]
+                    for c in chunks:
+                        self._hashers[row].update(c)
+                    row_frames.append(b"".join(chunks))
 
         def wr(i):
             if not self.ok[i]:
@@ -251,9 +255,10 @@ class ShardStageWriter:
             self.disks[i].append_file(META_BUCKET, self.stage_path(i), row_frames[row])
 
         self._appended = True
-        for i, (_, e) in enumerate(meta_mod.parallel_map(wr, range(len(self.disks)))):
-            if e is not None:
-                self.ok[i] = False
+        with tracing.span("shard-fanout", "object", drives=len(self.disks)):
+            for i, (_, e) in enumerate(meta_mod.parallel_map(wr, range(len(self.disks)))):
+                if e is not None:
+                    self.ok[i] = False
 
     def alive(self) -> int:
         return sum(self.ok)
@@ -683,7 +688,8 @@ class ErasureObjects:
         size = len(data)
         etag = opts.etag or hashlib.md5(data).hexdigest()
         blocks = [data[i : i + BLOCK_SIZE] for i in range(0, size, BLOCK_SIZE)]
-        encoded = self.codec.encode(blocks, k, m) if blocks else []
+        with tracing.span("encode", "object", blocks=len(blocks)):
+            encoded = self.codec.encode(blocks, k, m) if blocks else []
         shard_files = [
             _frame_shard([e[0][row] for e in encoded], [e[1][row] for e in encoded])
             for row in range(k + m)
@@ -712,13 +718,17 @@ class ErasureObjects:
             )
             disk.write_metadata(bucket, object_name, fi)
 
-        lk = self.ns_lock.new(bucket, object_name)
-        if not lk.acquire(writer=True, timeout=30):
-            raise errors.ErasureWriteQuorum(bucket, object_name, "namespace lock timeout")
-        try:
-            results = meta_mod.parallel_map(write_one, list(enumerate(self._online())))
-        finally:
-            lk.release()
+        # Inline puts have no staging: the metadata write IS the commit.
+        with tracing.span("commit", "object", drives=self.drive_count):
+            lk = self.ns_lock.new(bucket, object_name)
+            if not lk.acquire(writer=True, timeout=30):
+                raise errors.ErasureWriteQuorum(
+                    bucket, object_name, "namespace lock timeout"
+                )
+            try:
+                results = meta_mod.parallel_map(write_one, list(enumerate(self._online())))
+            finally:
+                lk.release()
         errs = [e for _, e in results]
         n_ok = sum(1 for e in errs if e is None)
         if n_ok < write_quorum:
@@ -856,14 +866,19 @@ class ErasureObjects:
             )
             disks[i].rename_data(META_BUCKET, tmp_dir(i), fi, bucket, object_name)
 
-        lk = self.ns_lock.new(bucket, object_name)
-        if not lk.acquire(writer=True, timeout=30):
-            cleanup(range(n))
-            raise errors.ErasureWriteQuorum(bucket, object_name, "namespace lock timeout")
-        try:
-            results = meta_mod.parallel_map(commit, list(range(n)))
-        finally:
-            lk.release()
+        # The commit stage covers lock wait + rename_data quorum fan-out:
+        # both are serialization costs the encode pipeline can't hide.
+        with tracing.span("commit", "object", drives=n):
+            lk = self.ns_lock.new(bucket, object_name)
+            if not lk.acquire(writer=True, timeout=30):
+                cleanup(range(n))
+                raise errors.ErasureWriteQuorum(
+                    bucket, object_name, "namespace lock timeout"
+                )
+            try:
+                results = meta_mod.parallel_map(commit, list(range(n)))
+            finally:
+                lk.release()
         errs = [e for _, e in results]
         n_ok = sum(1 for e in errs if e is None)
         # Drop stragglers' staging dirs (committed drives' tmp dirs were
@@ -1196,7 +1211,10 @@ class ErasureObjects:
                 frames[j], oks[j] = result if result is not None else (None, None)
                 loaded[j] = True
 
-            gather_hedged(read_window, futures, issued_at, install)
+            # GET-side stage mark: the hedged shard gather is where a
+            # degraded or slow-drive read spends its time.
+            with tracing.span("shard-read", "object", drives=len(primaries)):
+                gather_hedged(read_window, futures, issued_at, install)
 
             def load_spares() -> None:
                 spare = [j for j in range(k + mth) if not loaded[j]]
@@ -1245,13 +1263,17 @@ class ErasureObjects:
                 if want:
                     pattern = tuple(r is not None for r in rows)
                     groups.setdefault((pattern, want), []).append(wi)
-            for (_, want), idxs in groups.items():
-                results = self.codec.reconstruct_batch(
-                    [rows_by_block[wi] for wi in idxs], k, mth, want
-                )
-                for wi, (chunks, _) in zip(idxs, results):
-                    for slot, j in enumerate(want):
-                        rows_by_block[wi][j] = chunks[slot]
+            if groups:
+                # Only a degraded window pays for (and reports) a decode
+                # stage; healthy reads skip the mark entirely.
+                with tracing.span("decode", "object", blocks=len(rows_by_block)):
+                    for (_, want), idxs in groups.items():
+                        results = self.codec.reconstruct_batch(
+                            [rows_by_block[wi] for wi in idxs], k, mth, want
+                        )
+                        for wi, (chunks, _) in zip(idxs, results):
+                            for slot, j in enumerate(want):
+                                rows_by_block[wi][j] = chunks[slot]
 
             for b in range(g0, g1 + 1):
                 joined = _join_block_rows(rows_by_block[b - g0], k, block_len(b))

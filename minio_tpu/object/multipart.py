@@ -199,11 +199,14 @@ class MultipartManager:
             )
             disks[i].write_all(META_BUCKET, f"{udir}/part.{part_number}.meta", part_doc)
 
-        results = meta_mod.parallel_map(publish, list(range(n)))
-        n_ok = sum(1 for _, e in results if e is None)
-        if n_ok < write_quorum:
-            cleanup()
-            raise errors.ErasureWriteQuorum(bucket, object_name, "upload part quorum")
+        # The rename-publish is the part's commit point (encode and
+        # shard-fanout already ride ShardStageWriter.append_group).
+        with tracing.span("commit", "object", drives=n):
+            results = meta_mod.parallel_map(publish, list(range(n)))
+            n_ok = sum(1 for _, e in results if e is None)
+            if n_ok < write_quorum:
+                cleanup()
+                raise errors.ErasureWriteQuorum(bucket, object_name, "upload part quorum")
         return ObjectPartInfo(part_number, size, size, mod_time, etag)
 
     def list_parts(
@@ -328,11 +331,12 @@ class MultipartManager:
             )
             disk.rename_data(META_BUCKET, tmp, fi, bucket, object_name)
 
-        results = meta_mod.parallel_map(commit, list(enumerate(self.eo._online())))
-        n_ok = sum(1 for _, e in results if e is None)
-        write_quorum = k + 1 if k == m else k
-        if n_ok < write_quorum:
-            raise errors.ErasureWriteQuorum(bucket, object_name, "complete quorum")
+        with tracing.span("commit", "object", drives=n, parts=len(part_infos)):
+            results = meta_mod.parallel_map(commit, list(enumerate(self.eo._online())))
+            n_ok = sum(1 for _, e in results if e is None)
+            write_quorum = k + 1 if k == m else k
+            if n_ok < write_quorum:
+                raise errors.ErasureWriteQuorum(bucket, object_name, "complete quorum")
         self.abort_multipart_upload(bucket, object_name, upload_id, missing_ok=True)
         oi = ObjectInfo(
             bucket=bucket,

@@ -123,6 +123,15 @@ def available() -> bool:
     return load() is not None
 
 
+def status() -> tuple[bool, bool]:
+    """(probe_attempted, loaded) WITHOUT triggering a load.
+
+    The metrics scrape needs a device-vs-CPU fallback gauge; calling
+    available() there could kick off a 120s g++ build inside a scrape.
+    """
+    return _tried, _lib is not None
+
+
 def _ptr(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
 

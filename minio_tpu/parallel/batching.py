@@ -30,6 +30,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from ..control import tracing
+from ..control.perf import GLOBAL_PERF
 from ..models.pipeline import ErasurePipeline, Geometry
 from ..object.codec import BlockCodec, HostCodec
 from ..ops import rs_matrix
@@ -149,7 +150,11 @@ class BatchingDeviceCodec(BlockCodec):
                 arr[i] = req.shards
             t0 = _time.perf_counter()
             shards, digests = pipe.encode(arr)
-            self.device_encode_seconds += _time.perf_counter() - t0
+            dt = _time.perf_counter() - t0
+            self.device_encode_seconds += dt
+            # Ledger record, not a span: worker threads run outside any
+            # request context, so a span here would be a silent no-op.
+            GLOBAL_PERF.ledger.record("codec", "encode-batch", dt)
             self.batches_run += 1
             self.blocks_encoded += b_real
             self.blocks_padded += b_pad
@@ -225,7 +230,9 @@ class BatchingDeviceCodec(BlockCodec):
             out = run_device_reconstruct(
                 self._pipelines[(k, m)], rows_batch, k, tuple(want), surv, s, with_digests
             )
-            self.device_recon_seconds += _time.perf_counter() - t0
+            dt = _time.perf_counter() - t0
+            self.device_recon_seconds += dt
+            GLOBAL_PERF.ledger.record("codec", "reconstruct-batch", dt)
             self.recon_batches_run += 1
             self.blocks_reconstructed += len(rows_batch)
             return out
@@ -281,7 +288,9 @@ class BatchingDeviceCodec(BlockCodec):
                 arr[i, 0] = np.frombuffer(c, dtype=np.uint8)
             t0 = _time.perf_counter()
             digs = np.asarray(pipe.verify_digests(arr))  # [n_pad, 1, 32]
-            self.device_verify_seconds += _time.perf_counter() - t0
+            dt = _time.perf_counter() - t0
+            self.device_verify_seconds += dt
+            GLOBAL_PERF.ledger.record("codec", "verify-batch", dt)
             self.verify_batches_run += 1
             self.digests_verified += len(sub)
             out.extend(digs[i, 0].tobytes() for i in range(len(sub)))
@@ -310,6 +319,7 @@ class BatchingDeviceCodec(BlockCodec):
             "device_encode_seconds": self.device_encode_seconds,
             "device_recon_seconds": self.device_recon_seconds,
             "device_verify_seconds": self.device_verify_seconds,
+            "compiled_verify_lens": len(self._verify_lens),
         }
 
     def close(self) -> None:

@@ -13,6 +13,8 @@ import inspect
 import threading
 import time
 
+from ..control.perf import GLOBAL_PERF
+
 # StorageAPI methods that hit the disk (the metered set).
 _METERED = frozenset(
     (
@@ -45,7 +47,12 @@ class MeteredDrive:
             return attr
 
         def record(t0: float, failed: bool) -> None:
-            ms = (time.perf_counter() - t0) * 1e3
+            dt = time.perf_counter() - t0
+            ms = dt * 1e3
+            # Always-on attribution: storage calls feed the stage ledger
+            # directly (one bucket increment) -- drive fan-out pool threads
+            # have no span context, so Span.finish can't cover them.
+            GLOBAL_PERF.ledger.record("storage", name, dt)
             with self._lock:
                 if failed:
                     self._errors[name] = self._errors.get(name, 0) + 1
@@ -127,3 +134,11 @@ class MeteredDrive:
                 }
                 for name in sorted(self._lat)
             }
+
+    def reset_api_latencies(self) -> None:
+        """Drop EWMAs/counts/errors (the /perf ?reset= knob): before/after
+        measurements need a clean slate, not an average polluted by boot."""
+        with self._lock:
+            self._lat.clear()
+            self._counts.clear()
+            self._errors.clear()

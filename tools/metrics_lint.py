@@ -12,7 +12,8 @@ Checks (validate_exposition):
     versa), each at most once, before the family's first sample
   * no duplicate samples (same name + identical label set)
   * histograms: bucket counts are monotone over increasing `le`, the +Inf
-    bucket exists and equals `_count`, and `_sum` is present
+    bucket exists and equals `_count`, `_sum` is present, and every label
+    set of a family exposes the same bucket boundaries
 
 Lints (lint_exposition):
   * duplicate series (a family declared or emitted under two TYPE lines)
@@ -186,6 +187,20 @@ def _check_histograms(text: str, type_names: dict[str, str]) -> list[str]:
             problems.append(f"{fam}{dict(base)}: missing _sum")
         if key not in counts:
             problems.append(f"{fam}{dict(base)}: missing _count")
+    # Bucket-boundary consistency: every label set of one histogram family
+    # must expose the SAME le edges -- Prometheus aggregations across label
+    # sets (sum by (le)) silently produce garbage on mixed boundaries.
+    fam_edges: dict[str, tuple[tuple[float, ...], tuple]] = {}
+    for (fam, base), series in sorted(buckets.items()):
+        edges = tuple(sorted(series))
+        first = fam_edges.get(fam)
+        if first is None:
+            fam_edges[fam] = (edges, base)
+        elif first[0] != edges:
+            problems.append(
+                f"{fam}{dict(base)}: bucket boundaries differ from "
+                f"{fam}{dict(first[1])} -- mixed le edges break aggregation"
+            )
     return problems
 
 

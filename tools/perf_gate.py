@@ -1,0 +1,122 @@
+#!/usr/bin/env python3
+"""Stage-share regression gate over BENCH JSON `stage_breakdown` objects.
+
+bench.py attributes its end-to-end PUT/GET wall clock to pipeline stages
+via the always-on perf ledger (control/perf.py). This gate compares the
+latest BENCH line's breakdown against the previous one and flags any stage
+whose SHARE of total latency grew by more than a threshold -- a share
+shift localizes a regression to a stage even when absolute times moved
+with the machine (shares are scale-free; GiB/s is not).
+
+A stage is flagged when BOTH hold:
+  * its share grew by more than `threshold` (absolute, e.g. 0.10 = ten
+    percentage points), and
+  * its absolute time grew too -- a share can grow because OTHER stages
+    got faster, which is an improvement, not a regression.
+
+Usage:
+    python tools/perf_gate.py OLD.json NEW.json [--threshold 0.10]
+
+Exit 0 = no stage regressed, 1 = regression(s) flagged, 2 = unusable
+input (missing/unparseable breakdowns -- the gate cannot vouch either
+way, callers decide whether that blocks).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+DEFAULT_THRESHOLD = 0.10  # share points a stage may grow before flagging
+
+
+def _breakdowns(bench: dict) -> dict:
+    """Phase -> breakdown from one BENCH JSON object (tolerates absence)."""
+    sb = bench.get("stage_breakdown")
+    return sb if isinstance(sb, dict) else {}
+
+
+def compare(old: dict, new: dict, threshold: float = DEFAULT_THRESHOLD) -> list[dict]:
+    """Regressed stages between two BENCH JSON objects.
+
+    Returns one record per flagged stage: phase, stage, old/new share,
+    old/new total_ms. Stages present on only one side are skipped (no
+    basis for a delta); phases compare independently.
+    """
+    flagged: list[dict] = []
+    old_sb, new_sb = _breakdowns(old), _breakdowns(new)
+    for phase, new_phase in new_sb.items():
+        old_phase = old_sb.get(phase)
+        if not isinstance(old_phase, dict):
+            continue
+        old_stages = old_phase.get("stages", {})
+        for stage, new_row in new_phase.get("stages", {}).items():
+            old_row = old_stages.get(stage)
+            if not isinstance(old_row, dict) or not isinstance(new_row, dict):
+                continue
+            d_share = float(new_row.get("share", 0.0)) - float(old_row.get("share", 0.0))
+            d_ms = float(new_row.get("total_ms", 0.0)) - float(old_row.get("total_ms", 0.0))
+            if d_share > threshold and d_ms > 0:
+                flagged.append(
+                    {
+                        "phase": phase,
+                        "stage": stage,
+                        "old_share": old_row.get("share", 0.0),
+                        "new_share": new_row.get("share", 0.0),
+                        "old_total_ms": old_row.get("total_ms", 0.0),
+                        "new_total_ms": new_row.get("total_ms", 0.0),
+                    }
+                )
+    return flagged
+
+
+def _load(path: str) -> dict | None:
+    """Last parseable JSON object line of a file (BENCH logs are JSONL;
+    the final line is the bench's one-object contract)."""
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError as e:
+        print(f"perf_gate: {path}: {e}", file=sys.stderr)
+        return None
+    for ln in reversed(lines):
+        try:
+            doc = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    print(f"perf_gate: {path}: no JSON object line", file=sys.stderr)
+    return None
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    threshold = DEFAULT_THRESHOLD
+    for a in argv:
+        if a.startswith("--threshold="):
+            threshold = float(a.split("=", 1)[1])
+    if len(args) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    old, new = _load(args[0]), _load(args[1])
+    if old is None or new is None:
+        return 2
+    if not _breakdowns(old) or not _breakdowns(new):
+        print("perf_gate: no stage_breakdown on one side; nothing to compare",
+              file=sys.stderr)
+        return 2
+    flagged = compare(old, new, threshold)
+    for f in flagged:
+        print(
+            f"REGRESSED {f['phase']}/{f['stage']}: share "
+            f"{f['old_share']:.3f} -> {f['new_share']:.3f}, "
+            f"{f['old_total_ms']:.1f} ms -> {f['new_total_ms']:.1f} ms"
+        )
+    if not flagged:
+        print("perf_gate: ok")
+    return 1 if flagged else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
