@@ -25,6 +25,7 @@ import time
 
 from aiohttp import web
 
+from ..control.logging import GLOBAL_LOGGER
 from ..utils import errors as oerr
 from .jwt import JWTError, sign_hs256, verify as jwt_verify
 from .server import _display_size
@@ -97,8 +98,8 @@ def make_console_app(ctx) -> web.Application:
         if scanner is not None and getattr(scanner, "usage", None) is not None:
             try:
                 return scanner.usage.summary()
-            except Exception:  # noqa: BLE001 - usage is advisory
-                pass
+            except Exception as e:  # noqa: BLE001 - usage is advisory
+                GLOBAL_LOGGER.log_once(f"usage summary unavailable: {e}", key="console-usage")
         return {}
 
     async def info(request: web.Request) -> web.Response:

@@ -35,6 +35,7 @@ from ..control.degrade import GLOBAL_DEGRADE
 from ..control import objectlock as ol
 from ..control import tiering as tiering_mod
 from ..control.iam import IAMSys
+from ..control.logging import GLOBAL_LOGGER
 from ..control import policy as policy_mod
 from ..control import tracing
 from ..object.pools import ServerPools
@@ -1153,7 +1154,11 @@ class S3Server:
                         ("s3:ListBucket", f"arn:aws:s3:::{bucket}"),
                     )
                 )
-            except Exception:  # noqa: BLE001 - malformed stored policy is not public
+            except Exception as e:  # noqa: BLE001 - malformed stored policy is not public
+                GLOBAL_LOGGER.log_once(
+                    f"bucket {bucket}: stored policy unparsable, treating as private: {e}",
+                    key=f"policy-status-{bucket}",
+                )
                 public = False
         return _xml(
             f'<PolicyStatus xmlns="{XML_NS}">'
@@ -1729,7 +1734,11 @@ class S3Server:
                 from ..control.config import SUBSYS_COMPRESSION
 
                 compression_on = self.config.get_bool(SUBSYS_COMPRESSION, "enable")
-            except Exception:
+            except Exception as e:  # noqa: BLE001 - config read failure = feature off
+                GLOBAL_LOGGER.log_once(
+                    f"compression config unreadable, treating as disabled: {e}",
+                    key="compression-config",
+                )
                 compression_on = False
         if compression_on and compress_mod.is_compressible(key, opts.content_type):
             body, cmeta = compress_mod.compress(body)
@@ -1825,7 +1834,11 @@ class S3Server:
                 from ..control.config import SUBSYS_COMPRESSION
 
                 compression_on = self.config.get_bool(SUBSYS_COMPRESSION, "enable")
-            except Exception:
+            except Exception as e:  # noqa: BLE001 - config read failure = feature off
+                GLOBAL_LOGGER.log_once(
+                    f"compression config unreadable, treating as disabled: {e}",
+                    key="compression-config",
+                )
                 compression_on = False
         return compression_on and compress_mod.is_compressible(key, opts.content_type)
 
@@ -1845,7 +1858,11 @@ class S3Server:
             raise S3Error("XMinioAdminBucketQuotaExceeded", resource=f"/{bucket}")
         try:
             used = self.quota_usage(bucket)
-        except Exception:  # noqa: BLE001 - usage source down != reject writes
+        except Exception as e:  # noqa: BLE001 - usage source down != reject writes
+            GLOBAL_LOGGER.log_once(
+                f"quota usage source failed for {bucket}, skipping enforcement: {e}",
+                key=f"quota-usage-{bucket}",
+            )
             return
         if used is None:
             return
@@ -2640,8 +2657,10 @@ class S3Server:
                     self.replication.on_put(bucket, oi)
                 elif event_name.startswith("s3:ObjectRemoved:"):
                     self.replication.on_delete(bucket, oi)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 - replication is async best-effort
+                GLOBAL_LOGGER.error(
+                    f"replication hook failed: {event_name} {bucket}/{oi.name}", exc=e
+                )
         if self.notifier is not None:
             from ..control.events import Event
 
@@ -2659,13 +2678,15 @@ class S3Server:
                         region=self.region,
                     )
                 )
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 - notification must not fail the op
+                GLOBAL_LOGGER.error(
+                    f"event notification failed: {event_name} {bucket}/{oi.name}", exc=e
+                )
         if self.on_event is not None:
             try:
                 self.on_event(event_name, bucket, oi)
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 - observer hook must not fail the op
+                GLOBAL_LOGGER.error(f"on_event hook failed: {event_name}", exc=e)
 
 
 def _api_name(method: str, bucket: str, key: str, q) -> str:

@@ -28,6 +28,8 @@ from typing import Callable
 
 from aiohttp import web
 
+from ..control.logging import GLOBAL_LOGGER
+
 
 class HubBridge:
     """Bridge a blocking PubSub hub into an asyncio queue."""
@@ -97,13 +99,13 @@ class HubBridge:
                         self.offer_threadsafe(json.loads(line))
                     except ValueError:
                         continue
-            except Exception:  # noqa: BLE001 - peer loss must not kill the stream
-                pass
+            except Exception as e:  # noqa: BLE001 - peer loss must not kill the stream
+                GLOBAL_LOGGER.log_once(f"peer stream lost: {e}", key="peer-stream")
             finally:
                 if resp is not None:
                     try:
                         resp.close()
-                    except Exception:  # noqa: BLE001
+                    except OSError:
                         pass
 
         for fn in stream_fns:
@@ -120,7 +122,7 @@ class HubBridge:
         for r in resps:
             try:
                 r.close()  # aborts the pump's blocking iter_lines
-            except Exception:  # noqa: BLE001
+            except OSError:
                 pass
 
 

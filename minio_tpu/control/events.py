@@ -153,18 +153,29 @@ class TargetQueue:
         # Private copy: emit() hands the SAME dict to every target, and each
         # queue annotates its own spool path on it.
         record = dict(record)
+        # Spool the record BEFORE taking the lock: disk I/O under _lock
+        # would serialize every producer behind one slow drive. On a
+        # full-queue drop the optimistically written file is unlinked.
+        fn = ""
+        if self.queue_dir:
+            fn = os.path.join(self.queue_dir, f"{time.time_ns()}-{uuid.uuid4().hex}.json")
+            try:
+                with open(fn, "w") as f:
+                    json.dump(record, f)
+                record["__spool__"] = fn
+            except OSError:
+                fn = ""
         with self._lock:
-            if len(self._mem) >= self.queue_limit:
-                return  # drop oldest-tolerant: refuse new when full
-            self._mem.append(record)
-            if self.queue_dir:
-                fn = os.path.join(self.queue_dir, f"{time.time_ns()}-{uuid.uuid4().hex}.json")
+            dropped = len(self._mem) >= self.queue_limit
+            if not dropped:
+                self._mem.append(record)
+        if dropped:  # drop oldest-tolerant: refuse new when full
+            if fn:
                 try:
-                    with open(fn, "w") as f:
-                        json.dump(record, f)
-                    record["__spool__"] = fn
+                    os.unlink(fn)
                 except OSError:
                     pass
+            return
         self._wake.set()
 
     def _loop(self) -> None:

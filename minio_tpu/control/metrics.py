@@ -417,6 +417,19 @@ class MetricsSys:
         buckets, +Inf, _sum/_count."""
         from .perf import BUCKET_LE_S, GLOBAL_PERF
 
+        slow = GLOBAL_PERF.slow.stats()
+        for mname, key, help_ in (
+            ("minio_tpu_slow_requests_captured_total", "captured_total",
+             "Requests whose full span tree was retained by the slow-request capture."),
+            ("minio_tpu_slow_capture_evicted_spans_total", "evicted_spans",
+             "Spans dropped by the slow-capture per-trace/ring caps."),
+            ("minio_tpu_slow_capture_evicted_traces_total", "evicted_traces",
+             "Whole traces evicted from the slow-capture ring."),
+        ):
+            lines.append(f"# HELP {mname} {help_}")
+            lines.append(f"# TYPE {mname} counter")
+            lines.append(f"{mname} {slow[key]}")
+
         snap = GLOBAL_PERF.ledger.snapshot()
         stages = snap.get("stages", {})
         if not stages:

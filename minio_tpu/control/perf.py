@@ -56,6 +56,45 @@ class _Hist:
         self.sum = 0.0
 
 
+# -- stage registry -----------------------------------------------------------
+
+# Every LITERAL (layer, stage) key recorded into the ledger -- via
+# tracing.span(stage, layer) marks or direct ledger.record(layer, stage, s)
+# calls -- must be declared here. tools/mtpulint (stage-key rule) parses this
+# literal statically and rejects marks that would mint a new unaggregated
+# series no dashboard row or perf_gate threshold knows about. Adding a stage
+# is a two-line diff: the mark, and its registry entry.
+STAGES: frozenset = frozenset({
+    # api/server.py request stages
+    ("api", "auth"),
+    ("api", "body-read"),
+    ("api", "response-write"),
+    # object/erasure.py + object/multipart.py data-path stages
+    ("object", "encode"),
+    ("object", "shard-fanout"),
+    ("object", "commit"),
+    ("object", "shard-read"),
+    ("object", "decode"),
+    ("object", "object.PutObject"),
+    ("object", "object.GetObject"),
+    ("object", "object.DeleteObject"),
+    ("object", "object.HealObject"),
+    ("object", "object.PutObjectPart"),
+    ("object", "object.CompleteMultipartUpload"),
+    # object/codec.py + parallel/batching.py codec spans
+    ("erasure", "erasure.encode"),
+    ("erasure", "erasure.encode_frames"),
+    ("erasure", "erasure.reconstruct"),
+    # parallel/batching.py worker-side direct ledger records
+    ("codec", "encode-batch"),
+    ("codec", "reconstruct-batch"),
+    ("codec", "verify-batch"),
+})
+
+# Layers whose stage names are computed at runtime (per-API root spans,
+# per-peer endpoints, per-StorageAPI call names): checked by layer only.
+DYNAMIC_STAGE_LAYERS: frozenset = frozenset({"api", "rpc", "rpc-peer", "storage"})
+
 # -- stage ledger -------------------------------------------------------------
 
 _N_SHARDS = 8  # power of two: shard pick is a mask

@@ -20,6 +20,8 @@ import uuid
 from dataclasses import dataclass, field
 
 import msgpack
+
+from ..control.logging import GLOBAL_LOGGER
 from aiohttp import web
 
 from ..utils import deadline, errors
@@ -202,7 +204,11 @@ class DRWMutex:
                 try:
                     if lk.lock(self.resource, self.uid, writer):
                         held.append(i)
-                except Exception:  # noqa: BLE001 - a dead locker is a no-vote
+                except Exception as e:  # noqa: BLE001 - a dead locker is a no-vote
+                    GLOBAL_LOGGER.log_once(
+                        f"locker {i} vote failed for {self.resource}: {e}",
+                        key=f"locker-vote-{i}",
+                    )
                     continue
             if len(held) >= quorum:
                 self._held = held
@@ -214,8 +220,10 @@ class DRWMutex:
             for i in held:
                 try:
                     self.lockers[i].unlock(self.resource, self.uid)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001 - best-effort rollback
+                    GLOBAL_LOGGER.log_once(
+                        f"locker {i} rollback-unlock failed: {e}", key=f"locker-unlock-{i}"
+                    )
             time.sleep(random.uniform(0.005, 0.05))
         return False
 
@@ -225,8 +233,10 @@ class DRWMutex:
         for i in self._held:
             try:
                 self.lockers[i].unlock(self.resource, self.uid)
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 - lease expiry reclaims it
+                GLOBAL_LOGGER.log_once(
+                    f"locker {i} release-unlock failed: {e}", key=f"locker-unlock-{i}"
+                )
         self._held = []
 
     def _start_refresher(self) -> None:
@@ -240,7 +250,11 @@ class DRWMutex:
             try:
                 if self.lockers[i].refresh(self.resource, self.uid):
                     ok += 1
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001 - counted as a lost vote
+                GLOBAL_LOGGER.log_once(
+                    f"locker {i} refresh failed for {self.resource}: {e}",
+                    key=f"locker-refresh-{i}",
+                )
                 continue
         if ok >= self._quorum(self._writer):
             return True
@@ -250,8 +264,8 @@ class DRWMutex:
         if self.on_lost is not None:
             try:
                 self.on_lost()
-            except Exception:  # noqa: BLE001
-                pass
+            except Exception as e:  # noqa: BLE001 - loss is already being handled
+                GLOBAL_LOGGER.error("lock-lost callback raised", exc=e)
         return False
 
     def __enter__(self):

@@ -461,7 +461,8 @@ class Node:
             return  # transient: keep what we have
         try:
             self.notifier.set_bucket_rules_from_xml(bucket, xml)
-        except Exception:  # noqa: BLE001 - malformed persisted XML
+        except Exception as e:  # noqa: BLE001 - malformed persisted XML
+            self.logger.error(f"notification rules for {bucket} unparsable", exc=e)
             return
 
     def _quota_usage(self, bucket: str) -> int | None:
@@ -489,7 +490,11 @@ class Node:
                         from ..control.usage import DataUsageCache
 
                         self._quota_cache = DataUsageCache.from_bytes(raw)
-                except Exception:  # noqa: BLE001 - unreadable tree = unknown
+                except Exception as e:  # noqa: BLE001 - unreadable tree = unknown
+                    self.logger.log_once(
+                        f"usage tree unreadable, quota enforcement skipped: {e}",
+                        key="quota-usage-tree",
+                    )
                     self._quota_cache = None
         cache = self._quota_cache
         if cache is None or not cache.last_update:

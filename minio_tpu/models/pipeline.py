@@ -17,6 +17,7 @@ parallelism. See parallel/mesh.py.
 from __future__ import annotations
 
 import functools
+import threading
 from dataclasses import dataclass
 
 import jax
@@ -34,6 +35,10 @@ from ..parallel import mesh as mesh_lib
 # {"choice": "pallas"|"xla", "pallas_ok": bool, "pallas_gibs": float,
 #  "xla_gibs": float, "detail": str}
 _HASH_SELECT: dict[str, dict] = {}
+# Guards the check-then-probe in hash_selection(): two threads racing the
+# first call would otherwise both run the (expensive, jit-compiling) probe
+# and clobber each other's verdict.
+_HASH_SELECT_LOCK = threading.Lock()
 
 # Production chunk length: the per-shard slice a 1 MiB block / 12 data
 # shards produces (cmd/erasure-utils.go shard math) — the length every
@@ -110,9 +115,10 @@ def _probe_and_time_hash(backend: str) -> dict:
 def hash_selection() -> dict:
     """The cached per-backend probe+timing verdict (for diagnostics/bench)."""
     backend = jax.default_backend()
-    if backend not in _HASH_SELECT:
-        _HASH_SELECT[backend] = _probe_and_time_hash(backend)
-    return _HASH_SELECT[backend]
+    with _HASH_SELECT_LOCK:
+        if backend not in _HASH_SELECT:
+            _HASH_SELECT[backend] = _probe_and_time_hash(backend)
+        return _HASH_SELECT[backend]
 
 
 def hash_batch_fn():

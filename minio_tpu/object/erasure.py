@@ -82,7 +82,7 @@ def _rank_read_slots(by_shard: list, k: int) -> list[int]:
                 row = lat_fn().get("read_file")
                 if row:
                     ewma = float(row["ewma_ms"])
-            except Exception:  # noqa: BLE001 - ranking is advisory
+            except (KeyError, TypeError, ValueError):  # ranking is advisory
                 ewma = 0.0
         scored.append((ewma, 0 if j < k else 1, j))
     scored.sort()
@@ -147,6 +147,7 @@ class _PipelinedMD5:
                 return
             try:
                 self._h.update(b)
+            # mtpulint: disable=swallowed-except -- stored, re-raised below
             except BaseException as e:  # noqa: BLE001 - surfaced to the PUT
                 # Keep draining so the producer never blocks on a full
                 # queue; the error re-raises at the next update/hexdigest

@@ -45,6 +45,8 @@ class S3Gateway:
 
     def __init__(self, endpoint: str, access_key: str, secret_key: str,
                  region: str = "us-east-1"):
+        # mtpulint: disable=raw-transport -- gateway talks to an EXTERNAL
+        # S3 endpoint; internode deadline propagation does not apply here.
         import requests
 
         from ..api.auth import Credentials, sign_request
@@ -54,6 +56,7 @@ class S3Gateway:
         self.creds = Credentials(access_key, secret_key)
         self.region = region
         self.host = urllib.parse.urlparse(self.endpoint).netloc
+        # mtpulint: disable=raw-transport -- external backend session
         self.session = requests.Session()
         self.pools = [self]
         self.ns_lock = None

@@ -25,6 +25,8 @@ import time
 
 import msgpack
 
+from ..control.logging import GLOBAL_LOGGER
+
 META_BUCKET = ".minio.sys"
 
 # Persisted image layout: 8-byte big-endian unix-time header, then the
@@ -163,8 +165,11 @@ class MetacacheManager:
                     self._persist(
                         cache_path(bucket, prefix), _HDR.pack(time.time()) + body
                     )
-                except Exception:  # noqa: BLE001 - persistence is best effort
-                    pass
+                except Exception as e:  # noqa: BLE001 - persistence is best effort
+                    GLOBAL_LOGGER.log_once(
+                        f"metacache persist failed for {bucket}/{prefix}: {e}",
+                        key="metacache-persist",
+                    )
         return self._page(cache, marker)
 
     def _load_persisted(self, bucket: str, prefix: str) -> _Cache | None:

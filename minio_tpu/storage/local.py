@@ -327,6 +327,9 @@ class LocalDrive(StorageAPI):
             except errors.FileNotFound:
                 meta = XLMeta()
             meta.add_version(fi)
+            # mtpulint: disable=lock-blocking-io -- the read-modify-write of
+            # xl.meta IS the critical section; dropping the lock before the
+            # write would let a concurrent writer interleave a stale image.
             self.write_all(volume, os.path.join(path, XL_META_FILE), meta.to_bytes())
 
     def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
@@ -334,6 +337,7 @@ class LocalDrive(StorageAPI):
             meta = self.read_xl(volume, path)
             meta.find_version(fi.version_id)  # must exist
             meta.add_version(fi)
+            # mtpulint: disable=lock-blocking-io -- see write_metadata
             self.write_all(volume, os.path.join(path, XL_META_FILE), meta.to_bytes())
 
     def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
@@ -354,6 +358,7 @@ class LocalDrive(StorageAPI):
                 except errors.DiskError:
                     pass
             if meta.versions:
+                # mtpulint: disable=lock-blocking-io -- see write_metadata
                 self.write_all(volume, os.path.join(path, XL_META_FILE), meta.to_bytes())
             else:
                 try:

@@ -59,6 +59,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running scenario tests excluded from tier-1"
     )
+    # tools/race_gate.py discovers its file list from this marker.
+    config.addinivalue_line(
+        "markers", "race: concurrency-sensitive tests rerun by tools/race_gate.py"
+    )
 
 
 def _child_pids() -> set[int]:

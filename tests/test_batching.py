@@ -9,6 +9,10 @@ import pytest
 from minio_tpu.object.codec import HostCodec
 from minio_tpu.parallel.batching import BatchingDeviceCodec
 
+# Stressed under adversarial thread scheduling by tools/race_gate.py.
+pytestmark = pytest.mark.race
+
+
 BLOCK = 1 << 20
 
 

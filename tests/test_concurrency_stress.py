@@ -20,6 +20,10 @@ from minio_tpu.object.types import DeleteObjectOptions
 from minio_tpu.utils import errors
 from tests.test_sets_pools import make_pools
 
+# Stressed under adversarial thread scheduling by tools/race_gate.py.
+pytestmark = pytest.mark.race
+
+
 BUCKET = "raceb"
 KEYS = 6
 WRITERS = 4

@@ -26,6 +26,10 @@ from minio_tpu.storage.local import LocalDrive
 from minio_tpu.utils import errors
 from tests.s3client import S3TestClient
 
+# Stressed under adversarial thread scheduling by tools/race_gate.py.
+pytestmark = pytest.mark.race
+
+
 
 def _free_port() -> int:
     s = socket.socket()

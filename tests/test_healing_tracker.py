@@ -10,6 +10,8 @@ removes the tracker when the drive is fully re-protected.
 import os
 import shutil
 
+import pytest
+
 from minio_tpu.control.healmgr import (
     DiskHealMonitor,
     HealingTracker,
@@ -21,6 +23,10 @@ from minio_tpu.storage import format as fmt
 from minio_tpu.storage.local import LocalDrive
 from minio_tpu.utils import errors
 from tests.harness import ErasureHarness
+
+# Stressed under adversarial thread scheduling by tools/race_gate.py.
+pytestmark = pytest.mark.race
+
 
 BUCKET = "tracked"
 

@@ -1,0 +1,490 @@
+"""mtpulint rule/engine tests: every rule has a firing and a non-firing
+fixture, plus suppression- and baseline-handling coverage.
+
+Fixtures are tiny synthetic trees under tmp_path (the engine resolves
+relpaths against whatever root it is given), so each test pins exactly one
+behavior without depending on the real minio_tpu sources. The real tree is
+gated separately by tests/test_static_analysis.py."""
+
+from __future__ import annotations
+
+import textwrap
+
+from tools.mtpulint import (
+    apply_baseline,
+    format_baseline,
+    lint_tree,
+    load_baseline,
+)
+from tools.mtpulint.rules import (
+    DeadlineRebindRule,
+    LockBlockingIORule,
+    MetricsRenderedRule,
+    RawTransportRule,
+    ResourceLeakRule,
+    StageKeyRule,
+    SwallowedExceptRule,
+    TypedErrorsRule,
+    UnlockedGlobalRule,
+)
+
+
+def run_rule(tmp_path, files: dict[str, str], rule) -> list:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src).lstrip("\n"))
+    return lint_tree(str(tmp_path), ["minio_tpu"], [rule])
+
+
+# -- swallowed-except ---------------------------------------------------------
+
+
+def test_swallowed_except_fires_on_silent_broad_handler(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/x.py": """
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        """,
+    }, SwallowedExceptRule())
+    assert [f.rule for f in findings] == ["swallowed-except"]
+    assert findings[0].line == 4
+
+
+def test_swallowed_except_fires_on_bare_except_and_bare_return(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/x.py": """
+            def f():
+                try:
+                    g()
+                except:
+                    return
+        """,
+    }, SwallowedExceptRule())
+    assert len(findings) == 1 and "bare except" in findings[0].message
+
+
+def test_swallowed_except_quiet_when_narrow_or_observable(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/x.py": """
+            def f(log):
+                try:
+                    g()
+                except ValueError:
+                    pass
+                try:
+                    g()
+                except Exception:
+                    log.warning("g failed")
+                try:
+                    g()
+                except Exception:
+                    raise
+        """,
+    }, SwallowedExceptRule())
+    assert findings == []
+
+
+def test_swallowed_except_ignores_cold_paths(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+        """,
+    }, SwallowedExceptRule())
+    assert findings == []
+
+
+# -- raw-transport ------------------------------------------------------------
+
+
+def test_raw_transport_fires_on_import_and_call(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/peer.py": """
+            import requests
+
+            def f(url):
+                return requests.get(url)
+        """,
+    }, RawTransportRule())
+    assert [f.line for f in findings] == [1, 4]
+    assert all(f.rule == "raw-transport" for f in findings)
+
+
+def test_raw_transport_allows_transport_py_itself(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/transport.py": """
+            import requests
+            import socket
+        """,
+    }, RawTransportRule())
+    assert findings == []
+
+
+# -- deadline-rebind ----------------------------------------------------------
+
+
+def test_deadline_rebind_fires_when_transport_loses_markers(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/transport.py": """
+            def call(url):
+                return url
+        """,
+    }, DeadlineRebindRule())
+    msgs = " ".join(f.message for f in findings)
+    assert len(findings) == 3
+    assert "deadline.remaining()" in msgs
+    assert "DEADLINE_HEADER" in msgs
+    assert "DeadlineExceeded" in msgs
+
+
+def test_deadline_rebind_fires_on_server_without_bind(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/some_rest.py": """
+            def handler(request):
+                tok = request.headers.get(TOKEN_HEADER)
+                return tok
+        """,
+    }, DeadlineRebindRule())
+    assert len(findings) == 1
+    assert "bind_header" in findings[0].message
+
+
+def test_deadline_rebind_quiet_on_complete_plumbing(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/transport.py": """
+            def call(headers, deadline):
+                if deadline.remaining() <= 0:
+                    raise DeadlineExceeded("spent")
+                headers[DEADLINE_HEADER] = "1.5"
+        """,
+        "minio_tpu/dist/some_rest.py": """
+            def handler(request):
+                tok = request.headers.get(TOKEN_HEADER)
+                deadline.bind_header(request.headers.get("X-Mtpu-Deadline"))
+                return tok
+        """,
+    }, DeadlineRebindRule())
+    assert findings == []
+
+
+# -- lock-blocking-io ---------------------------------------------------------
+
+
+def test_lock_blocking_io_fires_on_sleep_and_open_under_lock(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/x.py": """
+            import time
+
+            def f(self, path):
+                with self._lock:
+                    time.sleep(1)
+                    fh = open(path)
+                return fh
+        """,
+    }, LockBlockingIORule())
+    assert sorted(f.line for f in findings) == [5, 6]
+
+
+def test_lock_blocking_io_quiet_outside_lock_or_in_nested_def(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/x.py": """
+            import time
+
+            def f(self, pool):
+                time.sleep(1)
+                with self._lock:
+                    def deferred():
+                        time.sleep(1)
+                    pool.submit(deferred)
+                with self.items:
+                    time.sleep(1)
+        """,
+    }, LockBlockingIORule())
+    assert findings == []
+
+
+# -- resource-leak ------------------------------------------------------------
+
+
+def test_resource_leak_fires_on_unclosed_open(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/x.py": """
+            def f(path):
+                fh = open(path)
+                return fh.name
+        """,
+    }, ResourceLeakRule())
+    assert [f.rule for f in findings] == ["resource-leak"]
+
+
+def test_resource_leak_quiet_on_with_finally_and_escape(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/x.py": """
+            def ok_with(path):
+                with open(path) as f:
+                    return f.read()
+
+            def ok_finally(path):
+                f = open(path)
+                try:
+                    return f.read()
+                finally:
+                    f.close()
+
+            def ok_escape(path):
+                return open(path)
+
+            def ok_handoff(path, sink):
+                sink.adopt(open(path))
+        """,
+    }, ResourceLeakRule())
+    assert findings == []
+
+
+# -- stage-key ----------------------------------------------------------------
+
+_PERF_FIXTURE = """
+    STAGES = frozenset({("api", "auth"), ("object", "encode")})
+    DYNAMIC_STAGE_LAYERS = frozenset({"rpc"})
+"""
+
+
+def test_stage_key_fires_on_unregistered_literal(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/perf.py": _PERF_FIXTURE,
+        "minio_tpu/object/x.py": """
+            def f():
+                with tracing.span("typo-stage", "api"):
+                    pass
+        """,
+    }, StageKeyRule())
+    assert len(findings) == 1
+    assert "('api', 'typo-stage')" in findings[0].message
+
+
+def test_stage_key_quiet_on_registered_and_dynamic(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/perf.py": _PERF_FIXTURE,
+        "minio_tpu/object/x.py": """
+            def f(GLOBAL_PERF, name):
+                with tracing.span("auth", "api"):
+                    pass
+                GLOBAL_PERF.ledger.record("rpc", name, 0.1)
+                GLOBAL_PERF.ledger.record("rpc", "peer-call", 0.1)
+        """,
+    }, StageKeyRule())
+    assert findings == []
+
+
+def test_stage_key_reports_missing_registry(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/perf.py": "X = 1\n",
+    }, StageKeyRule())
+    assert len(findings) == 1
+    assert "registry literal not found" in findings[0].message
+
+
+# -- metrics-rendered ---------------------------------------------------------
+
+_DEGRADE_FIXTURE = """
+    class DegradeStats:
+        def hit(self):
+            self.mystery_counter += 1
+"""
+
+
+def test_metrics_rendered_fires_on_unexported_counter(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/degrade.py": _DEGRADE_FIXTURE,
+        "minio_tpu/control/metrics.py": "def render():\n    return ''\n",
+    }, MetricsRenderedRule())
+    assert len(findings) == 1
+    assert "'mystery_counter'" in findings[0].message
+
+
+def test_metrics_rendered_quiet_when_rendered(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/degrade.py": _DEGRADE_FIXTURE,
+        "minio_tpu/control/metrics.py": """
+            def render(snap):
+                return snap["mystery_counter"]
+        """,
+    }, MetricsRenderedRule())
+    assert findings == []
+
+
+# -- typed-errors -------------------------------------------------------------
+
+
+def test_typed_errors_fires_on_untyped_raise(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/x.py": """
+            def f():
+                raise Exception("boom")
+
+            def g():
+                raise RuntimeError("boom")
+        """,
+    }, TypedErrorsRule())
+    assert sorted(f.line for f in findings) == [2, 5]
+
+
+def test_typed_errors_quiet_on_typed_raise(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/x.py": """
+            def f():
+                raise S3Error("NoSuchKey")
+        """,
+    }, TypedErrorsRule())
+    assert findings == []
+
+
+# -- unlocked-global ----------------------------------------------------------
+
+
+def test_unlocked_global_fires_on_bare_mutation(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/models/x.py": """
+            _CACHE = {}
+
+            def put(k, v):
+                _CACHE[k] = v
+        """,
+    }, UnlockedGlobalRule())
+    assert [f.rule for f in findings] == ["unlocked-global"]
+
+
+def test_unlocked_global_quiet_when_locked_or_marked(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/models/x.py": """
+            import threading
+
+            _CACHE = {}
+            _CACHE_LOCK = threading.Lock()
+            _TABLE = {"a": 1}  # mtpulint: immutable -- built once at import
+
+            def put(k, v):
+                with _CACHE_LOCK:
+                    _CACHE[k] = v
+
+            def get(k):
+                return _TABLE.get(k)
+        """,
+    }, UnlockedGlobalRule())
+    assert findings == []
+
+
+# -- suppressions -------------------------------------------------------------
+
+_SWALLOW = """
+    def f():
+        try:
+            g()
+        except Exception:{inline}
+            pass
+"""
+
+
+def test_inline_suppression_same_line(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/x.py": _SWALLOW.format(
+            inline="  # mtpulint: disable=swallowed-except"
+        ),
+    }, SwallowedExceptRule())
+    assert findings == []
+
+
+def test_suppression_comment_above_with_justification(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/x.py": """
+            def f():
+                try:
+                    g()
+                # mtpulint: disable=swallowed-except -- g() is fire-and-forget
+                # and failures are observed by its own retry loop.
+                except Exception:
+                    pass
+        """,
+    }, SwallowedExceptRule())
+    assert findings == []
+
+
+def test_suppression_for_other_rule_does_not_hide(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/x.py": _SWALLOW.format(
+            inline="  # mtpulint: disable=typed-errors"
+        ),
+    }, SwallowedExceptRule())
+    assert len(findings) == 1
+
+
+def test_file_level_suppression(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/x.py": "# mtpulint: disable-file=swallowed-except\n"
+        + textwrap.dedent(_SWALLOW.format(inline="")),
+    }, SwallowedExceptRule())
+    assert findings == []
+
+
+def test_parse_error_is_reported_as_finding(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/x.py": "def f(:\n",
+    }, SwallowedExceptRule())
+    assert [f.rule for f in findings] == ["parse-error"]
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+def _mk(relpath, rule, line):
+    from tools.mtpulint import Finding
+
+    return Finding(rule=rule, relpath=relpath, line=line, message="m")
+
+
+def test_load_baseline_parses_and_skips_junk(tmp_path):
+    p = tmp_path / "baseline.txt"
+    p.write_text(
+        "# comment\n"
+        "\n"
+        "minio_tpu/api/x.py::swallowed-except::2\n"
+        "not-a-valid-line\n"
+        "minio_tpu/api/x.py::swallowed-except::1\n"  # additive duplicate
+    )
+    assert load_baseline(str(p)) == {("minio_tpu/api/x.py", "swallowed-except"): 3}
+
+
+def test_load_baseline_missing_file_is_empty(tmp_path):
+    assert load_baseline(str(tmp_path / "nope.txt")) == {}
+
+
+def test_apply_baseline_grandfathers_up_to_quota(tmp_path):
+    findings = [
+        _mk("a.py", "r", 1),
+        _mk("a.py", "r", 5),
+        _mk("a.py", "r", 9),
+    ]
+    new, stale = apply_baseline(findings, {("a.py", "r"): 2})
+    assert [f.line for f in new] == [9]
+    assert stale == []
+
+
+def test_apply_baseline_reports_stale_entries(tmp_path):
+    new, stale = apply_baseline([_mk("a.py", "r", 1)], {("a.py", "r"): 3})
+    assert new == []
+    assert len(stale) == 1 and "shrink the baseline" in stale[0]
+
+
+def test_format_baseline_round_trips(tmp_path):
+    findings = [_mk("a.py", "r", 1), _mk("a.py", "r", 2), _mk("b.py", "q", 7)]
+    text = format_baseline(findings, header="# hdr")
+    p = tmp_path / "baseline.txt"
+    p.write_text(text)
+    assert load_baseline(str(p)) == {("a.py", "r"): 2, ("b.py", "q"): 1}

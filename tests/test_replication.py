@@ -17,6 +17,10 @@ from minio_tpu.dist.node import Node
 from tests.s3client import S3TestClient
 from tests.test_dist import _free_port
 
+# Stressed under adversarial thread scheduling by tools/race_gate.py.
+pytestmark = pytest.mark.race
+
+
 ROOT = "replroot"
 SECRET = "repl-secret-key"
 ADMIN = "/mtpu/admin/v1"

@@ -43,6 +43,15 @@ def main() -> int:
     if rc != 0:
         return rc
 
+    if invariants:
+        # The degradation slice leans on every project invariant, not just
+        # the deadline ones: run the full mtpulint rule set over the tree.
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.mtpulint", "minio_tpu"], cwd=root
+        )
+        if proc.returncode != 0:
+            return proc.returncode
+
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [
         sys.executable, "-m", "pytest", "-q",

@@ -1,76 +1,41 @@
-"""Deadline-propagation lint: the budget must ride EVERY internode hop.
+"""Deadline-propagation lint -- thin shim over tools/mtpulint.
 
-End-to-end deadlines (minio_tpu/utils/deadline.py) only work if no RPC path
-forgets the plumbing: one module issuing raw HTTP, or one REST server not
-re-binding the X-Mtpu-Deadline header, silently re-introduces the unbounded
-hop the budget exists to prevent. This lint enforces the three structural
-invariants statically, so a refactor that drops the plumbing fails CI
-instead of failing a production deadline:
+The budget must ride EVERY internode hop: one module issuing raw HTTP, or
+one REST server not re-binding X-Mtpu-Deadline, silently re-introduces the
+unbounded hop the deadline exists to prevent. The checks now live as real
+AST rules in tools/mtpulint/rules.py (`raw-transport`, `deadline-rebind`);
+this module keeps the historical `lint() -> list[str]` / `main()` surface
+so tools/chaos_check.py and tests/test_degradation.py keep working:
 
-  1. dist/transport.py (the single RPC seam) still checks the remaining
-     budget, caps the socket timeout with it, and stamps DEADLINE_HEADER
-     on outgoing requests.
-  2. Every dist/ REST *server* (a module that authenticates TOKEN_HEADER
-     on inbound requests) re-binds the propagated budget with
-     deadline.bind_header -- a hop that drops the header resets the
-     budget to infinity for everything downstream.
-  3. No dist/ module other than transport.py talks `requests.` directly:
-     all RPCs must ride RestClient.call, where the deadline is enforced.
+  * dist/transport.py (the single RPC seam) still checks the remaining
+    budget, stamps DEADLINE_HEADER on outgoing requests, and raises
+    DeadlineExceeded when the budget is spent.
+  * Every REST *server* module (authenticates TOKEN_HEADER on inbound
+    requests) re-binds the propagated budget with deadline.bind_header.
+  * No dist/storage/object module other than transport.py talks
+    `requests.`/`socket.` directly: RPCs ride RestClient.call.
 
     python tools/deadline_lint.py          # lint the tree, exit 1 on violations
-
-Run by tools/chaos_check.py and wired into tier-1 via tests/test_degradation.py.
+    python -m tools.mtpulint minio_tpu     # the full rule set, same engine
 """
 
 from __future__ import annotations
 
 import os
-import re
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-DIST = os.path.join(REPO, "minio_tpu", "dist")
+if REPO not in sys.path:
+    # Loaded by file path (importlib in tests, chaos_check, direct script
+    # execution): make the `tools.mtpulint` package importable either way.
+    sys.path.insert(0, REPO)
 
-# transport.py must keep these markers (invariant 1).
-TRANSPORT_MARKERS = [
-    ("deadline.remaining()", "budget check before each hop"),
-    ("DEADLINE_HEADER", "deadline header stamped on outgoing RPCs"),
-    ("DeadlineExceeded", "expired budget surfaces as the typed error"),
-]
-
-# Inbound-auth marker: a module matching this hosts a REST server.
-_SERVER_RE = re.compile(r"request\.headers\.get\(TOKEN_HEADER")
-_BIND_RE = re.compile(r"deadline\.bind_header\(")
-_RAW_REQUESTS_RE = re.compile(r"^\s*(?:import requests|from requests)|[^.\w]requests\.(?:get|post|put|delete|request|Session)\(", re.M)
+from tools.mtpulint import DEADLINE_RULES, lint_tree  # noqa: E402
 
 
 def lint() -> list[str]:
-    problems: list[str] = []
-
-    transport = os.path.join(DIST, "transport.py")
-    with open(transport, encoding="utf-8") as f:
-        tsrc = f.read()
-    for marker, why in TRANSPORT_MARKERS:
-        if marker not in tsrc:
-            problems.append(f"dist/transport.py: missing `{marker}` ({why})")
-
-    for name in sorted(os.listdir(DIST)):
-        if not name.endswith(".py") or name == "transport.py":
-            continue
-        path = os.path.join(DIST, name)
-        with open(path, encoding="utf-8") as f:
-            src = f.read()
-        if _SERVER_RE.search(src) and not _BIND_RE.search(src):
-            problems.append(
-                f"dist/{name}: authenticates TOKEN_HEADER but never calls "
-                "deadline.bind_header -- inbound budgets are dropped here"
-            )
-        if _RAW_REQUESTS_RE.search(src):
-            problems.append(
-                f"dist/{name}: raw `requests` usage -- RPCs must ride "
-                "RestClient.call so the deadline caps the socket timeout"
-            )
-    return problems
+    """Deadline-invariant findings as display strings ([] = clean)."""
+    return [f.render() for f in lint_tree(REPO, ["minio_tpu"], DEADLINE_RULES)]
 
 
 def main() -> int:

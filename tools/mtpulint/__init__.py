@@ -1,0 +1,43 @@
+"""mtpulint: AST-based project-invariant checker for minio_tpu.
+
+The `go vet`/staticcheck analogue for this tree (the reference runs its
+whole suite under vet + the race detector in CI; see docs/STATIC_ANALYSIS.md
+for how mtpulint / race_gate / metrics_lint / chaos_check divide that
+surface). Engine in engine.py, rules in rules.py, CLI in __main__.py:
+
+    python -m tools.mtpulint minio_tpu/            # lint against the baseline
+    python -m tools.mtpulint --no-baseline ...     # full scan, nothing hidden
+    python -m tools.mtpulint --write-baseline ...  # regenerate the baseline
+    python -m tools.mtpulint --list-rules
+"""
+
+from __future__ import annotations
+
+import os
+
+from .engine import (  # noqa: F401 - public surface
+    Finding,
+    ProjectContext,
+    Rule,
+    apply_baseline,
+    build_project,
+    format_baseline,
+    load_baseline,
+    run_rules,
+)
+from .rules import ALL_RULES, DEADLINE_RULES  # noqa: F401
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.txt")
+
+
+def lint_tree(
+    root: str | None = None,
+    paths: list[str] | None = None,
+    rules: list[Rule] | None = None,
+) -> list[Finding]:
+    """One-call scan (no baseline applied): parse + run + suppressions."""
+    project = build_project(root or REPO_ROOT, paths or ["minio_tpu"])
+    return run_rules(project, rules if rules is not None else ALL_RULES)

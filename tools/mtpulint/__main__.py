@@ -1,0 +1,93 @@
+"""mtpulint CLI. Exit 0 = no findings beyond the committed baseline."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+try:
+    from . import (
+        ALL_RULES,
+        BASELINE_PATH,
+        REPO_ROOT,
+        apply_baseline,
+        format_baseline,
+        lint_tree,
+        load_baseline,
+    )
+except ImportError:  # executed as a loose script: python tools/mtpulint/__main__.py
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from mtpulint import (  # type: ignore[no-redef]
+        ALL_RULES,
+        BASELINE_PATH,
+        REPO_ROOT,
+        apply_baseline,
+        format_baseline,
+        lint_tree,
+        load_baseline,
+    )
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mtpulint", description="AST-based project-invariant checker"
+    )
+    ap.add_argument("paths", nargs="*", default=["minio_tpu"],
+                    help="files/dirs to lint (default: minio_tpu)")
+    ap.add_argument("--root", default=REPO_ROOT,
+                    help="project root (directory containing minio_tpu/)")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline file of grandfathered findings")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="full scan: report every finding, grandfathered or not")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from the current scan and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.id:18s} {rule.title}")
+        return 0
+
+    findings = lint_tree(args.root, args.paths or ["minio_tpu"])
+
+    if args.write_baseline:
+        header = (
+            "# mtpulint baseline -- grandfathered findings (relpath::rule::count).\n"
+            "# Shrink-only: fix a finding, delete its line. New code must be clean.\n"
+            "# Regenerate: python -m tools.mtpulint --write-baseline"
+        )
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write(format_baseline(findings, header))
+        print(f"mtpulint: baseline written: {len(findings)} findings -> {args.baseline}")
+        return 0
+
+    if args.no_baseline:
+        new, stale = findings, []
+    else:
+        new, stale = apply_baseline(findings, load_baseline(args.baseline))
+
+    for f in new:
+        print(f.render(), file=sys.stderr)
+    for s in stale:
+        print(f"mtpulint: stale baseline entry: {s}", file=sys.stderr)
+    if new:
+        print(
+            f"mtpulint: {len(new)} finding(s) "
+            f"({len(findings)} total, {len(findings) - len(new)} baselined)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"mtpulint: ok ({len(findings)} baselined finding(s) remain)"
+        if findings
+        else "mtpulint: ok (clean tree)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,257 @@
+"""mtpulint engine: AST project scan, suppressions, baseline accounting.
+
+The framework half of tools/mtpulint (rules live in rules.py): walk a tree,
+parse every Python file once, hand the shared ProjectContext to each rule,
+then filter the findings through two escape hatches:
+
+  * inline suppressions -- `# mtpulint: disable=<rule>[,<rule>...]` on the
+    finding line (or alone on the line directly above it) silences that
+    line; `# mtpulint: disable-file=<rule>` anywhere silences the whole
+    file for that rule. Suppressions are for *justified* exemptions (the
+    comment should say why), not for burying findings.
+  * the committed baseline -- grandfathered findings recorded as
+    `relpath::rule::count` lines. A file/rule pair may produce at most its
+    baselined count; anything beyond is NEW and fails the run. Entries
+    whose count exceeds reality are reported as stale so the baseline only
+    ever shrinks.
+
+Pure stdlib, no imports of the linted package: the tree is analyzed as
+text + AST, never executed, so the lint runs in milliseconds and cannot be
+confused by import-time side effects or missing accelerator deps.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mtpulint:\s*(disable|disable-file)=([a-zA-Z0-9_,\- ]+)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    relpath: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.relpath}:{self.line}: {self.rule}: {self.message}"
+
+
+@dataclass
+class FileContext:
+    """One parsed source file. `relpath` is slash-normalized and relative to
+    the project root (the directory that contains `minio_tpu/`), so rules
+    and baseline entries are stable regardless of where the scan runs."""
+
+    relpath: str
+    source: str
+    tree: ast.AST
+    line_disables: dict[int, set[str]] = field(default_factory=dict)
+    file_disables: set[str] = field(default_factory=set)
+
+    @property
+    def lines(self) -> list[str]:
+        return self.source.splitlines()
+
+
+def _parse_suppressions(ctx: FileContext) -> None:
+    """Populate line/file disables. A disable comment alone on its own line
+    applies to the next NON-comment line (multi-line statements anchor
+    findings at their first line, so `# mtpulint: disable=x` sits naturally
+    above, anywhere inside the justification comment block)."""
+    all_lines = ctx.source.splitlines()
+    for lineno, text in enumerate(all_lines, 1):
+        m = _SUPPRESS_RE.search(text)
+        if m is None:
+            continue
+        kind = m.group(1)
+        # First whitespace token per comma segment, so a justification may
+        # trail the rule name: `# mtpulint: disable=foo -- why this is ok`.
+        rules = {
+            seg.split()[0] for seg in m.group(2).split(",") if seg.split()
+        }
+        if kind == "disable-file":
+            ctx.file_disables |= rules
+        elif text.lstrip().startswith("#"):
+            tgt = lineno + 1
+            while tgt <= len(all_lines) and (
+                not all_lines[tgt - 1].strip()
+                or all_lines[tgt - 1].lstrip().startswith("#")
+            ):
+                tgt += 1
+            target = ctx.line_disables.setdefault(tgt, set())
+            target |= rules
+        else:
+            target = ctx.line_disables.setdefault(lineno, set())
+            target |= rules
+
+
+class ProjectContext:
+    """Everything a rule may look at: every parsed file, keyed by relpath."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self.files: list[FileContext] = []
+        self.by_relpath: dict[str, FileContext] = {}
+        self.parse_errors: list[Finding] = []
+
+    def add_file(self, abspath: str) -> None:
+        relpath = os.path.relpath(abspath, self.root).replace(os.sep, "/")
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                source = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            self.parse_errors.append(
+                Finding("parse-error", relpath, 0, f"unreadable: {e}")
+            )
+            return
+        try:
+            tree = ast.parse(source, filename=relpath)
+        except SyntaxError as e:
+            self.parse_errors.append(
+                Finding("parse-error", relpath, e.lineno or 0, f"syntax error: {e.msg}")
+            )
+            return
+        ctx = FileContext(relpath=relpath, source=source, tree=tree)
+        _parse_suppressions(ctx)
+        self.files.append(ctx)
+        self.by_relpath[relpath] = ctx
+
+    def iter_files(self, *prefixes: str):
+        """FileContexts whose relpath starts with any prefix ('' = all)."""
+        for ctx in self.files:
+            if not prefixes or any(ctx.relpath.startswith(p) for p in prefixes):
+                yield ctx
+
+    def get(self, relpath: str) -> FileContext | None:
+        return self.by_relpath.get(relpath)
+
+
+def build_project(root: str, paths: list[str]) -> ProjectContext:
+    """Parse every .py under `paths` (files or directories, relative to or
+    under `root`) into one ProjectContext. __pycache__ is skipped."""
+    project = ProjectContext(root)
+    seen: set[str] = set()
+    for p in paths:
+        absp = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(absp):
+            targets = [absp]
+        else:
+            targets = []
+            for dirpath, dirnames, filenames in os.walk(absp):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                targets.extend(
+                    os.path.join(dirpath, f) for f in filenames if f.endswith(".py")
+                )
+        for t in sorted(targets):
+            t = os.path.abspath(t)
+            if t not in seen:
+                seen.add(t)
+                project.add_file(t)
+    return project
+
+
+class Rule:
+    """Base rule: subclasses set `id`/`title`/`scope` and implement check().
+
+    `scope` is a tuple of relpath prefixes the rule applies to (empty =
+    whole tree); the engine does not pre-filter -- rules call
+    project.iter_files(*self.scope) so cross-file rules can still see
+    out-of-scope files (e.g. the stage registry) when they need to.
+    """
+
+    id: str = ""
+    title: str = ""
+    scope: tuple[str, ...] = ()
+
+    def check(self, project: ProjectContext):  # pragma: no cover - interface
+        raise NotImplementedError
+        yield  # makes every override a generator for free
+
+
+def run_rules(project: ProjectContext, rules: list[Rule]) -> list[Finding]:
+    """All non-suppressed findings, sorted by (path, line, rule)."""
+    findings: list[Finding] = list(project.parse_errors)
+    for rule in rules:
+        for f in rule.check(project):
+            ctx = project.get(f.relpath)
+            if ctx is not None and _is_suppressed(ctx, f):
+                continue
+            findings.append(f)
+    findings.sort(key=lambda f: (f.relpath, f.line, f.rule))
+    return findings
+
+
+def _is_suppressed(ctx: FileContext, f: Finding) -> bool:
+    if f.rule in ctx.file_disables or "all" in ctx.file_disables:
+        return True
+    rules = ctx.line_disables.get(f.line, set())
+    return f.rule in rules or "all" in rules
+
+
+# -- baseline -----------------------------------------------------------------
+
+
+def load_baseline(path: str) -> dict[tuple[str, str], int]:
+    """Parse `relpath::rule::count` lines; comments/blanks ignored."""
+    allowed: dict[tuple[str, str], int] = {}
+    if not os.path.exists(path):
+        return allowed
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split("::")
+            if len(parts) != 3:
+                continue
+            relpath, rule, count = parts
+            try:
+                allowed[(relpath, rule)] = allowed.get((relpath, rule), 0) + int(count)
+            except ValueError:
+                continue
+    return allowed
+
+
+def apply_baseline(
+    findings: list[Finding], allowed: dict[tuple[str, str], int]
+) -> tuple[list[Finding], list[str]]:
+    """Split findings into (new, stale-baseline-notes).
+
+    Per (file, rule): the first `allowed` findings (in line order) are
+    grandfathered; the rest are new. Baseline entries covering more
+    findings than exist are stale -- the fix landed, shrink the file.
+    """
+    by_key: dict[tuple[str, str], list[Finding]] = {}
+    for f in findings:
+        by_key.setdefault((f.relpath, f.rule), []).append(f)
+    new: list[Finding] = []
+    for key, group in sorted(by_key.items()):
+        quota = allowed.get(key, 0)
+        if len(group) > quota:
+            new.extend(group[quota:])
+    stale = [
+        f"{relpath}::{rule}: baseline allows {quota}, found "
+        f"{len(by_key.get((relpath, rule), []))} -- shrink the baseline"
+        for (relpath, rule), quota in sorted(allowed.items())
+        if len(by_key.get((relpath, rule), [])) < quota
+    ]
+    return new, stale
+
+
+def format_baseline(findings: list[Finding], header: str = "") -> str:
+    counts: dict[tuple[str, str], int] = {}
+    for f in findings:
+        key = (f.relpath, f.rule)
+        counts[key] = counts.get(key, 0) + 1
+    lines = [header.rstrip()] if header else []
+    lines.extend(
+        f"{relpath}::{rule}::{n}" for (relpath, rule), n in sorted(counts.items())
+    )
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,832 @@
+"""mtpulint rules: the project invariants, one class each.
+
+Every rule encodes a structural property PRs 1-4 established and a refactor
+could silently drop: error transport (swallowed-except, typed-errors),
+deadline plumbing (raw-transport, deadline-rebind), lock hygiene
+(lock-blocking-io, unlocked-global), resource lifetime (resource-leak), and
+the observability seams (stage-key, metrics-rendered). Rules are AST-based
+-- they see structure, not text -- so renames and reformatting can't dodge
+them, and suppressions (`# mtpulint: disable=<rule>`) are visible decisions
+in the diff rather than regex blind spots.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, ProjectContext, Rule
+
+# Hot-path packages: where a swallowed error means silent data-plane damage.
+HOT_PATHS = (
+    "minio_tpu/api/",
+    "minio_tpu/object/",
+    "minio_tpu/dist/",
+    "minio_tpu/storage/",
+    "minio_tpu/chaos/",
+)
+
+TRANSPORT = "minio_tpu/dist/transport.py"
+PERF = "minio_tpu/control/perf.py"
+METRICS = "minio_tpu/control/metrics.py"
+DEGRADE = "minio_tpu/control/degrade.py"
+
+
+def _call_name(node: ast.Call) -> str:
+    """Dotted best-effort name of a call: `a.b.c(...)` -> 'a.b.c',
+    `f(...)` -> 'f'. Unresolvable pieces render as '?'."""
+    parts: list[str] = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def _str_const(node: ast.AST | None) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+# ---------------------------------------------------------------------------
+# swallowed-except
+# ---------------------------------------------------------------------------
+
+
+class SwallowedExceptRule(Rule):
+    """Broad `except` that swallows silently on a hot path.
+
+    A handler for bare/`Exception`/`BaseException` whose body neither
+    re-raises, returns, logs, counts, nor calls anything is a black hole:
+    the error happened, nobody will ever know. Narrow the type, or make the
+    swallow observable (log + metric)."""
+
+    id = "swallowed-except"
+    title = "broad except swallows without logging or re-raising"
+    scope = HOT_PATHS
+
+    BROAD = {"Exception", "BaseException"}
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        if isinstance(t, ast.Name):
+            return t.id in self.BROAD
+        if isinstance(t, ast.Tuple):
+            return any(
+                isinstance(e, ast.Name) and e.id in self.BROAD for e in t.elts
+            )
+        return False
+
+    def _is_silent(self, handler: ast.ExceptHandler) -> bool:
+        """Silent = nothing in the body raises, returns, or calls anything.
+        A bare `return`/`continue`/`pass` body observes nothing."""
+        for stmt in handler.body:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Raise, ast.Call, ast.Yield, ast.YieldFrom)):
+                    return False
+                if isinstance(node, ast.Return) and node.value is not None:
+                    return False
+        return True
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if self._is_broad(node) and self._is_silent(node):
+                    what = "bare except" if node.type is None else "broad except"
+                    yield Finding(
+                        self.id,
+                        ctx.relpath,
+                        node.lineno,
+                        f"{what} swallows silently -- narrow the type, or "
+                        "log-and-count before continuing",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# raw-transport
+# ---------------------------------------------------------------------------
+
+
+class RawTransportRule(Rule):
+    """Raw `requests`/`socket` traffic outside dist/transport.py.
+
+    All internode RPC must ride RestClient.call: that is where the deadline
+    budget caps the socket timeout, the X-Mtpu-Deadline header is stamped,
+    chaos faults inject, and per-peer histograms record. A module opening
+    its own HTTP session or socket re-introduces the unbounded hop. External
+    backends (the S3 gateway) are the one legitimate exception -- suppress
+    with a justification comment."""
+
+    id = "raw-transport"
+    title = "raw requests/socket use outside dist/transport.py"
+    scope = ("minio_tpu/dist/", "minio_tpu/storage/", "minio_tpu/object/")
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            if ctx.relpath == TRANSPORT:
+                continue
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.Import):
+                    for alias in node.names:
+                        if alias.name.split(".")[0] in ("requests", "socket"):
+                            yield self._finding(ctx, node, f"import {alias.name}")
+                elif isinstance(node, ast.ImportFrom):
+                    if (node.module or "").split(".")[0] in ("requests", "socket"):
+                        yield self._finding(ctx, node, f"from {node.module} import ...")
+                elif isinstance(node, ast.Call):
+                    name = _call_name(node)
+                    root = name.split(".")[0]
+                    if root in ("requests", "socket") and "." in name:
+                        yield self._finding(ctx, node, f"{name}(...)")
+
+    def _finding(self, ctx, node, what: str) -> Finding:
+        return Finding(
+            self.id,
+            ctx.relpath,
+            node.lineno,
+            f"{what} -- internode traffic must ride dist/transport.py "
+            "RestClient so the deadline/chaos/metrics seams apply",
+        )
+
+
+# ---------------------------------------------------------------------------
+# deadline-rebind
+# ---------------------------------------------------------------------------
+
+
+class DeadlineRebindRule(Rule):
+    """The deadline budget must ride EVERY hop (tools/deadline_lint.py,
+    generalized to the AST).
+
+    Two obligations:
+      1. dist/transport.py keeps the plumbing: a `deadline.remaining()`
+         check, a DEADLINE_HEADER stamp on outgoing requests
+         (`headers[DEADLINE_HEADER] = ...`), and a DeadlineExceeded raise.
+      2. Every internode REST *server* module (one that authenticates
+         TOKEN_HEADER on inbound requests) re-binds the propagated budget
+         with `deadline.bind_header(...)` -- a hop that drops the header
+         resets the budget to infinity for everything downstream."""
+
+    id = "deadline-rebind"
+    title = "deadline propagation plumbing dropped"
+    scope = ("minio_tpu/",)
+
+    def check(self, project: ProjectContext):
+        tctx = project.get(TRANSPORT)
+        if tctx is not None:
+            yield from self._check_transport(tctx)
+        for ctx in project.iter_files(*self.scope):
+            if ctx.relpath == TRANSPORT:
+                continue
+            if self._authenticates_token(ctx) and not self._rebinds(ctx):
+                yield Finding(
+                    self.id,
+                    ctx.relpath,
+                    1,
+                    "authenticates TOKEN_HEADER (REST server) but never calls "
+                    "deadline.bind_header -- inbound budgets are dropped here",
+                )
+
+    def _check_transport(self, ctx):
+        has_remaining = False
+        has_stamp = False
+        has_exceeded = False
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _call_name(node).endswith(
+                "deadline.remaining"
+            ):
+                has_remaining = True
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Subscript)
+                        and isinstance(tgt.slice, ast.Name)
+                        and tgt.slice.id == "DEADLINE_HEADER"
+                    ):
+                        has_stamp = True
+            if isinstance(node, ast.Raise) and node.exc is not None:
+                name = ""
+                if isinstance(node.exc, ast.Call):
+                    name = _call_name(node.exc)
+                elif isinstance(node.exc, (ast.Name, ast.Attribute)):
+                    cur = node.exc
+                    name = cur.attr if isinstance(cur, ast.Attribute) else cur.id
+                if "DeadlineExceeded" in name:
+                    has_exceeded = True
+        if not has_remaining:
+            yield Finding(self.id, ctx.relpath, 1,
+                          "missing deadline.remaining() budget check before the hop")
+        if not has_stamp:
+            yield Finding(self.id, ctx.relpath, 1,
+                          "missing headers[DEADLINE_HEADER] stamp on outgoing RPCs")
+        if not has_exceeded:
+            yield Finding(self.id, ctx.relpath, 1,
+                          "missing DeadlineExceeded raise for a spent budget")
+
+    @staticmethod
+    def _authenticates_token(ctx) -> bool:
+        for node in ast.walk(ctx.tree):
+            if (
+                isinstance(node, ast.Call)
+                and _call_name(node).endswith("headers.get")
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == "TOKEN_HEADER"
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _rebinds(ctx) -> bool:
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _call_name(node).endswith(
+                "deadline.bind_header"
+            ):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
+# lock-blocking-io
+# ---------------------------------------------------------------------------
+
+
+class LockBlockingIORule(Rule):
+    """Blocking I/O inside a `with <lock>:` body.
+
+    A sleep, HTTP call, or file open while holding a mutex convoys every
+    other thread that needs it -- the exact pattern behind the refresh-
+    daemon redesign in dist/locks.py. Do the I/O outside, publish results
+    under the lock."""
+
+    id = "lock-blocking-io"
+    title = "blocking I/O while holding a lock"
+    scope = ("minio_tpu/storage/", "minio_tpu/dist/", "minio_tpu/control/")
+
+    _LOCK_HINTS = ("lock", "mutex", "_mu", "sem")
+    _BLOCKING_EXACT = {
+        "time.sleep", "sleep", "open", "subprocess.run", "subprocess.Popen",
+        "subprocess.check_call", "subprocess.check_output",
+        "socket.create_connection", "tempfile.NamedTemporaryFile",
+    }
+    _BLOCKING_PREFIX = ("requests.",)
+    _BLOCKING_SUFFIX = (".read_file", ".write_all", ".create_file", ".append_file")
+
+    def _is_lock_expr(self, expr: ast.AST) -> bool:
+        name = ""
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Call):
+            # with self._locks[i] / with lock() styles resolve via the callee
+            return self._is_lock_expr(expr.func)
+        elif isinstance(expr, ast.Subscript):
+            return self._is_lock_expr(expr.value)
+        low = name.lower()
+        return any(h in low for h in self._LOCK_HINTS)
+
+    def _is_blocking(self, call: ast.Call) -> bool:
+        name = _call_name(call)
+        if name in self._BLOCKING_EXACT:
+            return True
+        if any(name.startswith(p) for p in self._BLOCKING_PREFIX):
+            return True
+        return any(name.endswith(s) for s in self._BLOCKING_SUFFIX)
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, (ast.With, ast.AsyncWith)):
+                    continue
+                if not any(
+                    self._is_lock_expr(item.context_expr) for item in node.items
+                ):
+                    continue
+                for stmt in node.body:
+                    for sub in ast.walk(stmt):
+                        # Deferred work (nested defs) runs after release.
+                        if isinstance(
+                            sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                        ):
+                            break
+                        if isinstance(sub, ast.Call) and self._is_blocking(sub):
+                            yield Finding(
+                                self.id,
+                                ctx.relpath,
+                                sub.lineno,
+                                f"{_call_name(sub)}(...) inside a `with lock:` "
+                                "body -- do the I/O outside, publish under "
+                                "the lock",
+                            )
+
+
+# ---------------------------------------------------------------------------
+# resource-leak
+# ---------------------------------------------------------------------------
+
+
+class ResourceLeakRule(Rule):
+    """open()/NamedTemporaryFile() without `with` or a closing try/finally.
+
+    A handle that leaks on the exception path pins an fd (and on staged
+    writes, a .tmp file) until GC happens to run -- under load that is fd
+    exhaustion. Acceptable shapes: `with open(...)`, `f = open(...)` later
+    entered as `with f:` or closed via `f.close()` in a `finally:`, or the
+    handle escaping as a return value / argument (ownership transferred)."""
+
+    id = "resource-leak"
+    title = "file handle not closed on all paths"
+    scope = HOT_PATHS
+
+    _OPENERS = {
+        "open", "tempfile.NamedTemporaryFile", "tempfile.TemporaryFile",
+        "NamedTemporaryFile", "TemporaryFile", "io.open",
+    }
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            for fn in ast.walk(ctx.tree):
+                if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from self._check_function(ctx, fn)
+
+    def _check_function(self, ctx, fn):
+        with_exprs: set[int] = set()     # id() of calls used as with-items
+        owned: set[int] = set()          # id() of calls whose result escapes
+        assigns: dict[int, str] = {}     # id(call) -> simple target name
+        calls: list[ast.Call] = []
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call):
+                            with_exprs.add(id(sub))
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Call):
+                    pass
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Call):
+                            owned.add(id(sub))
+            if isinstance(node, ast.Return) and node.value is not None:
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call):
+                        owned.add(id(sub))
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name):
+                    for sub in ast.walk(node.value):
+                        if isinstance(sub, ast.Call):
+                            assigns[id(sub)] = tgt.id
+            if isinstance(node, ast.Call) and self._is_opener(node):
+                calls.append(node)
+
+        closed_names = self._names_closed_or_withed(fn)
+        for call in calls:
+            if id(call) in with_exprs or id(call) in owned:
+                continue
+            name = assigns.get(id(call))
+            if name is not None and name in closed_names:
+                continue
+            yield Finding(
+                self.id,
+                ctx.relpath,
+                call.lineno,
+                f"{_call_name(call)}(...) result is neither entered as "
+                "`with` nor closed in a try/finally -- leaks the handle "
+                "on the exception path",
+            )
+
+    def _is_opener(self, call: ast.Call) -> bool:
+        return _call_name(call) in self._OPENERS
+
+    @staticmethod
+    def _names_closed_or_withed(fn) -> set[str]:
+        """Names later entered as `with <name>:` anywhere in the function,
+        or `.close()`d inside a `finally:` block."""
+        names: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Name):
+                        names.add(item.context_expr.id)
+            if isinstance(node, ast.Try):
+                for stmt in node.finalbody:
+                    for sub in ast.walk(stmt):
+                        if (
+                            isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "close"
+                            and isinstance(sub.func.value, ast.Name)
+                        ):
+                            names.add(sub.func.value.id)
+        return names
+
+
+# ---------------------------------------------------------------------------
+# stage-key
+# ---------------------------------------------------------------------------
+
+
+class StageKeyRule(Rule):
+    """Every literal stage mark must name a registered (layer, stage) key.
+
+    control/perf.py declares STAGES (the literal registry) and
+    DYNAMIC_STAGE_LAYERS (layers whose stage names are computed at runtime:
+    per-peer endpoints, per-storage-API names). A mark outside both would
+    silently mint a new unaggregated ledger series no dashboard knows about
+    -- register it (and its dashboard row) or fix the typo."""
+
+    id = "stage-key"
+    title = "stage mark not registered in control/perf.py"
+    scope = ("minio_tpu/",)
+
+    def _load_registry(self, project):
+        stages: set[tuple[str, str]] = set()
+        dynamic: set[str] = set()
+        ctx = project.get(PERF)
+        if ctx is None:
+            return None, None
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt, value = node.target, node.value
+            else:
+                continue
+            if not isinstance(tgt, ast.Name):
+                continue
+            if tgt.id == "STAGES":
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Tuple) and len(sub.elts) == 2:
+                        layer = _str_const(sub.elts[0])
+                        stage = _str_const(sub.elts[1])
+                        if layer is not None and stage is not None:
+                            stages.add((layer, stage))
+            elif tgt.id == "DYNAMIC_STAGE_LAYERS":
+                for sub in ast.walk(value):
+                    s = _str_const(sub)
+                    if s is not None:
+                        dynamic.add(s)
+        return (stages or None), (dynamic or None)
+
+    def check(self, project: ProjectContext):
+        stages, dynamic = self._load_registry(project)
+        if stages is None:
+            ctx = project.get(PERF)
+            if ctx is not None:
+                yield Finding(
+                    self.id, PERF, 1,
+                    "STAGES registry literal not found in control/perf.py",
+                )
+            return
+        dynamic = dynamic or set()
+        layers = {l for l, _ in stages} | dynamic
+        for ctx in project.iter_files("minio_tpu/"):
+            if ctx.relpath in (PERF, "minio_tpu/control/tracing.py"):
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _call_name(node)
+                if name.endswith("tracing.span") or name.endswith("tracing.root_span"):
+                    if len(node.args) < 2:
+                        continue
+                    stage_arg, layer_arg = node.args[0], node.args[1]
+                elif name.endswith("ledger.record"):
+                    if len(node.args) < 2:
+                        continue
+                    layer_arg, stage_arg = node.args[0], node.args[1]
+                else:
+                    continue
+                layer = _str_const(layer_arg)
+                stage = _str_const(stage_arg)
+                if layer is None:
+                    continue  # computed layer: nothing checkable statically
+                if stage is None:
+                    if layer not in layers:
+                        yield Finding(
+                            self.id, ctx.relpath, node.lineno,
+                            f"dynamic stage mark in unregistered layer "
+                            f"{layer!r} -- add it to DYNAMIC_STAGE_LAYERS "
+                            "in control/perf.py",
+                        )
+                elif (layer, stage) not in stages and layer not in dynamic:
+                    yield Finding(
+                        self.id, ctx.relpath, node.lineno,
+                        f"stage key ({layer!r}, {stage!r}) not in the "
+                        "control/perf.py STAGES registry",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# metrics-rendered
+# ---------------------------------------------------------------------------
+
+
+class MetricsRenderedRule(Rule):
+    """Counters bumped in control/degrade.py and control/perf.py must be
+    rendered by control/metrics.py.
+
+    A counter nobody exports is a measurement nobody sees: the increment
+    costs a lock on the hot path and buys zero observability. Every public
+    `self.<name> += ...` / keyed-dict bump in DegradeStats and
+    SlowRequestCapture must appear (as a string key or attribute) in the
+    exposition renderer."""
+
+    id = "metrics-rendered"
+    title = "counter incremented but never rendered in control/metrics.py"
+    scope = (DEGRADE, PERF)
+
+    _COUNTER_CLASSES = {"DegradeStats", "SlowRequestCapture"}
+
+    def _counters(self, ctx) -> list[tuple[str, int]]:
+        out: list[tuple[str, int]] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if node.name not in self._COUNTER_CLASSES:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.AugAssign) or not isinstance(
+                    sub.op, ast.Add
+                ):
+                    continue
+                tgt = sub.target
+                name = None
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    name = tgt.attr
+                elif (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Attribute)
+                    and isinstance(tgt.value.value, ast.Name)
+                    and tgt.value.value.id == "self"
+                ):
+                    name = tgt.value.attr
+                if name and not name.startswith("_"):
+                    out.append((name, sub.lineno))
+        # keyed bumps written as self.d[k] = self.d.get(k, 0) + 1
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if (
+                isinstance(tgt, ast.Subscript)
+                and isinstance(tgt.value, ast.Attribute)
+                and isinstance(tgt.value.value, ast.Name)
+                and tgt.value.value.id == "self"
+                and not tgt.value.attr.startswith("_")
+                and isinstance(node.value, ast.BinOp)
+                and isinstance(node.value.op, ast.Add)
+            ):
+                out.append((tgt.value.attr, node.lineno))
+        return out
+
+    @staticmethod
+    def _rendered_tokens(metrics_ctx) -> set[str]:
+        tokens: set[str] = set()
+        for node in ast.walk(metrics_ctx.tree):
+            if isinstance(node, ast.Constant) and isinstance(node.value, str):
+                tokens.add(node.value)
+            if isinstance(node, ast.Attribute):
+                tokens.add(node.attr)
+        return tokens
+
+    def check(self, project: ProjectContext):
+        metrics_ctx = project.get(METRICS)
+        if metrics_ctx is None:
+            return
+        tokens = self._rendered_tokens(metrics_ctx)
+        seen: set[str] = set()
+        for relpath in self.scope:
+            ctx = project.get(relpath)
+            if ctx is None:
+                continue
+            for name, lineno in self._counters(ctx):
+                if name in seen:
+                    continue
+                seen.add(name)
+                if name not in tokens:
+                    yield Finding(
+                        self.id, ctx.relpath, lineno,
+                        f"counter {name!r} is incremented here but "
+                        "control/metrics.py never renders it",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# typed-errors
+# ---------------------------------------------------------------------------
+
+
+class TypedErrorsRule(Rule):
+    """API handlers must raise typed errors, never `raise Exception(...)`.
+
+    api/errors.py maps exception TYPES onto S3 wire codes; an untyped raise
+    can only ever surface as a 500 InternalError with a leaked str(e). Use
+    S3Error / utils.errors types so the client sees the right code."""
+
+    id = "typed-errors"
+    title = "untyped raise in an API module"
+    scope = ("minio_tpu/api/",)
+
+    _UNTYPED = {"Exception", "BaseException", "RuntimeError"}
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Raise) or node.exc is None:
+                    continue
+                exc = node.exc
+                name = None
+                if isinstance(exc, ast.Call) and isinstance(exc.func, ast.Name):
+                    name = exc.func.id
+                elif isinstance(exc, ast.Name):
+                    name = exc.id
+                if name in self._UNTYPED:
+                    yield Finding(
+                        self.id, ctx.relpath, node.lineno,
+                        f"raise {name}(...) in an API module -- raise "
+                        "S3Error or a typed utils.errors class so the "
+                        "client sees a real S3 code",
+                    )
+
+
+# ---------------------------------------------------------------------------
+# unlocked-global
+# ---------------------------------------------------------------------------
+
+
+class UnlockedGlobalRule(Rule):
+    """Mutable module globals mutated outside a lock.
+
+    A module-level dict/list/set written from request or worker threads
+    without a lock is a check-then-act race (the `_HASH_SELECT` class of
+    bug). Either guard every mutation with a module lock, or mark the
+    binding `# mtpulint: immutable` when it is write-once at import time."""
+
+    id = "unlocked-global"
+    title = "mutable module global mutated without a lock"
+    scope = ("minio_tpu/",)
+
+    _MUTABLE_CTORS = {
+        "dict", "list", "set", "OrderedDict", "defaultdict", "deque",
+        "collections.OrderedDict", "collections.defaultdict",
+        "collections.deque",
+    }
+    _MUTATORS = {
+        "append", "add", "update", "pop", "popitem", "clear", "extend",
+        "insert", "remove", "discard", "setdefault", "appendleft",
+    }
+    _LOCK_HINTS = ("lock", "mutex", "_mu", "sem")
+
+    def _module_mutables(self, ctx) -> dict[str, int]:
+        """Module-level `NAME = {}/[]/set()/...` bindings -> lineno."""
+        out: dict[str, int] = {}
+        body = getattr(ctx.tree, "body", [])
+        for node in body:
+            targets = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set)) or (
+                isinstance(value, ast.Call)
+                and _call_name(value) in self._MUTABLE_CTORS
+            )
+            if not mutable:
+                continue
+            for tgt in targets:
+                if isinstance(tgt, ast.Name) and not self._marked_immutable(
+                    ctx, node.lineno
+                ):
+                    out[tgt.id] = node.lineno
+        return out
+
+    @staticmethod
+    def _marked_immutable(ctx, lineno: int) -> bool:
+        lines = ctx.lines
+        if 1 <= lineno <= len(lines) and "immutable" in lines[lineno - 1]:
+            return True
+        return lineno >= 2 and "immutable" in lines[lineno - 2]
+
+    def _is_lock_expr(self, expr: ast.AST) -> bool:
+        name = ""
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Subscript):
+            return self._is_lock_expr(expr.value)
+        low = name.lower()
+        return any(h in low for h in self._LOCK_HINTS)
+
+    def _mutation_at(self, node, names: set[str]):
+        """(name, lineno) when THIS node (not its subtree) mutates a
+        watched global: subscript assign/del/augassign, or a mutator-method
+        call (`g.append(...)`, `g.setdefault(...)`, ...)."""
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = node.targets if isinstance(node, ast.Assign) else [node.target]
+            for tgt in tgts:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in names
+                ):
+                    return (tgt.value.id, node.lineno)
+        if isinstance(node, ast.Delete):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Subscript)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in names
+                ):
+                    return (tgt.value.id, node.lineno)
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in self._MUTATORS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in names
+        ):
+            return (node.func.value.id, node.lineno)
+        return None
+
+    def _mutations(self, fn, names: set[str]):
+        """(name, lineno, locked) for every mutation of a watched global
+        inside `fn`, where locked = lexically inside a `with <lock>:` body
+        at any nesting depth. Each node is visited exactly once, carrying
+        the innermost lock state down the tree."""
+
+        def scan(node, locked: bool):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                body_locked = locked or any(
+                    self._is_lock_expr(i.context_expr) for i in node.items
+                )
+                for item in node.items:
+                    yield from scan(item.context_expr, locked)
+                for child in node.body:
+                    yield from scan(child, body_locked)
+                return
+            hit = self._mutation_at(node, names)
+            if hit is not None:
+                yield (*hit, locked)
+            for child in ast.iter_child_nodes(node):
+                yield from scan(child, locked)
+
+        for stmt in fn.body:
+            yield from scan(stmt, False)
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            mutables = self._module_mutables(ctx)
+            if not mutables:
+                continue
+            names = set(mutables)
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for name, lineno, locked in self._mutations(node, names):
+                    if not locked:
+                        yield Finding(
+                            self.id, ctx.relpath, lineno,
+                            f"module global {name!r} mutated outside a "
+                            "lock -- guard it, or mark the binding "
+                            "`# mtpulint: immutable` if write-once",
+                        )
+
+
+ALL_RULES: list[Rule] = [
+    SwallowedExceptRule(),
+    RawTransportRule(),
+    DeadlineRebindRule(),
+    LockBlockingIORule(),
+    ResourceLeakRule(),
+    StageKeyRule(),
+    MetricsRenderedRule(),
+    TypedErrorsRule(),
+    UnlockedGlobalRule(),
+]
+
+# deadline_lint.py's historical surface: the two rules that together are the
+# old regex lint, runnable standalone by the shim and chaos_check.
+DEADLINE_RULES: list[Rule] = [
+    RawTransportRule(),
+    DeadlineRebindRule(),
+]
