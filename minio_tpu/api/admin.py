@@ -528,6 +528,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         ?cluster=1 merges every peer's ledger into one view; ?reset=1 zeroes
         the ledger, slow-capture ring, and drive EWMAs for a clean
         before/after measurement window (fanned out with ?cluster=1)."""
+        from ..control.degrade import GLOBAL_DEGRADE
         from ..control.perf import GLOBAL_PERF, merge_snapshots, summarize
 
         q = request.rel_url.query
@@ -538,6 +539,9 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         out: dict = {
             "node": {"stages": summarize(snap)},
             "slow": GLOBAL_PERF.slow.stats(),
+            # Degradation-ladder counters (hedges fired/won, breaker trips,
+            # sheds): an SLO report needs these next to the latency tails.
+            "degrade": GLOBAL_DEGRADE.snapshot(),
         }
 
         drives = {}

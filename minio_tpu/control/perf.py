@@ -92,8 +92,11 @@ STAGES: frozenset = frozenset({
 })
 
 # Layers whose stage names are computed at runtime (per-API root spans,
-# per-peer endpoints, per-StorageAPI call names): checked by layer only.
-DYNAMIC_STAGE_LAYERS: frozenset = frozenset({"api", "rpc", "rpc-peer", "storage"})
+# per-peer endpoints, per-StorageAPI call names, per-op loadgen latencies):
+# checked by layer only.
+DYNAMIC_STAGE_LAYERS: frozenset = frozenset(
+    {"api", "rpc", "rpc-peer", "storage", "loadgen"}
+)
 
 # -- stage ledger -------------------------------------------------------------
 
@@ -191,9 +194,21 @@ def quantile(counts: list[int], q: float) -> float:
     return BUCKET_LE_S[-1] * 2
 
 
+def bucket_max(counts: list[int]) -> float:
+    """Upper edge (SECONDS) of the highest non-empty bucket: the tightest
+    bound on the worst observation the bucket scheme can give. The +Inf
+    slot reports the same sentinel as quantile() -- twice the last edge."""
+    for i in range(len(counts) - 1, -1, -1):
+        if counts[i]:
+            return BUCKET_LE_S[-1] * 2 if i >= N_BUCKETS else BUCKET_LE_S[i]
+    return 0.0
+
+
 def summarize(snap: dict) -> dict:
-    """Admin-payload shape: per (layer, stage) count/total plus p50/p95/p99
-    (milliseconds -- the unit operators reason about request stages in)."""
+    """Admin-payload shape: per (layer, stage) count/total plus
+    p50/p95/p99/p99.9/max (milliseconds -- the unit operators reason about
+    request stages in). Tail SLOs need more than p99: a stage can hold its
+    p99 while its p99.9 and max walk off into timeout territory."""
     out: dict[str, dict[str, dict]] = {}
     for layer, stages in snap.get("stages", {}).items():
         for stage, h in stages.items():
@@ -206,6 +221,8 @@ def summarize(snap: dict) -> dict:
                 "p50_ms": round(quantile(counts, 0.50) * 1e3, 3),
                 "p95_ms": round(quantile(counts, 0.95) * 1e3, 3),
                 "p99_ms": round(quantile(counts, 0.99) * 1e3, 3),
+                "p999_ms": round(quantile(counts, 0.999) * 1e3, 3),
+                "max_ms": round(bucket_max(counts) * 1e3, 3),
             }
     return out
 

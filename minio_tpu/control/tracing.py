@@ -29,6 +29,8 @@ subscriber-gated.
 from __future__ import annotations
 
 import contextvars
+import itertools
+import os
 import secrets
 import time
 from typing import Iterator
@@ -42,6 +44,45 @@ TRACE_HEADER = "X-Mtpu-Trace"
 _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
     "minio_tpu_span", default=None
 )
+
+# -- span sampling (MTPU_TRACE_SAMPLE) ----------------------------------------
+#
+# High-concurrency load (tools/loadgen.py) can root tens of thousands of
+# requests per second; publishing every span tree to the hub and buffering
+# every trace in the slow-request capture turns the observer into the
+# bottleneck. MTPU_TRACE_SAMPLE in [0, 1] keeps 1-in-round(1/rate) request
+# roots "sampled": sampled-out requests STILL feed the perf ledger (stage
+# attribution stays exact -- it is bucket increments, not span records) but
+# skip hub publication and slow-capture buffering. Default 1.0 = trace all.
+
+_sample_counter = itertools.count()  # deterministic 1-in-N, not coin flips
+_sample_cached: tuple[str, float] = ("", 1.0)  # (raw env value, parsed rate)
+
+
+def _sample_rate() -> float:
+    """Parse MTPU_TRACE_SAMPLE lazily, memoized on the raw string so the
+    knob can be flipped at runtime without a per-request float() parse."""
+    global _sample_cached
+    raw = os.environ.get("MTPU_TRACE_SAMPLE", "")
+    cached_raw, cached_rate = _sample_cached
+    if raw == cached_raw:
+        return cached_rate
+    try:
+        rate = min(max(float(raw), 0.0), 1.0) if raw else 1.0
+    except ValueError:
+        rate = 1.0
+    _sample_cached = (raw, rate)
+    return rate
+
+
+def _sample_next() -> bool:
+    """Deterministic sampling decision for the next request root."""
+    rate = _sample_rate()
+    if rate >= 1.0:
+        return True
+    if rate <= 0.0:
+        return False
+    return next(_sample_counter) % max(1, round(1.0 / rate)) == 0
 
 
 def _new_id() -> str:
@@ -64,6 +105,7 @@ class Span:
         "sys",
         "start",
         "tags",
+        "sampled",
         "_token",
         "_closed",
     )
@@ -75,6 +117,7 @@ class Span:
         trace_id: str,
         parent_id: str,
         sys: TraceSys,
+        sampled: bool = True,
         **tags,
     ):
         self.name = name
@@ -85,6 +128,7 @@ class Span:
         self.sys = sys
         self.start = time.perf_counter()
         self.tags = tags
+        self.sampled = sampled
         self._token = None
         self._closed = False
 
@@ -112,9 +156,10 @@ class Span:
         self._closed = True
         duration = time.perf_counter() - self.start
         # The stage ledger records UNCONDITIONALLY -- attribution must not
-        # depend on someone watching the hub (control/perf.py).
+        # depend on someone watching the hub OR on the sampling knob
+        # (control/perf.py); only span PUBLICATION is sampled.
         GLOBAL_PERF.on_span_finish(self, duration, error)
-        if not self.sys.enabled():
+        if not self.sampled or not self.sys.enabled():
             return
         fields = dict(self.tags)
         if error:
@@ -138,6 +183,7 @@ class _NoopSpan:
     trace_id = ""
     span_id = ""
     parent_id = ""
+    sampled = False
 
     def set(self, **tags) -> None:
         pass
@@ -183,7 +229,12 @@ def span(name: str, layer: str, sys: TraceSys | None = None, **tags):
     if parent is None and not tsys.enabled():
         return NOOP
     if parent is not None:
-        return Span(name, layer, parent.trace_id, parent.span_id, tsys, **tags)
+        # Children inherit the root's sampling verdict (a _RemoteParent has
+        # no flag: the calling node already decided to trace this request).
+        return Span(
+            name, layer, parent.trace_id, parent.span_id, tsys,
+            sampled=getattr(parent, "sampled", True), **tags,
+        )
     return Span(name, layer, _new_id(), "", tsys, **tags)
 
 
@@ -193,10 +244,14 @@ def root_span(name: str, layer: str, trace_id: str, sys: TraceSys | None = None,
 
     Always a real span: the root is what arms stage attribution for the
     whole request tree (perf ledger + slow-request capture); publishing to
-    the hub still costs nothing without subscribers."""
+    the hub still costs nothing without subscribers. Under
+    MTPU_TRACE_SAMPLE < 1, sampled-out roots skip slow-capture buffering
+    and hub publication but still feed the ledger."""
     tsys = sys or GLOBAL_TRACE
-    GLOBAL_PERF.slow.begin_trace(trace_id)
-    return Span(name, layer, trace_id, "", tsys, **tags)
+    sampled = _sample_next()
+    if sampled:
+        GLOBAL_PERF.slow.begin_trace(trace_id)
+    return Span(name, layer, trace_id, "", tsys, sampled=sampled, **tags)
 
 
 class _RemoteParent:

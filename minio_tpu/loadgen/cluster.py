@@ -1,0 +1,93 @@
+"""In-process multi-node cluster for loadgen runs.
+
+The same machinery the distributed tests trust (tests/test_dist.py): N
+Node instances over local temp-dir drives, each behind its own
+ThreadedServer on a localhost port, sharing nothing but the endpoint list
+-- real sigv4 auth, real internode REST, real erasure IO, one process.
+Packaged here (not in tests/) so `tools/loadgen.py` can stand a cluster up
+outside pytest; tests/harness.py re-exports it for fixtures.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from types import SimpleNamespace
+
+ROOT_USER = "loadgenadmin"
+ROOT_PASSWORD = "loadgen-secret-key"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class InProcessCluster:
+    """N-node erasure cluster in this process; `urls` is the S3 surface."""
+
+    def __init__(
+        self,
+        workdir: str,
+        n_nodes: int = 4,
+        drives_per_node: int = 4,
+        root_user: str = ROOT_USER,
+        root_password: str = ROOT_PASSWORD,
+        build_timeout_s: float = 120.0,
+    ):
+        from ..api.server import ThreadedServer
+        from ..dist.node import Node
+
+        self.root_user = root_user
+        self.root_password = root_password
+        ports = [_free_port() for _ in range(n_nodes)]
+        self.urls = [f"http://127.0.0.1:{p}" for p in ports]
+        endpoints = []
+        for ni in range(n_nodes):
+            for di in range(drives_per_node):
+                d = os.path.join(workdir, f"n{ni}d{di}")
+                os.makedirs(d, exist_ok=True)
+                endpoints.append(f"{self.urls[ni]}{d}")
+        self.nodes = [
+            Node(
+                endpoints,
+                url=self.urls[ni],
+                root_user=root_user,
+                root_password=root_password,
+                set_drive_count=n_nodes * drives_per_node,
+            )
+            for ni in range(n_nodes)
+        ]
+        self.servers = []
+        try:
+            for ni, node in enumerate(self.nodes):
+                ts = ThreadedServer(SimpleNamespace(app=node.make_app()), port=ports[ni])
+                ts.start()
+                self.servers.append(ts)
+            # Build concurrently: node 0 leads the format, the rest wait
+            # for quorum (the same dance real multi-server boot does).
+            threads = [threading.Thread(target=n.build, daemon=True) for n in self.nodes]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(build_timeout_s)
+            if not all(n.pools is not None for n in self.nodes):
+                raise RuntimeError(
+                    f"cluster failed to build within {build_timeout_s:.0f}s "
+                    f"({n_nodes} nodes x {drives_per_node} drives)"
+                )
+        except BaseException:
+            self.stop()
+            raise
+
+    def stop(self) -> None:
+        for ts in self.servers:
+            try:
+                ts.stop()
+            except Exception:  # noqa: BLE001 - teardown must reach every server
+                pass
+        self.servers = []

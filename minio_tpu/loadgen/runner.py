@@ -1,0 +1,270 @@
+"""Scenario execution: drive a target with the generated op sequence and
+measure every op into the control/perf.py bucket scheme.
+
+Timing discipline:
+  * op generation is pre-run (generators.py) -- the replay clock never
+    waits on the dice;
+  * prepopulation happens OFF the clock -- a scenario measures steady
+    state, not its own setup;
+  * each phase owns a fresh StageLedger keyed ("loadgen", op kind), so
+    per-phase tails never bleed into each other, and the phase snapshots
+    merge (control/perf.py merge_snapshots) into the run-wide view.
+
+Chaos windows arm real faults through the admin surface at their declared
+offsets (threading.Timer off the worker path) and ALWAYS disarm on exit --
+a loadgen crash must not leave a live cluster injecting faults.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+from ..control.perf import StageLedger
+from .generators import Op, generate_ops, op_sequence_hash
+from .spec import Phase, Scenario
+from .target import OpResult, S3Target
+
+# Op-list cap for duration-bounded phases (generated up front; the run
+# consumes a prefix). Logged into the phase result when it truncates.
+_DURATION_OP_CAP = 200_000
+
+
+def _payload(key: str, size: int) -> bytes:
+    """Deterministic CSV-shaped payload: SELECT ops over these objects
+    exercise the real scan path instead of erroring on binary junk."""
+    if size <= 0:
+        return b""
+    row = f"{key},0123456789abcdef,42\n".encode()
+    reps = size // len(row) + 1
+    return (row * reps)[:size]
+
+
+@dataclass
+class PhaseResult:
+    name: str
+    concurrency: int
+    wall_s: float = 0.0
+    executed: int = 0
+    generated: int = 0
+    truncated: bool = False  # duration phase hit the op-list cap
+    op_hash: str = ""
+    ledger: StageLedger = field(default_factory=StageLedger)
+    # kind -> {"ok": n, "bytes": n, "errors": {class: n}}
+    kinds: dict = field(default_factory=dict)
+    # second offset -> {"ops": n, "errors": n}
+    timeline: dict = field(default_factory=dict)
+    chaos_windows: list = field(default_factory=list)
+
+
+class ScenarioRunner:
+    def __init__(self, scenario: Scenario, target: S3Target, admin, log=None):
+        self.scenario = scenario
+        self.target = target
+        self.admin = admin  # InProcessAdmin | EndpointAdmin
+        self._log = log or (lambda msg: None)
+
+    # -- op dispatch -------------------------------------------------------
+
+    def _execute(self, op: Op) -> OpResult:
+        b = self.scenario.bucket
+        node = op.index  # S3Target mods by len(urls): round-robin
+        if op.kind == "GET":
+            return self.target.get(b, op.key, node=node)
+        if op.kind == "PUT":
+            return self.target.put(b, op.key, _payload(op.key, op.size), node=node)
+        if op.kind == "DELETE":
+            return self.target.delete(b, op.key, node=node)
+        if op.kind == "LIST":
+            return self.target.list(b, op.prefix, self.scenario.list_max_keys, node=node)
+        if op.kind == "MULTIPART":
+            part = _payload(op.key, self.scenario.multipart_part_size)
+            return self.target.multipart(
+                b, op.key, part, self.scenario.multipart_parts, node=node
+            )
+        if op.kind == "SELECT":
+            return self.target.select(b, op.key, node=node)
+        return OpResult(False, "unknown-op", 0)
+
+    # -- setup -------------------------------------------------------------
+
+    def prepopulate(self) -> int:
+        """PUT the declared base keyspace (off the measurement clock)."""
+        sc = self.scenario
+        self.target.ensure_bucket(sc.bucket)
+        if not sc.prepopulate:
+            return 0
+        import random
+
+        from .generators import SizeDistribution
+
+        rng = random.Random(sc.seed ^ 0x5EED)
+        sizes = SizeDistribution(sc.sizes)
+        keys = [
+            (f"{sc.prefix}key-{kid:06d}", sizes.sample(rng))
+            for kid in range(min(sc.prepopulate, sc.keys))
+        ]
+        failures = 0
+        with ThreadPoolExecutor(max_workers=8, thread_name_prefix="lg-prepop") as ex:
+            futs = [
+                ex.submit(self.target.put, sc.bucket, k, _payload(k, n), i)
+                for i, (k, n) in enumerate(keys)
+            ]
+            for f in futs:
+                if not f.result().ok:
+                    failures += 1
+        if failures:
+            raise RuntimeError(f"prepopulate: {failures}/{len(keys)} PUTs failed")
+        return len(keys)
+
+    # -- phase execution ---------------------------------------------------
+
+    def _run_phase(self, phase: Phase) -> PhaseResult:
+        count = phase.ops or _DURATION_OP_CAP
+        ops = generate_ops(self.scenario, phase, count)
+        pr = PhaseResult(
+            name=phase.name,
+            concurrency=phase.concurrency,
+            generated=len(ops),
+            truncated=not phase.ops,
+            op_hash=op_sequence_hash(ops),
+        )
+        stats_lock = threading.Lock()
+        next_idx = itertools.count()
+        stop = threading.Event()
+        start = time.monotonic()
+        deadline = start + phase.duration_s if phase.duration_s else None
+
+        def worker(wi: int) -> None:
+            if phase.ramp_s and phase.concurrency > 1:
+                delay = phase.ramp_s * wi / phase.concurrency
+                if stop.wait(delay):
+                    return
+            while not stop.is_set():
+                i = next(next_idx)
+                if i >= len(ops):
+                    return
+                now = time.monotonic()
+                if deadline is not None and now >= deadline:
+                    return
+                op = ops[i]
+                t0 = time.perf_counter()
+                res = self._execute(op)
+                dt = time.perf_counter() - t0
+                pr.ledger.record("loadgen", op.kind, dt)
+                sec = int(time.monotonic() - start)
+                with stats_lock:
+                    pr.executed += 1
+                    row = pr.kinds.setdefault(
+                        op.kind, {"ok": 0, "bytes": 0, "errors": {}}
+                    )
+                    tl = pr.timeline.setdefault(sec, {"ops": 0, "errors": 0})
+                    tl["ops"] += 1
+                    if res.ok:
+                        row["ok"] += 1
+                        row["bytes"] += res.nbytes
+                    else:
+                        row["errors"][res.error_class] = (
+                            row["errors"].get(res.error_class, 0) + 1
+                        )
+                        tl["errors"] += 1
+
+        timers: list[threading.Timer] = []
+        armed: dict[str, dict] = {}
+        armed_lock = threading.Lock()
+
+        def arm(window_i: int, fault: dict, at_s: float, for_s: float) -> None:
+            try:
+                fid = self.admin.arm_fault(fault)
+            except Exception as e:  # noqa: BLE001 - report, don't kill workers
+                pr.chaos_windows.append(
+                    {"at_s": at_s, "for_s": for_s, "fault": fault,
+                     "error": f"{type(e).__name__}: {e}"[:200]}
+                )
+                return
+            rec = {
+                "at_s": at_s, "for_s": for_s, "fault": fault, "fault_id": fid,
+                "armed_at_s": round(time.monotonic() - start, 3),
+            }
+            with armed_lock:
+                armed[fid] = rec
+            pr.chaos_windows.append(rec)
+            t = threading.Timer(for_s, disarm, args=(fid,))
+            t.daemon = True
+            timers.append(t)
+            t.start()
+
+        def disarm(fid: str) -> None:
+            with armed_lock:
+                rec = armed.pop(fid, None)
+            if rec is None:
+                return
+            try:
+                self.admin.disarm_fault(fid)
+                rec["disarmed_at_s"] = round(time.monotonic() - start, 3)
+            except Exception as e:  # noqa: BLE001
+                rec["error"] = f"disarm: {type(e).__name__}: {e}"[:200]
+
+        for wi_c, cw in enumerate(phase.chaos):
+            t = threading.Timer(cw.at_s, arm, args=(wi_c, cw.fault, cw.at_s, cw.for_s))
+            t.daemon = True
+            timers.append(t)
+            t.start()
+
+        threads = [
+            threading.Thread(target=worker, args=(wi,), name=f"lg-{phase.name}-{wi}")
+            for wi in range(phase.concurrency)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            stop.set()
+            for t in timers:
+                t.cancel()
+            for fid in list(armed):
+                disarm(fid)
+        pr.wall_s = time.monotonic() - start
+        return pr
+
+    # -- whole run ---------------------------------------------------------
+
+    def run(self) -> dict:
+        from .report import build_report
+
+        sc = self.scenario
+        self._log(f"prepopulating {sc.prepopulate} objects into {sc.bucket!r}")
+        self.prepopulate()
+        # A clean measurement window: setup traffic must not pollute the
+        # cluster stage breakdown the report attributes the run to.
+        try:
+            self.admin.reset_perf()
+        except Exception:  # noqa: BLE001 - a live target may deny admin
+            pass
+        results: list[PhaseResult] = []
+        for phase in sc.phases:
+            self._log(
+                f"phase {phase.name!r}: concurrency={phase.concurrency} "
+                + (f"ops={phase.ops}" if phase.ops else f"duration={phase.duration_s}s")
+            )
+            results.append(self._run_phase(phase))
+        try:
+            stage_breakdown = self.admin.stage_breakdown()
+        except Exception:  # noqa: BLE001
+            stage_breakdown = {}
+        try:
+            degrade = self.admin.degrade()
+        except Exception:  # noqa: BLE001
+            degrade = {}
+        return build_report(
+            sc,
+            results,
+            stage_breakdown=stage_breakdown,
+            degrade=degrade,
+            probe_cached=bool(getattr(self.admin, "probe_cached", False)),
+        )

@@ -20,18 +20,25 @@ at 127.0.0.1:8083), and server boot must never wedge on it. The probe
 Env:
     MINIO_TPU_CODEC = auto | device | host   (default auto)
     MINIO_TPU_DEVICE_PROBE_S                 probe timeout, default 60
+    MTPU_PROBE_CACHE                         path of a cross-process verdict
+                                             cache file ("" / unset = off)
+    MTPU_PROBE_CACHE_TTL_S                   verdict freshness, default 3600
+                                             (failed verdicts: capped at 900)
 """
 
 from __future__ import annotations
 
 import atexit
+import json
 import os
 import signal
 import subprocess
 import sys
 import tempfile
 import threading
+import time
 from dataclasses import dataclass, field
+
 
 from .object import codec as codec_mod
 
@@ -44,6 +51,7 @@ class ProbeResult:
     device_kind: str | None = None
     error: str | None = None  # short reason on failure
     detail: str = ""  # stdout+stderr tail (faulthandler dump, relay checks)
+    cached: bool = False  # True when served from the cross-process file cache
 
     @property
     def ok(self) -> bool:
@@ -73,6 +81,89 @@ def _tail(text: str, limit: int = 4000) -> str:
     return text[-limit:] if len(text) > limit else text
 
 
+# -- cross-process probe verdict cache ----------------------------------------
+#
+# The in-memory cache above is per-process; bench.py and tools/loadgen.py are
+# fresh processes every run and were re-paying the full probe (180 s against a
+# wedged tunnel, BENCH_r04-r05) just to re-learn a verdict that rarely
+# changes. When MTPU_PROBE_CACHE names a file, completed verdicts are stored
+# there with a timestamp and honored within MTPU_PROBE_CACHE_TTL_S (default
+# 3600 s). Failed verdicts are honored for at most 900 s -- a recovered
+# device must not stay masked for an hour -- so re-probing is bounded, not
+# eliminated. The cache is OPT-IN: servers and tests probe in-process as
+# before unless the env names a path.
+
+_PROBE_FAIL_TTL_CAP_S = 900.0
+
+
+def _probe_cache_file() -> str:
+    return os.environ.get("MTPU_PROBE_CACHE", "")
+
+
+def _probe_cache_ttl() -> float:
+    try:
+        return float(os.environ.get("MTPU_PROBE_CACHE_TTL_S", "") or 3600.0)
+    except ValueError:
+        return 3600.0
+
+
+def _load_probe_file() -> ProbeResult | None:
+    """Fresh cached verdict from MTPU_PROBE_CACHE, or None (disabled /
+    missing / stale / unreadable -- every miss means 'probe for real')."""
+    path = _probe_cache_file()
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(doc, dict) or "time" not in doc:
+        return None
+    try:
+        age = time.time() - float(doc["time"])
+    except (TypeError, ValueError):
+        return None
+    ttl = _probe_cache_ttl()
+    platform = doc.get("platform") or None
+    if platform in (None, "cpu"):
+        ttl = min(ttl, _PROBE_FAIL_TTL_CAP_S)
+    if age < 0 or age >= ttl:
+        return None
+    return ProbeResult(
+        platform,
+        doc.get("device_kind") or None,
+        error=doc.get("error") or None,
+        detail=str(doc.get("detail", "")),
+        cached=True,
+    )
+
+
+def _store_probe_file(result: ProbeResult) -> None:
+    """Best-effort atomic write of the verdict (tmp + rename); a cache that
+    cannot be written must never fail the probe that produced the result."""
+    path = _probe_cache_file()
+    if not path:
+        return
+    doc = {
+        "time": time.time(),
+        "platform": result.platform,
+        "device_kind": result.device_kind,
+        "error": result.error,
+        "detail": _tail(result.detail, 2000),
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
 def probe_device(timeout_s: float, use_cache: bool = True) -> ProbeResult:
     """Bounded, evidence-preserving, non-leaking probe of jax device init.
 
@@ -93,6 +184,12 @@ def probe_device(timeout_s: float, use_cache: bool = True) -> ProbeResult:
             if not _atexit_registered:
                 atexit.register(_reap_live_probes)
                 _atexit_registered = True
+        if use_cache:
+            filed = _load_probe_file()
+            if filed is not None:
+                with _probe_lock:
+                    _probe_cache = filed
+                return filed
         return _probe_uncached(timeout_s)
 
 
@@ -172,6 +269,7 @@ def _probe_uncached(timeout_s: float) -> ProbeResult:
             )
     with _probe_lock:
         _probe_cache = result
+    _store_probe_file(result)
     return result
 
 

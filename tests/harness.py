@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 
+from minio_tpu.loadgen.cluster import InProcessCluster as ClusterHarness  # noqa: F401
 from minio_tpu.object.erasure import ErasureObjects
 from minio_tpu.storage import format as fmt
 from minio_tpu.storage.local import LocalDrive

@@ -1,0 +1,425 @@
+"""Loadgen harness tests: spec parsing (typed errors), generator
+determinism and shape, report/SLO math, and a fast in-process smoke run.
+
+Replay identity is the property everything else leans on: the same
+scenario + seed must produce the byte-identical op sequence anywhere, so
+two CI runs of a report diff compare the SYSTEM, not the dice. The smoke
+run is the tier-1 witness that the whole chain (spec -> generators ->
+runner -> cluster -> report -> exposition) holds together.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import random
+from pathlib import Path
+
+import pytest
+
+from minio_tpu.loadgen import (
+    SizeDistribution,
+    SpecError,
+    ZipfianGenerator,
+    build_report,
+    evaluate_slo,
+    generate_ops,
+    load_scenario,
+    op_sequence_hash,
+    parse_scenario,
+    render_prometheus,
+)
+from minio_tpu.loadgen.report import BURN_CAP
+from minio_tpu.loadgen.runner import PhaseResult
+
+_REPO = Path(__file__).resolve().parent.parent
+_LINT_PATH = _REPO / "tools" / "metrics_lint.py"
+_spec = importlib.util.spec_from_file_location("metrics_lint", _LINT_PATH)
+metrics_lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(metrics_lint)
+
+
+def _doc(**over) -> dict:
+    """A minimal valid scenario document, overridable per test."""
+    doc = {
+        "name": "t",
+        "seed": 9,
+        "keyspace": {"keys": 32, "prepopulate": 16, "prefix": "t/", "zipf_theta": 0.9},
+        "phases": [{"name": "p0", "mix": {"GET": 0.7, "PUT": 0.3}, "ops": 50}],
+    }
+    doc.update(over)
+    return doc
+
+
+class TestSpecParser:
+    def test_all_shipped_scenarios_parse(self):
+        files = sorted((_REPO / "scenarios").glob("*.yaml"))
+        assert len(files) >= 5, "canonical scenario set went missing"
+        for f in files:
+            sc = load_scenario(str(f))
+            assert sc.phases, f.name
+
+    def test_missing_name_is_typed(self):
+        with pytest.raises(SpecError) as ei:
+            parse_scenario({"phases": []})
+        assert ei.value.path == "$.name"
+
+    def test_unknown_op_kind_names_the_field(self):
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(phases=[{"name": "p", "mix": {"FROB": 1.0}, "ops": 1}]))
+        assert "FROB" in ei.value.path
+
+    def test_zero_weight_mix_rejected(self):
+        with pytest.raises(SpecError):
+            parse_scenario(_doc(phases=[{"name": "p", "mix": {"GET": 0.0}, "ops": 1}]))
+
+    def test_phase_needs_some_budget(self):
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(phases=[{"name": "p", "mix": {"GET": 1.0}}]))
+        assert "ops or duration_s" in str(ei.value)
+
+    def test_duplicate_phase_names_rejected(self):
+        ph = {"name": "p", "mix": {"GET": 1.0}, "ops": 1}
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(phases=[ph, dict(ph)]))
+        assert ei.value.path == "$.phases"
+
+    def test_compare_must_reference_real_phases(self):
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(compare={"a": "p0", "b": "ghost"}))
+        assert ei.value.path == "$.compare.b"
+
+    def test_prepopulate_bounded_by_keyspace(self):
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(keyspace={"keys": 4, "prepopulate": 9}))
+        assert ei.value.path == "$.keyspace.prepopulate"
+
+    def test_unknown_size_kind_rejected(self):
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(sizes={"kind": "pareto"}))
+        assert ei.value.path == "$.sizes.kind"
+
+    def test_error_budget_over_one_rejected(self):
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(slo={"GET": {"error_budget": 1.5}}))
+        assert "error_budget" in ei.value.path
+
+    def test_chaos_fault_needs_kind(self):
+        ph = {
+            "name": "p", "mix": {"GET": 1.0}, "ops": 1,
+            "chaos": [{"at_s": 0, "for_s": 1, "fault": {"prob": 1.0}}],
+        }
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(phases=[ph]))
+        assert ei.value.path.endswith(".fault")
+
+    def test_wrong_type_names_expected_type(self):
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(
+                _doc(phases=[{"name": "p", "mix": {"GET": 1.0}, "ops": 1,
+                              "concurrency": "four"}])
+            )
+        assert "expected" in str(ei.value)
+
+    def test_json_specs_load(self, tmp_path):
+        p = tmp_path / "s.json"
+        p.write_text(json.dumps(_doc()))
+        assert load_scenario(str(p)).name == "t"
+
+    def test_unreadable_and_invalid_files_are_typed(self, tmp_path):
+        with pytest.raises(SpecError):
+            load_scenario(str(tmp_path / "missing.json"))
+        bad = tmp_path / "bad.json"
+        bad.write_text("{nope")
+        with pytest.raises(SpecError) as ei:
+            load_scenario(str(bad))
+        assert "invalid JSON" in str(ei.value)
+
+    def test_mix_weights_normalize(self):
+        sc = parse_scenario(
+            _doc(phases=[{"name": "p", "mix": {"GET": 3, "PUT": 1}, "ops": 1}])
+        )
+        assert sc.phases[0].mix == {"GET": 0.75, "PUT": 0.25}
+
+
+class TestZipfian:
+    def test_same_seed_same_sequence(self):
+        a = ZipfianGenerator(128, 0.99, random.Random(7))
+        b = ZipfianGenerator(128, 0.99, random.Random(7))
+        assert [a.next_key() for _ in range(500)] == [b.next_key() for _ in range(500)]
+
+    def test_keys_stay_in_range(self):
+        g = ZipfianGenerator(64, 0.99, random.Random(1))
+        assert all(0 <= g.next_key() < 64 for _ in range(2000))
+
+    def test_theta_skews_the_head(self):
+        g = ZipfianGenerator(256, 0.99, random.Random(3))
+        ranks = [g.next_rank() for _ in range(5000)]
+        head_share = sum(1 for r in ranks if r == 0) / len(ranks)
+        assert head_share > 5 / 256  # way above the uniform 1/n share
+
+    def test_theta_zero_is_uniform_ish(self):
+        g = ZipfianGenerator(64, 0.0, random.Random(5))
+        ranks = [g.next_rank() for _ in range(4000)]
+        assert len(set(ranks)) > 50  # mass spreads over most of the space
+
+    def test_invalid_parameters_raise(self):
+        with pytest.raises(ValueError):
+            ZipfianGenerator(0, 0.5, random.Random(1))
+        with pytest.raises(ValueError):
+            ZipfianGenerator(8, 1.0, random.Random(1))
+
+
+class TestSizeDistribution:
+    def test_fixed(self):
+        d = SizeDistribution({"kind": "fixed", "bytes": 4096})
+        assert d.sample(random.Random(1)) == 4096
+
+    def test_uniform_bounds(self):
+        d = SizeDistribution({"kind": "uniform", "min": 10, "max": 20})
+        rng = random.Random(2)
+        assert all(10 <= d.sample(rng) <= 20 for _ in range(500))
+
+    def test_lognormal_clamps(self):
+        d = SizeDistribution(
+            {"kind": "lognormal", "mean": 1000, "sigma": 2.0, "min": 100, "max": 5000}
+        )
+        rng = random.Random(3)
+        assert all(100 <= d.sample(rng) <= 5000 for _ in range(500))
+
+    def test_choice_only_picks_listed(self):
+        d = SizeDistribution(
+            {"kind": "choice", "choices": [
+                {"bytes": 1, "weight": 1}, {"bytes": 2, "weight": 0}
+            ]}
+        )
+        rng = random.Random(4)
+        assert {d.sample(rng) for _ in range(200)} == {1}
+
+
+class TestGenerateOps:
+    def test_same_seed_identical_hash(self):
+        sc = parse_scenario(_doc())
+        h1 = op_sequence_hash(generate_ops(sc, sc.phases[0], 200))
+        h2 = op_sequence_hash(generate_ops(sc, sc.phases[0], 200))
+        assert h1 == h2
+
+    def test_different_seed_different_hash(self):
+        a = parse_scenario(_doc(seed=1))
+        b = parse_scenario(_doc(seed=2))
+        assert op_sequence_hash(generate_ops(a, a.phases[0], 200)) != op_sequence_hash(
+            generate_ops(b, b.phases[0], 200)
+        )
+
+    def test_only_declared_kinds_appear(self):
+        sc = parse_scenario(_doc())
+        kinds = {op.kind for op in generate_ops(sc, sc.phases[0], 300)}
+        assert kinds <= {"GET", "PUT"}
+
+    def test_empty_keyspace_get_degrades_to_put(self):
+        sc = parse_scenario(
+            _doc(keyspace={"keys": 8, "prepopulate": 0},
+                 phases=[{"name": "p", "mix": {"GET": 1.0}, "ops": 20}])
+        )
+        ops = generate_ops(sc, sc.phases[0], 20)
+        assert ops[0].kind == "PUT"  # nothing to read yet
+
+    def test_reads_target_keys_that_exist_at_that_point(self):
+        sc = parse_scenario(
+            _doc(keyspace={"keys": 16, "prepopulate": 4, "prefix": "t/"},
+                 phases=[{"name": "p",
+                          "mix": {"GET": 0.4, "PUT": 0.3, "DELETE": 0.3},
+                          "ops": 400}])
+        )
+        live = {f"t/key-{k:06d}" for k in range(4)}
+        for op in generate_ops(sc, sc.phases[0], 400):
+            if op.kind in ("GET", "SELECT"):
+                assert op.key in live, f"read of dead key at op {op.index}"
+            elif op.kind == "PUT":
+                live.add(op.key)
+            elif op.kind == "DELETE":
+                assert op.key in live
+                live.discard(op.key)
+
+    def test_phase_sizes_override_scenario_sizes(self):
+        sc = parse_scenario(
+            _doc(sizes={"kind": "fixed", "bytes": 4096},
+                 phases=[
+                     {"name": "a", "mix": {"PUT": 1.0}, "ops": 10},
+                     {"name": "b", "mix": {"PUT": 1.0}, "ops": 10,
+                      "sizes": {"kind": "fixed", "bytes": 7777}},
+                 ])
+        )
+        assert {o.size for o in generate_ops(sc, sc.phases[0], 10)} == {4096}
+        assert {o.size for o in generate_ops(sc, sc.phases[1], 10)} == {7777}
+
+    def test_list_ops_carry_prefix(self):
+        sc = parse_scenario(
+            _doc(phases=[{"name": "p", "mix": {"LIST": 1.0}, "ops": 5}])
+        )
+        for op in generate_ops(sc, sc.phases[0], 5):
+            assert op.kind == "LIST" and op.prefix == "t/" and op.size == 0
+
+
+def _phase_result(name: str, kinds: dict, latencies: dict, wall_s: float = 2.0):
+    """Synthetic PhaseResult: counters + ledger observations."""
+    pr = PhaseResult(name=name, concurrency=4, wall_s=wall_s, op_hash="x")
+    pr.kinds = kinds
+    pr.executed = sum(
+        row["ok"] + sum(row["errors"].values()) for row in kinds.values()
+    )
+    pr.generated = pr.executed
+    for kind, durs in latencies.items():
+        for d in durs:
+            pr.ledger.record("loadgen", kind, d)
+    return pr
+
+
+class TestReportAndSlo:
+    def _scenario(self, **over):
+        doc = _doc(
+            slo={"GET": {"p99_ms": 100.0, "error_budget": 0.02}}, **over
+        )
+        return parse_scenario(doc)
+
+    def test_4xx_errors_do_not_burn_budget(self):
+        sc = self._scenario()
+        merged = {
+            "GET": {"ok": 96, "errors": {"4xx:NoSuchKey": 4}, "p99_ms": 50.0}
+        }
+        row = evaluate_slo(sc, merged)["GET"]
+        assert row["budget_burn"] == 0.0
+        assert row["ok"] is True
+
+    def test_5xx_errors_burn(self):
+        sc = self._scenario()
+        merged = {
+            "GET": {"ok": 96, "errors": {"5xx:SlowDownRead": 4}, "p99_ms": 50.0}
+        }
+        row = evaluate_slo(sc, merged)["GET"]
+        assert row["budget_burn"] == pytest.approx(0.04 / 0.02, rel=1e-3)
+        assert row["burn_ok"] is False and row["ok"] is False
+
+    def test_zero_budget_uses_cap_sentinel(self):
+        sc = parse_scenario(
+            _doc(slo={"GET": {"p99_ms": 0, "error_budget": 0.0}})
+        )
+        merged = {"GET": {"ok": 9, "errors": {"transport:timeout": 1}, "p99_ms": 1.0}}
+        assert evaluate_slo(sc, merged)["GET"]["budget_burn"] == BURN_CAP
+
+    def test_unexercised_op_is_skipped_not_failed(self):
+        sc = self._scenario()
+        assert "skipped" in evaluate_slo(sc, {})["GET"]
+
+    def test_p99_target_judgment(self):
+        sc = self._scenario()
+        merged = {"GET": {"ok": 10, "errors": {}, "p99_ms": 250.0}}
+        row = evaluate_slo(sc, merged)["GET"]
+        assert row["p99_ok"] is False and row["ok"] is False
+
+    def test_build_report_schema_and_compare(self):
+        sc = parse_scenario(
+            _doc(
+                phases=[
+                    {"name": "single", "mix": {"PUT": 1.0}, "ops": 4},
+                    {"name": "concurrent", "mix": {"PUT": 1.0}, "ops": 8},
+                ],
+                compare={"a": "single", "b": "concurrent", "op": "PUT",
+                         "metric": "bytes_per_s", "min_ratio": 2.0},
+            )
+        )
+        a = _phase_result(
+            "single", {"PUT": {"ok": 4, "bytes": 4000, "errors": {}}},
+            {"PUT": [0.01] * 4}, wall_s=1.0,
+        )
+        b = _phase_result(
+            "concurrent", {"PUT": {"ok": 8, "bytes": 1600, "errors": {}}},
+            {"PUT": [0.02] * 8}, wall_s=1.0,
+        )
+        rep = build_report(sc, [a, b], stage_breakdown={"api": {}}, degrade={})
+        assert rep["loadgen_report"] == 1
+        put = rep["ops"]["PUT"]
+        for k in ("p50_ms", "p95_ms", "p99_ms", "p999_ms", "max_ms",
+                  "ops_per_s", "bytes_per_s", "error_rate"):
+            assert k in put, k
+        assert rep["phases"]["single"]["op_sequence_sha256"] == "x"
+        cmp = rep["compare"]
+        assert cmp["ratio"] == pytest.approx(4000 / 1600, rel=1e-3)
+        assert cmp["reproduced"] is True  # 2.5x >= 2.0
+
+    def test_render_prometheus_is_lint_clean(self):
+        sc = self._scenario()
+        pr = _phase_result(
+            "p0",
+            {"GET": {"ok": 5, "bytes": 100, "errors": {"5xx:Err": 1}}},
+            {"GET": [0.001] * 6},
+        )
+        rep = build_report(sc, [pr], stage_breakdown={}, degrade={})
+        text = render_prometheus(rep)
+        assert metrics_lint.validate_exposition(text) == []
+        assert metrics_lint.lint_exposition(text) == []
+        for series in (
+            "minio_tpu_loadgen_ops_total",
+            "minio_tpu_loadgen_latency_ms",
+            "minio_tpu_loadgen_throughput_bytes_per_second",
+            "minio_tpu_loadgen_slo_burn",
+        ):
+            assert series in text, series
+
+
+class TestSmokeRun:
+    """End-to-end: tiny scenario against a real 2-node in-process cluster.
+
+    This is the tier-1 witness for the whole harness; the bigger canonical
+    scenarios run through tools/loadgen.py out-of-band.
+    """
+
+    def test_smoke_scenario_end_to_end(self, tmp_path):
+        from minio_tpu.loadgen.cluster import InProcessCluster
+        from minio_tpu.loadgen.runner import ScenarioRunner
+        from minio_tpu.loadgen.target import InProcessAdmin, S3Target
+
+        sc = parse_scenario(
+            {
+                "name": "ci_smoke",
+                "seed": 3,
+                "bucket": "lgsmoke",
+                "cluster": {"nodes": 2, "drives_per_node": 4},
+                "keyspace": {"keys": 16, "prepopulate": 8, "prefix": "sm/",
+                             "zipf_theta": 0.9},
+                "sizes": {"kind": "fixed", "bytes": 2048},
+                # In-process CI clusters shed under GET/DELETE races (503
+                # SlowDownRead) -- the budget tolerates a few.
+                "slo": {"GET": {"p99_ms": 30000, "error_budget": 0.25},
+                        "PUT": {"p99_ms": 30000, "error_budget": 0.25}},
+                "phases": [
+                    {"name": "mixed",
+                     "mix": {"GET": 0.5, "PUT": 0.3, "LIST": 0.1, "DELETE": 0.1},
+                     "concurrency": 3, "ops": 30}
+                ],
+            }
+        )
+        cluster = InProcessCluster(str(tmp_path), n_nodes=2, drives_per_node=4)
+        try:
+            target = S3Target(cluster.urls, cluster.root_user, cluster.root_password)
+            report = ScenarioRunner(sc, target, InProcessAdmin()).run()
+        finally:
+            cluster.stop()
+
+        assert report["loadgen_report"] == 1
+        assert report["phases"]["mixed"]["executed"] == 30
+        assert set(report["ops"]) <= {"GET", "PUT", "LIST", "DELETE"}
+        for row in report["ops"].values():
+            assert "p99_ms" in row and "max_ms" in row
+        # The cluster's own stage attribution rode along.
+        assert "api" in report["stage_breakdown"]
+        assert "sheds" in report["degrade"]
+        # SLO section judged both declared ops.
+        assert set(report["slo"]) == {"GET", "PUT"}
+        # Exposition of a real run stays lint-clean.
+        text = render_prometheus(report)
+        assert metrics_lint.validate_exposition(text) == []
+        assert metrics_lint.lint_exposition(text) == []
+        # Replay identity: regenerating the phase reproduces the hash.
+        regen = op_sequence_hash(generate_ops(sc, sc.phases[0], 30))
+        assert report["phases"]["mixed"]["op_sequence_sha256"] == regen

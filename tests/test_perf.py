@@ -24,6 +24,7 @@ from minio_tpu.control.perf import (
     SlowRequestCapture,
     StageLedger,
     bucket_index,
+    bucket_max,
     merge_snapshots,
     quantile,
     summarize,
@@ -169,8 +170,29 @@ class TestQuantile:
         s = summarize(led.snapshot())
         row = s["api"]["auth"]
         assert row["count"] == 1
-        for k in ("total_ms", "mean_ms", "p50_ms", "p95_ms", "p99_ms"):
+        for k in ("total_ms", "mean_ms", "p50_ms", "p95_ms", "p99_ms", "p999_ms", "max_ms"):
             assert k in row
+
+    def test_p999_separates_the_one_in_a_thousand_tail(self):
+        led = StageLedger()
+        for _ in range(998):
+            led.record("l", "s", 0.001)
+        for _ in range(2):
+            led.record("l", "s", 4.0)  # tail spikes p99 must NOT show
+        row = summarize(led.snapshot())["l"]["s"]
+        assert row["p99_ms"] <= 2.0 * 1.024  # still in the ~1ms bucket
+        assert row["p999_ms"] >= 4000.0      # tail quantile sees the spike
+        assert row["max_ms"] >= 4000.0
+
+    def test_bucket_max_is_occupied_upper_edge(self):
+        led = StageLedger()
+        led.record("l", "s", 0.003)
+        counts = led.snapshot()["stages"]["l"]["s"]["counts"]
+        est = bucket_max(counts)
+        assert 0.003 <= est <= 0.006  # upper edge of the 3ms bucket
+
+    def test_bucket_max_empty_is_zero(self):
+        assert bucket_max([0] * (N_BUCKETS + 1)) == 0.0
 
 
 class TestSlowCapture:
@@ -275,6 +297,66 @@ class TestAlwaysOnWiring:
         assert dt / n < 500e-6, f"stage mark cost {dt / n * 1e6:.1f}us"
 
 
+class TestTraceSampling:
+    """MTPU_TRACE_SAMPLE: publication is sampled, attribution is not."""
+
+    def _reset_counter(self):
+        import itertools
+
+        tracing._sample_counter = itertools.count()
+
+    def test_sampled_out_root_feeds_ledger_but_not_hub_or_slow_ring(self, monkeypatch):
+        from minio_tpu.control.pubsub import TraceSys
+
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
+        perf.GLOBAL_PERF.ledger.reset()
+        pending_before = perf.GLOBAL_PERF.slow.stats()["pending_traces"]
+        tsys = TraceSys()
+        q = tsys.subscribe()
+        try:
+            with tracing.root_span("op", "samplelayer", "trace-sampled-out", sys=tsys) as root:
+                assert root.sampled is False
+                with tracing.span("stage-b", "samplelayer", sys=tsys) as child:
+                    assert child.sampled is False  # verdict inherited
+        finally:
+            tsys.unsubscribe(q)
+        snap = perf.GLOBAL_PERF.ledger.snapshot()
+        assert sum(snap["stages"]["samplelayer"]["op"]["counts"]) == 1
+        assert sum(snap["stages"]["samplelayer"]["stage-b"]["counts"]) == 1
+        assert q.empty()  # nothing published to the hub
+        assert perf.GLOBAL_PERF.slow.stats()["pending_traces"] == pending_before
+
+    def test_rate_one_keeps_every_root(self, monkeypatch):
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "1")
+        self._reset_counter()
+        assert all(tracing._sample_next() for _ in range(8))
+
+    def test_rate_half_is_deterministic_one_in_two(self, monkeypatch):
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0.5")
+        self._reset_counter()
+        assert [tracing._sample_next() for _ in range(6)] == [
+            True, False, True, False, True, False,
+        ]
+
+    def test_garbage_value_means_trace_all(self, monkeypatch):
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "banana")
+        self._reset_counter()
+        assert all(tracing._sample_next() for _ in range(4))
+
+    def test_sampled_root_still_publishes(self, monkeypatch):
+        from minio_tpu.control.pubsub import TraceSys
+
+        monkeypatch.setenv("MTPU_TRACE_SAMPLE", "1")
+        tsys = TraceSys()
+        q = tsys.subscribe()
+        try:
+            with tracing.root_span("op", "samplelayer", "trace-sampled-in", sys=tsys):
+                pass
+        finally:
+            tsys.unsubscribe(q)
+        assert not q.empty()
+
+
 class TestCodecObservatory:
     def test_batching_counters_reach_exposition(self):
         """The device-codec counters (occupancy, host fallbacks, compiled
@@ -350,3 +432,55 @@ class TestPerfGate:
 
     def test_missing_breakdown_compares_empty(self):
         assert perf_gate.compare({}, {}, threshold=0.1) == []
+
+
+class TestPerfGateSlo:
+    """--slo mode over loadgen reports (tools/loadgen.py emissions)."""
+
+    def _report(self, p99_ms: float, burn: float = 0.5, p99_ok: bool = True) -> dict:
+        return {
+            "ops": {"GET": {"p99_ms": p99_ms, "count": 100}},
+            "slo": {
+                "GET": {
+                    "p99_ms": p99_ms,
+                    "target_p99_ms": 500.0,
+                    "p99_ok": p99_ok,
+                    "budget_burn": burn,
+                    "error_budget": 0.02,
+                    "ok": p99_ok and burn <= 1.0,
+                }
+            },
+        }
+
+    def test_doctored_p99_regression_is_flagged(self):
+        old = self._report(100.0)
+        new = self._report(300.0)  # 3x, way past tol and floor
+        kinds = [f["kind"] for f in perf_gate.compare_slo(old, new)]
+        assert "p99-regression" in kinds
+
+    def test_within_tolerance_passes(self):
+        old = self._report(100.0)
+        new = self._report(110.0)  # +10% < 25% tol
+        assert perf_gate.compare_slo(old, new) == []
+
+    def test_small_absolute_growth_is_noise(self):
+        # 1ms -> 3ms triples but stays under the 5ms floor: bucket noise.
+        old = self._report(1.0)
+        new = self._report(3.0)
+        assert perf_gate.compare_slo(old, new) == []
+
+    def test_burn_violation_is_absolute(self):
+        # No old-side data needed: burning the budget flags on its own.
+        new = self._report(100.0, burn=4.9)
+        findings = perf_gate.compare_slo({}, new)
+        assert [f["kind"] for f in findings] == ["burn-violation"]
+        assert findings[0]["budget_burn"] == pytest.approx(4.9)
+
+    def test_p99_target_miss_is_flagged(self):
+        new = self._report(900.0, p99_ok=False)
+        kinds = [f["kind"] for f in perf_gate.compare_slo({}, new)]
+        assert "p99-violation" in kinds
+
+    def test_partial_shapes_tolerated(self):
+        assert perf_gate.compare_slo({}, {}) == []
+        assert perf_gate.compare_slo({"ops": None}, {"ops": {"GET": "oops"}}) == []

@@ -14,8 +14,17 @@ A stage is flagged when BOTH hold:
   * its absolute time grew too -- a share can grow because OTHER stages
     got faster, which is an improvement, not a regression.
 
+SLO mode (`--slo`) gates loadgen reports (tools/loadgen.py) instead:
+per-op p99 regressions between two same-scenario reports, plus absolute
+SLO violations (budget burn > 1, declared p99 target missed) in the new
+report. A p99 is flagged only when it grew by BOTH a relative tolerance
+and an absolute floor -- bucket-scheme quantiles are coarse, and a
+1 ms -> 2 ms "doubling" is measurement noise, not a regression.
+
 Usage:
     python tools/perf_gate.py OLD.json NEW.json [--threshold 0.10]
+    python tools/perf_gate.py --slo OLD.json NEW.json \\
+        [--p99-tol=0.25] [--min-ms=5]
 
 Exit 0 = no stage regressed, 1 = regression(s) flagged, 2 = unusable
 input (missing/unparseable breakdowns -- the gate cannot vouch either
@@ -28,6 +37,8 @@ import json
 import sys
 
 DEFAULT_THRESHOLD = 0.10  # share points a stage may grow before flagging
+DEFAULT_P99_TOL = 0.25    # relative p99 growth tolerated between reports
+DEFAULT_MIN_MS = 5.0      # ...and the absolute floor under which it's noise
 
 
 def _breakdowns(bench: dict) -> dict:
@@ -70,6 +81,60 @@ def compare(old: dict, new: dict, threshold: float = DEFAULT_THRESHOLD) -> list[
     return flagged
 
 
+def compare_slo(
+    old: dict,
+    new: dict,
+    p99_tol: float = DEFAULT_P99_TOL,
+    min_ms: float = DEFAULT_MIN_MS,
+) -> list[dict]:
+    """SLO findings between two loadgen reports (tolerates partial shapes).
+
+    Three finding kinds:
+      * p99-regression: an op's p99 grew past old * (1 + p99_tol) AND by
+        more than min_ms (both sides must report the op);
+      * burn-violation: the new report burned more than its whole error
+        budget (burn > 1.0) -- absolute, old report not required;
+      * p99-violation: the new report misses its own declared p99 target.
+    """
+    findings: list[dict] = []
+    old_ops = old.get("ops") if isinstance(old.get("ops"), dict) else {}
+    new_ops = new.get("ops") if isinstance(new.get("ops"), dict) else {}
+    for op, new_row in sorted(new_ops.items()):
+        old_row = old_ops.get(op)
+        if not isinstance(new_row, dict) or not isinstance(old_row, dict):
+            continue
+        try:
+            old_p99 = float(old_row.get("p99_ms", 0.0))
+            new_p99 = float(new_row.get("p99_ms", 0.0))
+        except (TypeError, ValueError):
+            continue
+        if old_p99 > 0 and new_p99 > old_p99 * (1.0 + p99_tol) and new_p99 - old_p99 > min_ms:
+            findings.append(
+                {"kind": "p99-regression", "op": op,
+                 "old_p99_ms": old_p99, "new_p99_ms": new_p99}
+            )
+    slo = new.get("slo") if isinstance(new.get("slo"), dict) else {}
+    for op, row in sorted(slo.items()):
+        if not isinstance(row, dict):
+            continue
+        try:
+            burn = float(row.get("budget_burn", 0.0))
+        except (TypeError, ValueError):
+            burn = 0.0
+        if burn > 1.0:
+            findings.append(
+                {"kind": "burn-violation", "op": op, "budget_burn": burn,
+                 "error_budget": row.get("error_budget")}
+            )
+        if row.get("p99_ok") is False:
+            findings.append(
+                {"kind": "p99-violation", "op": op,
+                 "p99_ms": row.get("p99_ms"),
+                 "target_p99_ms": row.get("target_p99_ms")}
+            )
+    return findings
+
+
 def _load(path: str) -> dict | None:
     """Last parseable JSON object line of a file (BENCH logs are JSONL;
     the final line is the bench's one-object contract)."""
@@ -93,15 +158,39 @@ def _load(path: str) -> dict | None:
 def main(argv: list[str]) -> int:
     args = [a for a in argv if not a.startswith("--")]
     threshold = DEFAULT_THRESHOLD
+    p99_tol, min_ms = DEFAULT_P99_TOL, DEFAULT_MIN_MS
+    slo_mode = "--slo" in argv
     for a in argv:
         if a.startswith("--threshold="):
             threshold = float(a.split("=", 1)[1])
+        elif a.startswith("--p99-tol="):
+            p99_tol = float(a.split("=", 1)[1])
+        elif a.startswith("--min-ms="):
+            min_ms = float(a.split("=", 1)[1])
     if len(args) != 2:
         print(__doc__, file=sys.stderr)
         return 2
     old, new = _load(args[0]), _load(args[1])
     if old is None or new is None:
         return 2
+    if slo_mode:
+        if not new.get("ops") and not new.get("slo"):
+            print("perf_gate: new report has no ops/slo sections; nothing to gate",
+                  file=sys.stderr)
+            return 2
+        findings = compare_slo(old, new, p99_tol, min_ms)
+        for f in findings:
+            if f["kind"] == "p99-regression":
+                print(f"REGRESSED p99 {f['op']}: "
+                      f"{f['old_p99_ms']:.1f} ms -> {f['new_p99_ms']:.1f} ms")
+            elif f["kind"] == "burn-violation":
+                print(f"SLO BURN {f['op']}: {f['budget_burn']:.2f}x the error budget")
+            else:
+                print(f"SLO MISS {f['op']}: p99 {f['p99_ms']} ms "
+                      f"over target {f['target_p99_ms']} ms")
+        if not findings:
+            print("perf_gate: slo ok")
+        return 1 if findings else 0
     if not _breakdowns(old) or not _breakdowns(new):
         print("perf_gate: no stage_breakdown on one side; nothing to compare",
               file=sys.stderr)
