@@ -50,6 +50,7 @@ from ..utils import errors as oerr
 from . import zipext
 from .auth import SigV4Verifier, UNSIGNED_PAYLOAD
 from .errors import S3Error, from_object_error
+from ..control.sanitizer import san_lock, san_rlock
 
 MAX_OBJECT_SIZE = 5 * (1 << 30)  # single-PUT cap, matching S3
 
@@ -286,7 +287,7 @@ class S3Server:
         # every one of them times out. 0 disables the gate.
         self._max_requests = int(_os.environ.get("MTPU_API_REQUESTS_MAX", "512"))
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        self._inflight_lock = san_lock("S3Server._inflight_lock")
         self.app = web.Application(client_max_size=MAX_OBJECT_SIZE)
         self.app.router.add_route("*", "/{tail:.*}", self._entry)
         # Hooks filled in by the control plane (events, metrics, trace).

@@ -29,6 +29,7 @@ from typing import Callable
 from aiohttp import web
 
 from ..control.logging import GLOBAL_LOGGER
+from ..control.sanitizer import san_lock, san_rlock
 
 
 class HubBridge:
@@ -42,7 +43,8 @@ class HubBridge:
         self._sub = hub.subscribe() if hub is not None else None
         self._thread = threading.Thread(target=self._pump, daemon=True, name="hub-bridge")
         self._peer_resps: list = []
-        self._peer_lock = threading.Lock()
+        self._peer_threads: list[threading.Thread] = []
+        self._peer_lock = san_lock("HubBridge._peer_lock")
 
     def offer_threadsafe(self, item) -> None:
         """Enqueue from any thread; drops when the watcher is slow."""
@@ -109,9 +111,12 @@ class HubBridge:
                         pass
 
         for fn in stream_fns:
-            threading.Thread(
+            t = threading.Thread(
                 target=pump, args=(fn,), daemon=True, name="peer-stream-pump"
-            ).start()
+            )
+            with self._peer_lock:
+                self._peer_threads.append(t)
+            t.start()
 
     def close(self) -> None:
         self.stop.set()
@@ -119,11 +124,19 @@ class HubBridge:
             self.hub.unsubscribe(self._sub)
         with self._peer_lock:
             resps, self._peer_resps = self._peer_resps, []
+            threads, self._peer_threads = self._peer_threads, []
         for r in resps:
             try:
                 r.close()  # aborts the pump's blocking iter_lines
             except OSError:
                 pass
+        # The local pump wakes within its 0.5s poll; peer pumps unblock when
+        # their responses are closed above (a pump still connecting rides the
+        # transport timeout -- don't stall the event loop waiting for it).
+        if self._thread.is_alive():
+            self._thread.join(2.0)
+        for t in threads:
+            t.join(2.0)
 
 
 async def stream_hub_response(

@@ -33,6 +33,7 @@ import uuid
 from dataclasses import dataclass, field
 
 from ..control import tracing
+from ..control.sanitizer import san_lock, san_rlock
 
 DRIVE_ERROR = "drive-error"
 DRIVE_HANG = "drive-hang"
@@ -120,7 +121,7 @@ class _Armed:
 
 class FaultRegistry:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("FaultRegistry._lock")
         self._armed: dict[str, _Armed] = {}
         self._injected: dict[tuple[str, str], int] = {}
         # Hot-path snapshots: tuple of live _Armed, or None when nothing of

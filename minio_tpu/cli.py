@@ -323,6 +323,8 @@ def _run_app_until(app, host, port, stop_evt):
         loop.run_until_complete(runner.cleanup())
         loop.close()
 
+    # mtpulint: disable=unjoined-thread -- the serving thread IS the process:
+    # it lives until stop_evt at exit; callers hold the handle to join.
     t = threading.Thread(target=_run_app, daemon=True, name="http-server")
     t.start()
     if not runner_ready.wait(10) or thread_error:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
+from .sanitizer import san_lock, san_rlock
 
 
 class _TokenBucket:
@@ -27,7 +28,7 @@ class _TokenBucket:
         self.capacity = max(self.rate, 1.0)
         self.tokens = self.capacity
         self.ts = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = san_lock("_TokenBucket._lock")
 
     def consume(self, n: int) -> float:
         """Take n tokens (n <= capacity; callers chunk larger requests);
@@ -78,7 +79,7 @@ class BandwidthMonitor:
     """Per-(bucket, target-arn) limits, throttles, and observed rates."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("BandwidthMonitor._lock")
         self._limits: dict[tuple[str, str], int] = {}
         self._buckets: dict[tuple[str, str], _TokenBucket] = {}
         self._windows: dict[tuple[str, str], _Window] = {}

@@ -16,6 +16,7 @@ import threading
 from dataclasses import dataclass, field
 
 from ..utils import errors
+from .sanitizer import san_lock, san_rlock
 
 ENV_PREFIX = "MINIO_TPU"
 
@@ -49,7 +50,7 @@ class ConfigSys:
     def __init__(self, store=None):
         self._defaults: dict[str, dict[str, KV]] = {}
         self._current: dict[str, dict[str, str]] = {}
-        self._lock = threading.RLock()
+        self._lock = san_rlock("ConfigSys._lock")
         self.store = store  # object-layer-backed blob store (ConfigStore)
         self._register_defaults()
 

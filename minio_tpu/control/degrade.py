@@ -17,13 +17,14 @@ never touches it.
 from __future__ import annotations
 
 import threading
+from .sanitizer import san_lock, san_rlock
 
 
 class DegradeStats:
     """Thread-safe counters for the degradation ladder."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("DegradeStats._lock")
         self.hedge_launched = 0  # hedge reads armed (a primary looked slow)
         self.hedge_wins = 0      # hedge results that beat their primary
         self.deadline_aborts: dict[str, int] = {}  # stage -> count

@@ -22,6 +22,7 @@ import json
 import threading
 
 from ..utils import errors
+from .sanitizer import san_lock, san_rlock
 
 PREFIX = "minio_tpu/"  # namespacing inside a shared etcd keyspace
 
@@ -44,7 +45,7 @@ class EtcdClient:
         self._timeout = timeout
         self._api = api_prefix
         self._conn = None
-        self._lock = threading.Lock()
+        self._lock = san_lock("EtcdClient._lock")
 
     def _open(self):
         import http.client

@@ -24,6 +24,7 @@ import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
 
 from .pubsub import PubSub
+from .sanitizer import san_lock, san_rlock
 
 
 @dataclass
@@ -122,7 +123,7 @@ class TargetQueue:
         self.queue_dir = queue_dir
         self.queue_limit = queue_limit
         self._mem: list[dict] = []
-        self._lock = threading.Lock()
+        self._lock = san_lock("TargetQueue._lock")
         self._wake = threading.Event()
         self._stop = threading.Event()
         if queue_dir:
@@ -209,6 +210,9 @@ class TargetQueue:
     def close(self) -> None:
         self._stop.set()
         self._wake.set()
+        # The loop re-checks _stop right after its wake poll; bounded join so
+        # a target mid-send (send timeout) cannot hang teardown.
+        self._thread.join(5.0)
 
     def pending(self) -> int:
         with self._lock:
@@ -248,7 +252,7 @@ class EventNotifier:
         self.targets: dict[str, WebhookEventTarget] = {}
         self.bucket_rules: dict[str, list[Rule]] = {}
         self.listen_hub = PubSub()
-        self._lock = threading.RLock()
+        self._lock = san_rlock("EventNotifier._lock")
 
     def register_target(self, target) -> None:
         with self._lock:

@@ -22,6 +22,7 @@ from dataclasses import asdict, dataclass, field
 
 from ..storage.format import SYS_DIR
 from ..utils import errors
+from .sanitizer import san_lock, san_rlock
 
 HEALING_FILE = "healing.bin"
 
@@ -47,6 +48,9 @@ class MRFQueue:
         self.failed = 0
         self.dropped = 0  # exported as minio_tpu_heal_mrf_dropped_total
         self._overflowing = False
+        # Counters are bumped from the worker loop, drain() callers, and
+        # add() on request threads concurrently; += is load/add/store.
+        self._stats_lock = san_lock("MRFQueue._stats_lock")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         if start:
@@ -63,7 +67,8 @@ class MRFQueue:
             # a saturated repair plane: count every one and log once per
             # overflow EPISODE (first drop after a successful enqueue), not
             # once per drop -- a wedged healer would otherwise spam the log.
-            self.dropped += 1
+            with self._stats_lock:
+                self.dropped += 1
             if not self._overflowing:
                 self._overflowing = True
                 log.warning(
@@ -77,9 +82,11 @@ class MRFQueue:
     def _heal_one(self, entry: MRFEntry) -> None:
         try:
             self.layer.heal_object(entry.bucket, entry.object_name, entry.version_id)
-            self.healed += 1
+            with self._stats_lock:
+                self.healed += 1
         except errors.StorageError:
-            self.failed += 1
+            with self._stats_lock:
+                self.failed += 1
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -87,7 +94,17 @@ class MRFQueue:
                 entry = self.q.get(timeout=0.5)
             except queue.Empty:
                 continue
+            if self._stop.is_set():
+                # Shutdown raced the dequeue: don't start a heal against a
+                # cluster that is tearing down -- dead peers would pin this
+                # thread past stop()'s bounded join. The scanner sweep
+                # re-finds anything dropped here.
+                break
             self._heal_one(entry)
+
+    def join(self, timeout: float = 5.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
 
     def drain(self, limit: int | None = None) -> int:
         """Synchronously heal queued entries (tests + shutdown path); returns
@@ -104,6 +121,7 @@ class MRFQueue:
 
     def stop(self) -> None:
         self._stop.set()
+        self.join()
 
     def pending(self) -> int:
         return self.q.qsize()
@@ -127,20 +145,43 @@ class HealManager:
     def __init__(self, layer):
         self.layer = layer
         self.sequences: dict[str, HealSequenceStatus] = {}
-        self._lock = threading.Lock()
+        self._lock = san_lock("HealManager._lock")
+        self._threads: dict[str, threading.Thread] = {}
 
     # -- heal sequences ------------------------------------------------------
 
     def start_sequence(self, bucket: str = "", prefix: str = "") -> str:
         seq_id = uuid.uuid4().hex[:12]
         status = HealSequenceStatus(seq_id=seq_id, path=f"{bucket}/{prefix}", started=time.time())
+        t = threading.Thread(
+            target=self._run_sequence, args=(status, bucket, prefix), daemon=True,
+            name=f"heal-seq-{seq_id}",
+        )
         with self._lock:
             self.sequences[seq_id] = status
-        t = threading.Thread(
-            target=self._run_sequence, args=(status, bucket, prefix), daemon=True
-        )
+            self._threads[seq_id] = t
         t.start()
         return seq_id
+
+    def join(self, seq_id: str | None = None, timeout: float = 30.0) -> None:
+        """Wait out one (or every) heal sequence; finished threads are
+        dropped from the registry so it cannot grow unbounded."""
+        with self._lock:
+            targets = (
+                list(self._threads.items())
+                if seq_id is None
+                else [(seq_id, self._threads[seq_id])]
+                if seq_id in self._threads
+                else []
+            )
+        for sid, t in targets:
+            t.join(timeout)
+            if not t.is_alive():
+                with self._lock:
+                    self._threads.pop(sid, None)
+
+    def stop(self) -> None:
+        self.join()
 
     def _run_sequence(self, status: HealSequenceStatus, bucket: str, prefix: str) -> None:
         try:
@@ -269,6 +310,10 @@ class DiskHealMonitor:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread is not None:
+            # The loop re-checks _stop between objects (see _heal_drive), so
+            # the join bound is one heal step, not a whole sweep.
+            self._thread.join(30.0)
 
     def _loop(self) -> None:
         while not self._stop.is_set():

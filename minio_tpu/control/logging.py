@@ -15,6 +15,7 @@ import traceback
 from typing import Any
 
 from .pubsub import PubSub
+from .sanitizer import san_lock, san_rlock
 
 
 class LogTarget:
@@ -59,7 +60,7 @@ class Logger:
         self.audit_targets: list[LogTarget] = []
         self.audit_hub = PubSub()  # live `admin trace --call audit` style taps
         self._once: set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = san_lock("Logger._lock")
 
     def log(self, level: str, message: str, **fields: Any) -> None:
         entry = {"level": level, "message": message, "time": time.time(), **fields}

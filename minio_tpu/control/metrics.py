@@ -13,6 +13,7 @@ import time
 from collections import defaultdict, deque
 
 from .degrade import GLOBAL_DEGRADE
+from .sanitizer import san_lock, san_rlock
 
 
 class LastMinuteLatency:
@@ -21,7 +22,7 @@ class LastMinuteLatency:
 
     def __init__(self):
         self._buckets: deque[tuple[int, int, float]] = deque()  # (sec, n, total)
-        self._lock = threading.Lock()
+        self._lock = san_lock("LastMinuteLatency._lock")
 
     def add(self, seconds: float) -> None:
         now = int(time.time())
@@ -49,7 +50,7 @@ HIST_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 class MetricsSys:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("MetricsSys._lock")
         self.http_requests: dict[tuple[str, int], int] = defaultdict(int)
         self.api_calls: dict[str, int] = defaultdict(int)
         self.api_errors: dict[str, int] = defaultdict(int)
@@ -201,6 +202,7 @@ class MetricsSys:
         self._render_heal_scanner(metric)
         self._render_chaos(metric)
         self._render_degrade(metric)
+        self._render_san(metric)
 
         if self.layer is not None:
             total = free = 0
@@ -521,6 +523,40 @@ class MetricsSys:
             metric("minio_tpu_chaos_injected_total", n,
                    {"kind": kind, "target": target},
                    help_="Faults injected by the chaos plane.")
+
+    def _render_san(self, metric) -> None:
+        """Concurrency-sanitizer plane (control/sanitizer.py). Emitted only
+        when the process runs armed (MTPU_TSAN=1) -- a production node never
+        pays for, or exposes, these series."""
+        from ..control import sanitizer
+
+        if not sanitizer.armed():
+            return
+        rep = sanitizer.GLOBAL_SAN.report()
+        by_rule: dict[str, int] = {}
+        for f in rep["findings"]:
+            by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
+        for rule, n in sorted(by_rule.items()):
+            metric("minio_tpu_san_findings_total", n, {"rule": rule},
+                   help_="Sanitizer findings recorded this process, by rule.")
+        metric("minio_tpu_san_lock_order_edges", rep["lock_order_edges"],
+               help_="Distinct lock-order edges observed.", type_="gauge")
+        for name, st in rep["lock_profile"].items():
+            metric("minio_tpu_san_lock_acquisitions_total",
+                   st["acquisitions"], {"lock": name},
+                   help_="Sanitized lock acquisitions, by lock class.")
+            metric("minio_tpu_san_lock_contended_total",
+                   st["contended"], {"lock": name},
+                   help_="Acquisitions that had to wait, by lock class.")
+            metric("minio_tpu_san_lock_hold_seconds_total",
+                   st["hold_s"], {"lock": name},
+                   help_="Cumulative time held, by lock class.")
+            metric("minio_tpu_san_lock_hold_seconds_max",
+                   st["hold_max_s"], {"lock": name},
+                   help_="Longest single hold, by lock class.", type_="gauge")
+            metric("minio_tpu_san_lock_wait_seconds_total",
+                   st["wait_s"], {"lock": name},
+                   help_="Cumulative time spent waiting to acquire, by lock class.")
 
     # -- cluster view --------------------------------------------------------
 

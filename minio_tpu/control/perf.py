@@ -27,6 +27,7 @@ import os
 import threading
 import time
 from collections import OrderedDict, deque
+from .sanitizer import san_lock, san_rlock
 
 # -- bucket scheme ------------------------------------------------------------
 
@@ -115,7 +116,7 @@ class StageLedger:
         self._shards: list[dict[tuple[str, str], _Hist]] = [
             {} for _ in range(_N_SHARDS)
         ]
-        self._locks = [threading.Lock() for _ in range(_N_SHARDS)]
+        self._locks = [san_lock("StageLedger._locks") for _ in range(_N_SHARDS)]
 
     def record(self, layer: str, stage: str, seconds: float) -> None:
         key = (layer, stage)
@@ -294,7 +295,7 @@ class SlowRequestCapture:
         self._pending: "OrderedDict[str, list[dict]]" = OrderedDict()
         self._ring: deque[dict] = deque()
         self._ring_bytes = 0
-        self._lock = threading.Lock()
+        self._lock = san_lock("SlowRequestCapture._lock")
         self.captured_total = 0
         self.evicted_spans = 0  # spans dropped from over-full trace buffers
         self.evicted_traces = 0  # buffers/captures dropped by the caps

@@ -16,6 +16,8 @@ import threading
 import time
 from collections import Counter
 
+from .sanitizer import san_lock
+
 
 class SamplingProfiler:
     """Start/stop sampler; report() returns a text summary."""
@@ -25,6 +27,10 @@ class SamplingProfiler:
         # Safety valve: an orchestration failure (peer stop call lost) must
         # not leave a sampler walking every thread's frames forever.
         self.max_duration_s = max_duration_s
+        # report() may be called while the sampler thread is still
+        # aggregating (admin peeks mid-profile): mutating a Counter during
+        # most_common() is a RuntimeError, so both sides take this lock.
+        self._data_lock = san_lock("SamplingProfiler._data_lock")
         self._stacks: Counter[str] = Counter()
         self._samples = 0
         self._stop = threading.Event()
@@ -62,8 +68,10 @@ class SamplingProfiler:
                     depth += 1
                 parts.reverse()
                 stack = ";".join(parts)
-                self._stacks[f"[{names.get(tid, tid)}] {stack}"] += 1
-            self._samples += 1
+                with self._data_lock:
+                    self._stacks[f"[{names.get(tid, tid)}] {stack}"] += 1
+            with self._data_lock:
+                self._samples += 1
             self._stop.wait(self.interval_s)
 
     def stop(self) -> None:
@@ -75,13 +83,16 @@ class SamplingProfiler:
         self._elapsed = time.monotonic() - self._t0
 
     def report(self, top: int = 60) -> str:
+        with self._data_lock:
+            samples = self._samples
+            common = self._stacks.most_common(top)
         lines = [
-            f"sampling profile: {self._samples} samples over "
+            f"sampling profile: {samples} samples over "
             f"{self._elapsed:.1f}s (interval {self.interval_s * 1000:.0f} ms), "
             "cumulative per-thread collapsed stacks",
             "",
         ]
-        for stack, n in self._stacks.most_common(top):
-            pct = 100.0 * n / max(1, self._samples)
+        for stack, n in common:
+            pct = 100.0 * n / max(1, samples)
             lines.append(f"{n:7d} {pct:5.1f}%  {stack}")
         return "\n".join(lines) + "\n"

@@ -11,12 +11,13 @@ from __future__ import annotations
 import queue
 import threading
 from typing import Any, Callable
+from .sanitizer import san_lock, san_rlock
 
 
 class PubSub:
     def __init__(self):
         self._subs: list[queue.Queue] = []
-        self._lock = threading.Lock()
+        self._lock = san_lock("PubSub._lock")
 
     def num_subscribers(self) -> int:
         return len(self._subs)

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 
 from ..object.types import GetObjectOptions
 from ..utils import errors
+from .sanitizer import san_lock, san_rlock
 
 # Replication status values (bucket-replication-utils.go replication.StatusType).
 PENDING = "PENDING"
@@ -133,7 +134,7 @@ class BucketTargetSys:
         self.bucket_meta = bucket_meta
         self.kms = kms
         self._clients: dict[str, TargetClient] = {}
-        self._lock = threading.Lock()
+        self._lock = san_lock("BucketTargetSys._lock")
 
     def _seal(self, bucket: str, secret: str) -> str:
         from .crypto import seal_secret
@@ -313,7 +314,7 @@ class ReplStats:
     """Thread-safe counters (request threads and workers both mutate)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("ReplStats._lock")
         self.completed = 0
         self.failed = 0
         self.replicated_bytes = 0
@@ -342,7 +343,7 @@ class ReplicationSys:
         self.bandwidth = BandwidthMonitor()
         self._q: queue.Queue[ReplTask | None] = queue.Queue(maxsize=100_000)
         self._retry: list[ReplTask] = []
-        self._retry_lock = threading.Lock()
+        self._retry_lock = san_lock("ReplicationSys._retry_lock")
         self._rule_cache: dict[str, tuple[str, list[ReplicationRule]]] = {}
         self._stop = threading.Event()
         self._threads = [
@@ -351,7 +352,9 @@ class ReplicationSys:
         ]
         for t in self._threads:
             t.start()
-        self._retry_thread = threading.Thread(target=self._retry_loop, daemon=True)
+        self._retry_thread = threading.Thread(
+            target=self._retry_loop, daemon=True, name="repl-retry"
+        )
         self._retry_thread.start()
 
     # -- config ---------------------------------------------------------------
@@ -499,6 +502,11 @@ class ReplicationSys:
 
     def close(self) -> None:
         self._stop.set()
+        # Workers wake within their 0.2s queue poll, the retry loop within
+        # its 1s sleep; join so teardown never races an in-flight replicate.
+        for t in self._threads:
+            t.join(5.0)
+        self._retry_thread.join(5.0)
 
     @property
     def pending(self) -> int:

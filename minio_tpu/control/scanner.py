@@ -17,6 +17,7 @@ import time
 from ..storage.xlmeta import XLMeta
 from ..utils import errors
 from .lifecycle import Lifecycle
+from .sanitizer import san_lock
 from .usage import DataUsageCache
 
 HEAL_SAMPLE = 128  # deep-check 1 in N objects per cycle (ref: 1/1024)
@@ -60,6 +61,9 @@ class DataScanner:
         self.objects_expired = 0
         self.uploads_aborted = 0
         self.objects_transitioned = 0
+        # scan_cycle also runs synchronously (tests, admin-triggered
+        # sweeps) concurrently with the loop thread: guard the counters.
+        self._stats_lock = san_lock("DataScanner._stats_lock")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._sleeper = DynamicSleeper()
@@ -73,6 +77,10 @@ class DataScanner:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._thread is not None:
+            # A cycle in flight finishes its current object between sleeper
+            # steps; bounded join keeps teardown from racing a live walk.
+            self._thread.join(30.0)
 
     def _loop(self) -> None:
         while not self._stop.is_set():
@@ -143,13 +151,15 @@ class DataScanner:
                         t0 = time.perf_counter()
                         try:
                             abort_mpu(bucket, up["object"], up["upload_id"])
-                            self.uploads_aborted += 1
+                            with self._stats_lock:
+                                self.uploads_aborted += 1
                         except errors.StorageError:
                             pass
                         self._sleeper.sleep(time.perf_counter() - t0)
         fresh.finish()
         self.usage = fresh
-        self.cycles_completed += 1
+        with self._stats_lock:
+            self.cycles_completed += 1
         if self.tiering is not None:
             try:
                 self.tiering.drain_journal()
@@ -195,7 +205,8 @@ class DataScanner:
 
                 if is_transitioned(fi.metadata):
                     self.tiering.journal_delete(fi.metadata)
-            self.objects_expired += 1
+            with self._stats_lock:
+                self.objects_expired += 1
             if self.notifier is not None:
                 from .events import Event
 
@@ -214,7 +225,8 @@ class DataScanner:
             return
         try:
             self.tiering.transition(self.layer, bucket, name, fi.version_id, tier)
-            self.objects_transitioned += 1
+            with self._stats_lock:
+                self.objects_transitioned += 1
         except Exception:  # noqa: BLE001 - unreachable tier (raw requests
             pass  # errors) must not abort the whole scan cycle
 
@@ -223,6 +235,7 @@ class DataScanner:
             res = self.layer.heal_object(bucket, name, dry_run=True)
             if res.disks_healed:
                 real = self.layer.heal_object(bucket, name)
-                self.objects_healed += real.disks_healed and 1 or 0
+                with self._stats_lock:
+                    self.objects_healed += real.disks_healed and 1 or 0
         except errors.StorageError:
             pass

@@ -28,6 +28,7 @@ from concurrent.futures import ThreadPoolExecutor
 from dataclasses import asdict, dataclass
 
 from ..utils import errors
+from .sanitizer import san_lock, san_rlock
 
 STATE_PATH = "site-replication/state.json"
 ADMIN_PREFIX = "/mtpu/admin/v1"
@@ -110,10 +111,10 @@ class SiteReplicationSys:
         # Object data has the replication workers' retry list; control
         # changes get the same at-least-once treatment here.
         self._pending: deque[tuple[str, str, dict, int]] = deque()
-        self._pending_lock = threading.Lock()
+        self._pending_lock = san_lock("SiteReplicationSys._pending_lock")
         self._retry_thread: threading.Thread | None = None
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = san_lock("SiteReplicationSys._lock")
         self.load()
 
     # -- state ---------------------------------------------------------------
@@ -257,6 +258,11 @@ class SiteReplicationSys:
 
     def close(self) -> None:
         self._stop.set()
+        t = self._retry_thread
+        if t is not None:
+            # The loop wakes from its retry_interval wait on _stop; a batch
+            # mid-flight finishes its current peer call, hence the bound.
+            t.join(10.0)
 
     # -- operator entry point (AddPeerClusters, site-replication.go:256) -----
 

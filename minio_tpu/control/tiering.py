@@ -25,6 +25,7 @@ from dataclasses import dataclass
 
 from ..object.types import GetObjectOptions, PutObjectOptions
 from ..utils import errors
+from .sanitizer import san_lock, san_rlock
 
 # Internal xl.meta markers (reference: TransitionStatus/TransitionedObjName/
 # TransitionTier fields of xlMetaV2Object, xl-storage-format-v2.go:163).
@@ -178,7 +179,7 @@ class TierConfigMgr:
         self._tiers: dict[str, TierConfig] = {}
         self._backends: dict[str, object] = {}
         self._journal: list[dict] = []  # [{"tier":..., "key":...}]
-        self._lock = threading.RLock()
+        self._lock = san_rlock("TierConfigMgr._lock")
         self.transitioned_objects = 0
         self.transitioned_bytes = 0
         self.load()

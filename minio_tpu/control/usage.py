@@ -14,6 +14,7 @@ import json
 import threading
 import time
 from dataclasses import dataclass, field
+from .sanitizer import san_lock, san_rlock
 
 
 @dataclass
@@ -49,7 +50,7 @@ class DataUsageCache:
     def __init__(self):
         self.root: dict[str, UsageEntry] = {}
         self.last_update = 0.0
-        self._lock = threading.Lock()
+        self._lock = san_lock("DataUsageCache._lock")
 
     def record(self, bucket: str, object_name: str, size: int, versions: int = 1) -> None:
         with self._lock:

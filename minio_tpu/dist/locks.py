@@ -26,6 +26,7 @@ from aiohttp import web
 
 from ..utils import deadline, errors
 from .transport import ERROR_HEADER, TOKEN_HEADER, RestClient
+from ..control.sanitizer import san_lock, san_rlock
 
 LOCK_PREFIX = "/mtpu/lock/v1"
 REFRESH_INTERVAL = 3.0
@@ -49,7 +50,7 @@ class _Entry:
 
 class LocalLocker:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = san_lock("LocalLocker._lock")
         self._map: dict[str, _Entry] = {}
 
     def _expire(self, resource: str) -> None:
@@ -287,7 +288,7 @@ class _RefreshDaemon:
     (server-side entries expire after EXPIRY=30 s — ten missed sweeps)."""
 
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = san_lock("_RefreshDaemon._mu")
         self._live: dict[int, DRWMutex] = {}
         self._thread: threading.Thread | None = None
         self._pool = None
@@ -296,6 +297,10 @@ class _RefreshDaemon:
         with self._mu:
             self._live[id(m)] = m
             if self._thread is None or not self._thread.is_alive():
+                # mtpulint: disable=unjoined-thread -- process-lifetime
+                # singleton by design: one daemon sweeps EVERY live DRWMutex
+                # for the process and parks (see _loop) when none remain;
+                # mtpusan SUPPRESSIONS carries the matching runtime entry.
                 self._thread = threading.Thread(
                     target=self._loop, daemon=True, name="lock-refresh"
                 )

@@ -14,6 +14,7 @@ from __future__ import annotations
 import os
 import time
 import urllib.parse
+import weakref
 from dataclasses import dataclass
 
 from aiohttp import web
@@ -53,6 +54,10 @@ class Endpoint:
 
 
 class Node:
+    # Every constructed node, for close_all() (weak: an abandoned node that
+    # never built threads may simply be collected).
+    _live: "weakref.WeakSet[Node]" = weakref.WeakSet()
+
     def __init__(
         self,
         endpoints: list[str],
@@ -66,6 +71,7 @@ class Node:
         codec: codec_mod.BlockCodec | None = None,
         check_skew: bool = False,
     ):
+        Node._live.add(self)
         self.url = url.rstrip("/")
         # endpoints: flat list (one pool) or list of lists (server pools --
         # each argument group is an independent pool, the reference's
@@ -269,9 +275,19 @@ class Node:
             for s in sets.sets:
                 s.ns_lock = self.ns_lock
         self.iam = IAMSys(self.creds.access_key, self.creds.secret_key)
+        from ..control import kms as kms_mod
         from ..control.kms import StaticKeyKMS, kms_from_env
 
-        self.kms = kms_from_env() or StaticKeyKMS()
+        # An explicitly configured KMS (env) is honored even if the crypto
+        # backend is missing -- failing loudly beats silently dropping the
+        # operator's encryption intent. The implicit ephemeral key, though,
+        # only exists to make SSE work out of the box; without the backend
+        # it can't, so run as a KMS-less node (SSE -> NotImplemented,
+        # config secrets stored unsealed) instead of erroring every
+        # replication-target / tier registration.
+        self.kms = kms_from_env()
+        if self.kms is None and kms_mod.AESGCM is not None:
+            self.kms = StaticKeyKMS()
         self.notification = NotificationSys(
             [PeerClient(u, self.token) for u in self.peer_urls]
         )
@@ -500,6 +516,38 @@ class Node:
         if cache is None or not cache.last_update:
             return None
         return cache.bucket_usage(bucket).size
+
+    # -- shutdown ------------------------------------------------------------
+
+    def close(self) -> None:
+        """Stop every background worker this node started, reverse build
+        order (consumers before their feeds). Idempotent, and safe on a
+        node that never completed build() -- each subsystem is stopped only
+        if it exists. The bounded joins inside each stop path keep a wedged
+        worker from hanging teardown. mtpusan's leaked-thread detector is
+        the check that this list stays complete."""
+        for sub in ("site_repl", "replication"):
+            s = getattr(self, sub, None)
+            if s is not None:
+                s.close()
+        for sub in ("scanner", "disk_heal", "mrf", "healmgr"):
+            s = getattr(self, sub, None)
+            if s is not None:
+                s.stop()
+        notifier = getattr(self, "notifier", None)
+        if notifier is not None:
+            for t in list(notifier.targets.values()):
+                t.close()
+        Node._live.discard(self)
+
+    @classmethod
+    def close_all(cls) -> None:
+        """Close every live node in the process -- the teardown hook for
+        test sessions (tests/conftest.py) and embedded multi-node setups,
+        where nodes are built ad hoc and nothing else owns their
+        lifetime."""
+        for node in list(cls._live):
+            node.close()
 
     def make_app(self) -> web.Application:
         """One aiohttp app: internode routers first, S3 catch-all last

@@ -24,6 +24,7 @@ from ..control import tracing
 from ..control.degrade import GLOBAL_DEGRADE
 from ..control.perf import GLOBAL_PERF
 from ..utils import deadline, errors
+from ..control.sanitizer import san_lock, san_rlock
 
 ERROR_HEADER = "X-Mtpu-Error"
 TOKEN_HEADER = "X-Mtpu-Token"
@@ -76,7 +77,7 @@ class DynamicTimeout:
         self._timeout = timeout
         self.minimum = min(minimum, timeout)
         self._log: list[float] = []
-        self._lock = threading.Lock()
+        self._lock = san_lock("DynamicTimeout._lock")
 
     def timeout(self) -> float:
         return self._timeout
@@ -128,13 +129,13 @@ class RestClient:
         # metadata traffic can't shrink an op class under what a loaded
         # server legitimately needs.
         self._tuners: dict[str, DynamicTimeout] = {}
-        self._tuners_lock = threading.Lock()
+        self._tuners_lock = san_lock("RestClient._tuners_lock")
         self.session = requests.Session()
         self.session.headers[TOKEN_HEADER] = token
         self._online = True
         self._last_failure = 0.0
         self._probe_interval = self.HEALTH_INTERVAL
-        self._lock = threading.Lock()
+        self._lock = san_lock("RestClient._lock")
 
     def is_online(self) -> bool:
         with self._lock:

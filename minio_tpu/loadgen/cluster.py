@@ -91,3 +91,12 @@ class InProcessCluster:
             except Exception:  # noqa: BLE001 - teardown must reach every server
                 pass
         self.servers = []
+        # Stop every node's background workers too (replication, MRF heal,
+        # disk-heal monitor, ...): a sanitized run (MTPU_TSAN=1) leak-checks
+        # threads at exit, and a plain run shouldn't strand daemons either.
+        for node in self.nodes:
+            try:
+                node.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self.nodes = []

@@ -143,6 +143,7 @@ def build_report(
     stage_breakdown: dict,
     degrade: dict,
     probe_cached: bool = False,
+    lock_profile: dict | None = None,
 ) -> dict:
     phases: dict = {}
     for pr in results:
@@ -172,6 +173,10 @@ def build_report(
         "stage_breakdown": stage_breakdown,
         "degrade": degrade,
     }
+    if lock_profile:
+        # Only present when the run was sanitized (MTPU_TSAN=1): per-lock
+        # acquisition counts, contention, and hold/wait time over the phases.
+        report["lock_profile"] = lock_profile
     cmp = _evaluate_compare(scenario, phases)
     if cmp is not None:
         report["compare"] = cmp

@@ -27,6 +27,7 @@ from ..control.perf import StageLedger
 from .generators import Op, generate_ops, op_sequence_hash
 from .spec import Phase, Scenario
 from .target import OpResult, S3Target
+from ..control.sanitizer import san_lock, san_rlock
 
 # Op-list cap for duration-bounded phases (generated up front; the run
 # consumes a prefix). Logged into the phase result when it truncates.
@@ -132,7 +133,7 @@ class ScenarioRunner:
             truncated=not phase.ops,
             op_hash=op_sequence_hash(ops),
         )
-        stats_lock = threading.Lock()
+        stats_lock = san_lock("ScenarioRunner.stats_lock")
         next_idx = itertools.count()
         stop = threading.Event()
         start = time.monotonic()
@@ -174,7 +175,7 @@ class ScenarioRunner:
 
         timers: list[threading.Timer] = []
         armed: dict[str, dict] = {}
-        armed_lock = threading.Lock()
+        armed_lock = san_lock("ScenarioRunner.armed_lock")
 
         def arm(window_i: int, fault: dict, at_s: float, for_s: float) -> None:
             try:
@@ -261,10 +262,13 @@ class ScenarioRunner:
             degrade = self.admin.degrade()
         except Exception:  # noqa: BLE001
             degrade = {}
+        from ..control.sanitizer import profile_if_armed
+
         return build_report(
             sc,
             results,
             stage_breakdown=stage_breakdown,
             degrade=degrade,
             probe_cached=bool(getattr(self.admin, "probe_cached", False)),
+            lock_profile=profile_if_armed(),
         )

@@ -29,6 +29,7 @@ import os
 from ..ops import highwayhash_jax as hhj
 from ..ops import rs, rs_matrix
 from ..parallel import mesh as mesh_lib
+from ..control.sanitizer import san_lock, san_rlock
 
 
 # Per-backend hash-kernel selection, cached after one probe+timing pass:
@@ -38,7 +39,7 @@ _HASH_SELECT: dict[str, dict] = {}
 # Guards the check-then-probe in hash_selection(): two threads racing the
 # first call would otherwise both run the (expensive, jit-compiling) probe
 # and clobber each other's verdict.
-_HASH_SELECT_LOCK = threading.Lock()
+_HASH_SELECT_LOCK = san_lock("pipeline._HASH_SELECT_LOCK")
 
 # Production chunk length: the per-shard slice a 1 MiB block / 12 data
 # shards produces (cmd/erasure-utils.go shard math) — the length every

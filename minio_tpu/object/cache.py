@@ -24,6 +24,7 @@ import time
 
 from ..utils import errors
 from .types import GetObjectOptions, ObjectInfo
+from ..control.sanitizer import san_lock, san_rlock
 
 CACHE_DATA = "part.1"
 CACHE_META = "cache.json"
@@ -76,7 +77,7 @@ class _CacheDrive:
     def __init__(self, root: str, cfg: CacheConfig):
         self.root = root
         self.cfg = cfg
-        self._lock = threading.Lock()
+        self._lock = san_lock("_CacheDrive._lock")
         os.makedirs(root, exist_ok=True)
         # Format marker (format-disk-cache.go role): refuse directories that
         # belong to a different subsystem.

@@ -26,6 +26,7 @@ import time
 import msgpack
 
 from ..control.logging import GLOBAL_LOGGER
+from ..control.sanitizer import san_lock, san_rlock
 
 META_BUCKET = ".minio.sys"
 
@@ -79,7 +80,7 @@ class MetacacheManager:
         # prefix) per process: after that, either the in-memory cache or a
         # walk is strictly fresher.
         self._cold_checked: set[tuple[str, str]] = set()
-        self._lock = threading.Lock()
+        self._lock = san_lock("MetacacheManager._lock")
         # Instrumentation: tests pin that paging does not re-walk per page.
         self.walks = 0
         self.hits = 0

@@ -14,12 +14,13 @@ import subprocess
 import threading
 
 import numpy as np
+from ..control.sanitizer import san_lock, san_rlock
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libminio_native.so"))
 
 _lib: ctypes.CDLL | None = None
-_lock = threading.Lock()
+_lock = san_lock("native._lock")
 _tried = False
 
 

@@ -34,6 +34,7 @@ from ..control.perf import GLOBAL_PERF
 from ..models.pipeline import ErasurePipeline, Geometry
 from ..object.codec import BlockCodec, HostCodec
 from ..ops import rs_matrix
+from ..control.sanitizer import san_lock, san_rlock
 
 _BUCKETS = (1, 2, 4, 8, 16, 32, 64)
 
@@ -69,7 +70,11 @@ class BatchingDeviceCodec(BlockCodec):
         self._queues: dict[tuple[int, int], queue.Queue[_Request]] = {}
         self._pipelines: dict[tuple[int, int], ErasurePipeline] = {}
         self._threads: dict[tuple[int, int], threading.Thread] = {}
-        self._lock = threading.Lock()
+        self._lock = san_lock("BatchingDeviceCodec._lock")
+        # Counters are bumped by batch workers AND request threads; += is
+        # load/add/store, so a dedicated leaf lock (LOCK_ORDER: taken inside
+        # _lock, never the reverse) guards every read-modify-write.
+        self._stats_lock = san_lock("BatchingDeviceCodec._stats_lock")
         self._stop = threading.Event()
         # Served-traffic counters (admin/metrics + tests assert the device
         # pipeline actually carries production blocks).
@@ -124,7 +129,6 @@ class BatchingDeviceCodec(BlockCodec):
             except queue.Empty:
                 continue
             batch = [first]
-            deadline = threading.Event()
             # Collect until the adaptive window closes or the batch is full.
             t_end = self.batch_timeout_s
             import time as _t
@@ -151,13 +155,14 @@ class BatchingDeviceCodec(BlockCodec):
             t0 = _time.perf_counter()
             shards, digests = pipe.encode(arr)
             dt = _time.perf_counter() - t0
-            self.device_encode_seconds += dt
             # Ledger record, not a span: worker threads run outside any
             # request context, so a span here would be a silent no-op.
             GLOBAL_PERF.ledger.record("codec", "encode-batch", dt)
-            self.batches_run += 1
-            self.blocks_encoded += b_real
-            self.blocks_padded += b_pad
+            with self._stats_lock:
+                self.device_encode_seconds += dt
+                self.batches_run += 1
+                self.blocks_encoded += b_real
+                self.blocks_padded += b_pad
             shards_np = np.asarray(shards)
             digests_np = np.asarray(digests)
             for i, req in enumerate(batch):
@@ -194,7 +199,8 @@ class BatchingDeviceCodec(BlockCodec):
                 futures[i] = f
             else:
                 host_idx.append(i)
-        self.host_fallback_blocks += len(host_idx)
+        with self._stats_lock:
+            self.host_fallback_blocks += len(host_idx)
         host_results = (
             self._host.encode([blocks[i] for i in host_idx], k, m) if host_idx else []
         )
@@ -222,7 +228,8 @@ class BatchingDeviceCodec(BlockCodec):
         ):
             plan = uniform_recon_plan(rows_batch, k) if len(rows_batch) > 1 else None
             if plan is None or plan[2] != rs_matrix.shard_size(self.block_size, k):
-                self.host_fallback_recon_blocks += len(rows_batch)
+                with self._stats_lock:
+                    self.host_fallback_recon_blocks += len(rows_batch)
                 return self._host.reconstruct_batch(rows_batch, k, m, want, with_digests)
             _, surv, s = plan
             self._ensure_worker(k, m)
@@ -231,10 +238,11 @@ class BatchingDeviceCodec(BlockCodec):
                 self._pipelines[(k, m)], rows_batch, k, tuple(want), surv, s, with_digests
             )
             dt = _time.perf_counter() - t0
-            self.device_recon_seconds += dt
             GLOBAL_PERF.ledger.record("codec", "reconstruct-batch", dt)
-            self.recon_batches_run += 1
-            self.blocks_reconstructed += len(rows_batch)
+            with self._stats_lock:
+                self.device_recon_seconds += dt
+                self.recon_batches_run += 1
+                self.blocks_reconstructed += len(rows_batch)
             return out
 
     def digests_batch(self, chunks):
@@ -242,7 +250,8 @@ class BatchingDeviceCodec(BlockCodec):
         (pipeline.verify_digests, the scanner's batched bitrot consumer --
         VERDICT r3 #9); small or ragged batches stay on the host."""
         if len(chunks) < 4 or len({len(c) for c in chunks}) != 1:
-            self.host_fallback_digest_chunks += len(chunks)
+            with self._stats_lock:
+                self.host_fallback_digest_chunks += len(chunks)
             return self._host.digests_batch(chunks)
         length = len(chunks[0])
         # Full-chunk lengths (ceil(block/k) for any plausible k) are the
@@ -263,7 +272,8 @@ class BatchingDeviceCodec(BlockCodec):
                 else:
                     pass_to_host = False
             if pass_to_host:
-                self.host_fallback_digest_chunks += len(chunks)
+                with self._stats_lock:
+                    self.host_fallback_digest_chunks += len(chunks)
                 return self._host.digests_batch(chunks)
         from ..models.pipeline import ErasurePipeline, Geometry
         from ..object.codec import bucket_batch
@@ -289,10 +299,11 @@ class BatchingDeviceCodec(BlockCodec):
             t0 = _time.perf_counter()
             digs = np.asarray(pipe.verify_digests(arr))  # [n_pad, 1, 32]
             dt = _time.perf_counter() - t0
-            self.device_verify_seconds += dt
             GLOBAL_PERF.ledger.record("codec", "verify-batch", dt)
-            self.verify_batches_run += 1
-            self.digests_verified += len(sub)
+            with self._stats_lock:
+                self.device_verify_seconds += dt
+                self.verify_batches_run += 1
+                self.digests_verified += len(sub)
             out.extend(digs[i, 0].tobytes() for i in range(len(sub)))
         return out
 
@@ -305,22 +316,23 @@ class BatchingDeviceCodec(BlockCodec):
 
     def stats(self) -> dict:
         """Counter snapshot for the /metrics/node codec/device series."""
-        return {
-            "blocks_encoded": self.blocks_encoded,
-            "batches_run": self.batches_run,
-            "blocks_padded": self.blocks_padded,
-            "blocks_reconstructed": self.blocks_reconstructed,
-            "recon_batches_run": self.recon_batches_run,
-            "digests_verified": self.digests_verified,
-            "verify_batches_run": self.verify_batches_run,
-            "host_fallback_blocks": self.host_fallback_blocks,
-            "host_fallback_recon_blocks": self.host_fallback_recon_blocks,
-            "host_fallback_digest_chunks": self.host_fallback_digest_chunks,
-            "device_encode_seconds": self.device_encode_seconds,
-            "device_recon_seconds": self.device_recon_seconds,
-            "device_verify_seconds": self.device_verify_seconds,
-            "compiled_verify_lens": len(self._verify_lens),
-        }
+        with self._stats_lock:
+            return {
+                "blocks_encoded": self.blocks_encoded,
+                "batches_run": self.batches_run,
+                "blocks_padded": self.blocks_padded,
+                "blocks_reconstructed": self.blocks_reconstructed,
+                "recon_batches_run": self.recon_batches_run,
+                "digests_verified": self.digests_verified,
+                "verify_batches_run": self.verify_batches_run,
+                "host_fallback_blocks": self.host_fallback_blocks,
+                "host_fallback_recon_blocks": self.host_fallback_recon_blocks,
+                "host_fallback_digest_chunks": self.host_fallback_digest_chunks,
+                "device_encode_seconds": self.device_encode_seconds,
+                "device_recon_seconds": self.device_recon_seconds,
+                "device_verify_seconds": self.device_verify_seconds,
+                "compiled_verify_lens": len(self._verify_lens),
+            }
 
     def close(self) -> None:
         self._stop.set()

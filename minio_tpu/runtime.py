@@ -41,6 +41,7 @@ from dataclasses import dataclass, field
 
 
 from .object import codec as codec_mod
+from .control.sanitizer import san_lock, san_rlock
 
 
 @dataclass
@@ -59,8 +60,8 @@ class ProbeResult:
 
 
 _live_probe_pgids: set[int] = set()
-_probe_lock = threading.Lock()
-_probe_once_lock = threading.Lock()  # single-flight: at most one child at a time
+_probe_lock = san_lock("runtime._probe_lock")
+_probe_once_lock = san_lock("runtime._probe_once_lock")  # single-flight: at most one child at a time
 _probe_cache: ProbeResult | None = None
 _atexit_registered = False
 
@@ -288,6 +289,9 @@ def _make_batching():
         except Exception:  # noqa: BLE001 - warmup is best-effort
             pass
 
+    # mtpulint: disable=unjoined-thread -- bounded one-shot: encodes a single
+    # block and exits on its own; joining would re-serialize boot on XLA
+    # compile, the exact stall this thread exists to hide.
     threading.Thread(target=_warm, daemon=True, name="codec-warmup").start()
     return codec
 
@@ -295,7 +299,7 @@ def _make_batching():
 # install/shutdown share one lock so a background probe can't install a fresh
 # device codec (spawning worker threads) after shutdown already closed the
 # data plane (TOCTOU the advisor flagged).
-_state_lock = threading.Lock()
+_state_lock = san_lock("runtime._state_lock")
 _closed = False
 
 
@@ -334,6 +338,9 @@ def install_data_plane_codec(
                 dev = _make_batching()
                 codec_mod.set_default_codec(dev)
 
+        # mtpulint: disable=unjoined-thread -- bounded one-shot probe whose
+        # timeout caps its life; the _state_lock/_closed handshake above
+        # already fences it against shutdown, which must not block on it.
         threading.Thread(target=_bg, daemon=True, name="codec-probe").start()
         return codec
     else:  # auto, synchronous: only pay device round trips for an accelerator

@@ -36,6 +36,7 @@ import time
 from ..control.degrade import GLOBAL_DEGRADE
 from ..utils import errors
 from .metered import _METERED
+from ..control.sanitizer import san_lock, san_rlock
 
 # Gate the same call set MeteredDrive times: everything that hits the disk.
 _GATED = _METERED
@@ -83,7 +84,7 @@ class CircuitBreaker:
         self.max_cooldown = max_cooldown
         self._probe = probe  # zero-arg callable; raising = still unhealthy
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = san_lock("CircuitBreaker._lock")
         self.state = CLOSED
         self.consecutive_errors = 0
         self.trips = 0
@@ -195,6 +196,14 @@ class CircuitBreaker:
             logging.getLogger("minio_tpu.breaker").info(
                 "circuit CLOSED for drive %s", self.name
             )
+
+    def close(self) -> None:
+        """Teardown: stop probing WITHOUT closing the circuit state (an open
+        breaker at shutdown stays open; reset() is the operator path)."""
+        self._closed_evt.set()
+        t = self._probe_thread
+        if t is not None:
+            t.join(5.0)
 
     def allows(self) -> bool:
         return self.state == CLOSED

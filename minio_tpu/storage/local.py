@@ -33,6 +33,7 @@ from .format import SYS_DIR, DriveFormat
 from .interface import StorageAPI
 from .types import DiskInfo, FileInfo, VolInfo, now
 from .xlmeta import XLMeta
+from ..control.sanitizer import san_lock, san_rlock
 
 TMP_DIR = os.path.join(SYS_DIR, "tmp")
 BUCKETS_META_DIR = os.path.join(SYS_DIR, "buckets")
@@ -61,7 +62,7 @@ class LocalDrive(StorageAPI):
         self.root = os.path.abspath(root)
         self.fsync = fsync
         # RLock: delete_version (marker path) re-enters write_metadata.
-        self._meta_lock = threading.RLock()
+        self._meta_lock = san_rlock("LocalDrive._meta_lock")
         self._disk_id: str | None = None
         os.makedirs(os.path.join(self.root, TMP_DIR), exist_ok=True)
         os.makedirs(os.path.join(self.root, BUCKETS_META_DIR), exist_ok=True)

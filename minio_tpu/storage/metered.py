@@ -14,6 +14,7 @@ import threading
 import time
 
 from ..control.perf import GLOBAL_PERF
+from ..control.sanitizer import san_lock, san_rlock
 
 # StorageAPI methods that hit the disk (the metered set).
 _METERED = frozenset(
@@ -39,7 +40,7 @@ class MeteredDrive:
         self.__dict__["_lat"] = {}
         self.__dict__["_counts"] = {}
         self.__dict__["_errors"] = {}
-        self.__dict__["_lock"] = threading.Lock()
+        self.__dict__["_lock"] = san_lock("MeteredDrive._lock")
 
     def __getattr__(self, name):
         attr = getattr(self.inner, name)

@@ -100,6 +100,22 @@ def _child_pids() -> set[int]:
 
 
 @pytest.fixture(scope="session", autouse=True)
+def _no_leaked_threads():
+    """Stop every Node's background workers at session end. Tests build
+    in-process clusters ad hoc and rarely own their teardown; without this
+    the daemon threads (replication workers, MRF heal, disk-heal monitor)
+    pile up across the session and mtpusan's leaked-thread detector --
+    which runs at interpreter exit, after this hook -- reports every one."""
+    yield
+    try:
+        from minio_tpu.dist.node import Node
+
+        Node.close_all()
+    except Exception:  # pragma: no cover - teardown must not mask failures
+        pass
+
+
+@pytest.fixture(scope="session", autouse=True)
 def _no_leaked_children():
     yield
     # Reap the probe process groups eagerly (atexit would fire later anyway;

@@ -17,14 +17,18 @@ from tools.mtpulint import (
     load_baseline,
 )
 from tools.mtpulint.rules import (
+    CondWaitLoopRule,
     DeadlineRebindRule,
     LockBlockingIORule,
+    LockOrderRule,
     MetricsRenderedRule,
     RawTransportRule,
     ResourceLeakRule,
+    SharedPublishRule,
     StageKeyRule,
     SwallowedExceptRule,
     TypedErrorsRule,
+    UnjoinedThreadRule,
     UnlockedGlobalRule,
 )
 
@@ -488,3 +492,326 @@ def test_format_baseline_round_trips(tmp_path):
     p = tmp_path / "baseline.txt"
     p.write_text(text)
     assert load_baseline(str(p)) == {("a.py", "r"): 2, ("b.py", "q"): 1}
+
+
+# -- lock-order ---------------------------------------------------------------
+
+
+_SAN_WITH_ORDER = """
+    LOCK_ORDER = (
+        "A._outer_lock",
+        "A._inner_lock",
+    )
+"""
+
+
+def test_lock_order_fires_on_declared_order_violation(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/sanitizer.py": _SAN_WITH_ORDER,
+        "minio_tpu/storage/x.py": """
+            class A:
+                def f(self):
+                    with self._inner_lock:
+                        with self._outer_lock:
+                            pass
+        """,
+    }, LockOrderRule())
+    assert [f.rule for f in findings] == ["lock-order"]
+    assert "LOCK_ORDER" in findings[0].message
+
+
+def test_lock_order_quiet_when_nesting_matches_declaration(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/sanitizer.py": _SAN_WITH_ORDER,
+        "minio_tpu/storage/x.py": """
+            class A:
+                def f(self):
+                    with self._outer_lock:
+                        with self._inner_lock:
+                            pass
+        """,
+    }, LockOrderRule())
+    assert findings == []
+
+
+def test_lock_order_detects_cross_module_cycle(tmp_path):
+    # a.py takes X then Y; b.py takes Y then X -- a cycle even with no
+    # declared order covering either lock.
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/a.py": """
+            class P:
+                def f(self):
+                    with self._x_lock:
+                        with self._y_lock:
+                            pass
+        """,
+        "minio_tpu/dist/b.py": """
+            class P:
+                def g(self):
+                    with self._y_lock:
+                        with self._x_lock:
+                            pass
+        """,
+    }, LockOrderRule())
+    assert len(findings) == 1
+    assert "cycle" in findings[0].message
+
+
+def test_lock_order_ignores_non_lock_context_managers(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/a.py": """
+            class P:
+                def f(self):
+                    with self.session:
+                        with self._x_lock:
+                            pass
+                def g(self):
+                    with self._x_lock:
+                        with self.session:
+                            pass
+        """,
+    }, LockOrderRule())
+    assert findings == []
+
+
+def test_lock_order_nested_def_resets_held_stack(tmp_path):
+    # The inner function body runs later, not under the outer with.
+    findings = run_rule(tmp_path, {
+        "minio_tpu/dist/a.py": """
+            class P:
+                def f(self):
+                    with self._x_lock:
+                        def cb():
+                            with self._y_lock:
+                                pass
+                        return cb
+                def g(self):
+                    with self._y_lock:
+                        with self._x_lock:
+                            pass
+        """,
+    }, LockOrderRule())
+    assert findings == []
+
+
+# -- unjoined-thread ----------------------------------------------------------
+
+
+def test_unjoined_thread_fires_without_stop_path(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run, daemon=True)
+                    self._t.start()
+        """,
+    }, UnjoinedThreadRule())
+    assert [f.rule for f in findings] == ["unjoined-thread"]
+
+
+def test_unjoined_thread_quiet_when_class_stop_joins(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run, daemon=True)
+                    self._t.start()
+
+                def stop(self):
+                    self._t.join(timeout=5.0)
+        """,
+    }, UnjoinedThreadRule())
+    assert findings == []
+
+
+def test_unjoined_thread_quiet_when_joined_in_same_function(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import threading
+
+            def scatter(fns):
+                ts = [threading.Thread(target=f, daemon=True) for f in fns]
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+        """,
+    }, UnjoinedThreadRule())
+    assert findings == []
+
+
+def test_unjoined_thread_ignores_non_daemon(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    self._t = threading.Thread(target=self._run)
+                    self._t.start()
+        """,
+    }, UnjoinedThreadRule())
+    assert findings == []
+
+
+def test_unjoined_thread_inline_suppression(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    # mtpulint: disable=unjoined-thread -- process-lifetime
+                    # singleton by design.
+                    self._t = threading.Thread(target=self._run, daemon=True)
+                    self._t.start()
+        """,
+    }, UnjoinedThreadRule())
+    assert findings == []
+
+
+# -- cond-wait-loop -----------------------------------------------------------
+
+
+def test_cond_wait_loop_fires_on_bare_wait(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/parallel/x.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def get(self):
+                    with self._cv:
+                        if not self.items:
+                            self._cv.wait()
+                        return self.items.pop()
+        """,
+    }, CondWaitLoopRule())
+    assert [f.rule for f in findings] == ["cond-wait-loop"]
+
+
+def test_cond_wait_loop_quiet_inside_while(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/parallel/x.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+
+                def get(self):
+                    with self._cv:
+                        while not self.items:
+                            self._cv.wait()
+                        return self.items.pop()
+        """,
+    }, CondWaitLoopRule())
+    assert findings == []
+
+
+def test_cond_wait_loop_exempts_wait_for_and_events(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/parallel/x.py": """
+            import threading
+
+            class Q:
+                def __init__(self):
+                    self._cv = threading.Condition()
+                    self._stop = threading.Event()
+
+                def get(self):
+                    with self._cv:
+                        self._cv.wait_for(lambda: self.items)
+                    self._stop.wait()
+        """,
+    }, CondWaitLoopRule())
+    assert findings == []
+
+
+# -- shared-publish -----------------------------------------------------------
+
+
+def test_shared_publish_fires_on_unlocked_augassign_in_worker(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    t = threading.Thread(target=self._run, daemon=True)
+                    t.start()
+
+                def _run(self):
+                    self.count += 1
+        """,
+    }, SharedPublishRule())
+    assert [f.rule for f in findings] == ["shared-publish"]
+    assert "self.count" in findings[0].message
+
+
+def test_shared_publish_quiet_under_lock(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    t = threading.Thread(target=self._run, daemon=True)
+                    t.start()
+
+                def _run(self):
+                    with self._lock:
+                        self.count += 1
+        """,
+    }, SharedPublishRule())
+    assert findings == []
+
+
+def test_shared_publish_follows_helper_calls(tmp_path):
+    # _run -> self._tick(): the AugAssign lives in a helper reached only
+    # transitively from the thread target.
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    t = threading.Thread(target=self._run, daemon=True)
+                    t.start()
+
+                def _run(self):
+                    self._tick()
+
+                def _tick(self):
+                    self.stats["n"] += 1
+        """,
+    }, SharedPublishRule())
+    assert len(findings) == 1
+    assert "self.stats[...]" in findings[0].message
+
+
+def test_shared_publish_exempts_atomic_publishes_and_request_path(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import threading
+
+            class W:
+                def start(self):
+                    t = threading.Thread(target=self._run, daemon=True)
+                    t.start()
+
+                def _run(self):
+                    self.last = 1          # plain assignment: atomic publish
+                    self.items.append(2)   # append: atomic under the GIL
+
+                def serve(self):
+                    self.requests += 1     # not reachable from the worker
+        """,
+    }, SharedPublishRule())
+    assert findings == []

@@ -13,6 +13,7 @@ from types import SimpleNamespace
 import pytest
 
 from minio_tpu.api.server import ThreadedServer
+from minio_tpu.control import kms as _kms_mod
 from minio_tpu.dist.node import Node
 from tests.s3client import S3TestClient
 from tests.test_dist import _free_port
@@ -341,6 +342,10 @@ class TestReplication:
             r = c.request("GET", "/bidir", query=[("versions", "")])
             assert r.text.count("<DeleteMarker>") == 1, r.text
 
+    @pytest.mark.skipif(
+        _kms_mod.AESGCM is None,
+        reason="cryptography not installed: node boots KMS-less, secrets unsealed",
+    )
     def test_target_secret_sealed_at_rest(self, pair):
         """The stored bucket metadata must not contain the target's secret
         key in cleartext (sealed with the cluster KMS)."""

@@ -1,0 +1,449 @@
+"""mtpusan runtime sanitizer tests: every detector has a firing and a
+non-firing fixture, plus cycle math, report/baseline plumbing, the
+metrics exposition when armed, and the disarmed pass-through guarantee.
+
+The seeded lock-order inversion here is the acceptance fixture for the
+whole subsystem: the SAME inversion is caught statically (mtpulint's
+lock-order rule, test_lint.py) and at runtime (graph cycle below) --
+sequentially, so the suite itself can never deadlock on it.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+import minio_tpu.control.sanitizer as sm
+from minio_tpu.control.sanitizer import (
+    SanCondition,
+    Sanitizer,
+    SanLock,
+    SanRLock,
+    san_condition,
+    san_lock,
+    san_rlock,
+)
+
+_REPO = Path(__file__).resolve().parent.parent
+_LINT_PATH = _REPO / "tools" / "metrics_lint.py"
+_spec = importlib.util.spec_from_file_location("metrics_lint", _LINT_PATH)
+metrics_lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(metrics_lint)
+
+
+@pytest.fixture
+def armed_san():
+    """Arm a fresh Sanitizer for one test, restoring prior state after --
+    including the case where the whole session already runs armed (a
+    sanitized run of this very file must not disarm itself)."""
+    was_armed = sm.armed()
+    prev = sm.GLOBAL_SAN
+    san = sm.arm(Sanitizer(hold_threshold_s=0.05))
+    yield san
+    if not was_armed:
+        sm.disarm()
+    sm.GLOBAL_SAN = prev
+
+
+def _unsuppressed(san):
+    return [f for f in san.report()["findings"] if "suppressed" not in f]
+
+
+# -- disarmed pass-through (the overhead guarantee) ---------------------------
+
+
+def test_disarmed_factories_return_plain_primitives():
+    if sm.armed():  # pragma: no cover - only under a sanitized outer run
+        pytest.skip("session armed: pass-through not observable")
+    assert type(san_lock("x")) is type(threading.Lock())
+    assert isinstance(san_rlock("x"), type(threading.RLock()))
+    assert isinstance(san_condition("x"), threading.Condition)
+    assert sm.profile_if_armed() is None
+
+
+def test_armed_factories_return_instrumented_primitives(armed_san):
+    assert isinstance(san_lock("a"), SanLock)
+    assert isinstance(san_rlock("b"), SanRLock)
+    assert isinstance(san_condition("c"), SanCondition)
+    assert sm.profile_if_armed() is not None
+
+
+# -- lock-order-inversion -----------------------------------------------------
+
+
+def test_seeded_inversion_detected_at_runtime_without_deadlock(armed_san):
+    """A->B in one call path, B->A in another: the graph closes a cycle and
+    reports it even though nothing ever wedged (both nestings run on one
+    thread, sequentially)."""
+    a = SanLock(armed_san, "Seed._a_lock")
+    b = SanLock(armed_san, "Seed._b_lock")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    rules = [f["rule"] for f in _unsuppressed(armed_san)]
+    assert rules == ["lock-order-inversion"]
+    (f,) = _unsuppressed(armed_san)
+    assert "Seed._a_lock" in f["message"] and "Seed._b_lock" in f["message"]
+    assert f["stacks"]  # acquisition stacks for both directions
+
+
+def test_consistent_order_is_clean(armed_san):
+    a = SanLock(armed_san, "Seed._a_lock")
+    b = SanLock(armed_san, "Seed._b_lock")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert _unsuppressed(armed_san) == []
+    assert armed_san.report()["lock_order_edges"] == 1
+
+
+def test_transitive_cycle_through_three_locks(armed_san):
+    """A->B, B->C, then C->A: the cycle spans the whole chain, not just
+    the closing edge pair."""
+    a = SanLock(armed_san, "T._a_lock")
+    b = SanLock(armed_san, "T._b_lock")
+    c = SanLock(armed_san, "T._c_lock")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:
+            pass
+    (f,) = _unsuppressed(armed_san)
+    assert f["rule"] == "lock-order-inversion"
+    for name in ("T._a_lock", "T._b_lock", "T._c_lock"):
+        assert name in f["message"]
+
+
+def test_same_inversion_reported_once(armed_san):
+    a = SanLock(armed_san, "O._a_lock")
+    b = SanLock(armed_san, "O._b_lock")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert len(_unsuppressed(armed_san)) == 1
+
+
+def test_static_rule_catches_the_same_seeded_inversion(tmp_path):
+    """The acceptance pairing: the runtime cycle above, expressed as source,
+    is also a static lock-order finding before the code ever runs."""
+    from tools.mtpulint import lint_tree
+    from tools.mtpulint.rules import LockOrderRule
+
+    src = tmp_path / "minio_tpu" / "dist" / "seed.py"
+    src.parent.mkdir(parents=True)
+    src.write_text(textwrap.dedent("""
+        class Seed:
+            def forward(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def backward(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """))
+    findings = lint_tree(str(tmp_path), ["minio_tpu"], [LockOrderRule()])
+    assert [f.rule for f in findings] == ["lock-order"]
+    assert "cycle" in findings[0].message
+
+
+# -- self-deadlock ------------------------------------------------------------
+
+
+def test_self_deadlock_raises_instead_of_hanging(armed_san):
+    lk = SanLock(armed_san, "S._lock")
+    lk.acquire()
+    try:
+        with pytest.raises(RuntimeError, match="self-deadlock"):
+            lk.acquire()
+    finally:
+        lk.release()
+    rules = [f["rule"] for f in _unsuppressed(armed_san)]
+    assert rules == ["self-deadlock"]
+
+
+def test_rlock_reentry_is_clean(armed_san):
+    lk = SanRLock(armed_san, "S._rlock")
+    with lk:
+        with lk:
+            pass
+    assert _unsuppressed(armed_san) == []
+
+
+# -- lock-held-long -----------------------------------------------------------
+
+
+def test_long_hold_fires(armed_san):
+    lk = SanLock(armed_san, "H._lock")
+    with lk:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < 0.08:  # busy: sleep would ALSO fire
+            pass
+    (f,) = _unsuppressed(armed_san)
+    assert f["rule"] == "lock-held-long"
+    assert f["site"] == "H._lock"
+
+
+def test_short_hold_is_clean(armed_san):
+    lk = SanLock(armed_san, "H._lock")
+    with lk:
+        pass
+    assert _unsuppressed(armed_san) == []
+
+
+# -- lock-over-blocking -------------------------------------------------------
+
+
+def test_sleep_under_lock_fires(armed_san):
+    lk = SanLock(armed_san, "B._lock")
+    with lk:
+        time.sleep(0.001)
+    rules = {f["rule"] for f in _unsuppressed(armed_san)}
+    assert "lock-over-blocking" in rules
+
+
+def test_sleep_outside_lock_is_clean(armed_san):
+    lk = SanLock(armed_san, "B._lock")
+    with lk:
+        pass
+    time.sleep(0.001)
+    assert _unsuppressed(armed_san) == []
+
+
+# -- cond-wait-no-loop --------------------------------------------------------
+
+
+def test_bare_wait_outside_while_fires(armed_san):
+    cond = SanCondition(armed_san, "C._cv")
+    with cond:
+        cond.wait(timeout=0.01)
+    (f,) = _unsuppressed(armed_san)
+    assert f["rule"] == "cond-wait-no-loop"
+
+
+def test_wait_inside_while_predicate_is_clean(armed_san):
+    cond = SanCondition(armed_san, "C._cv")
+    done = [False]
+    with cond:
+        while not done[0]:
+            cond.wait(timeout=0.01)
+            done[0] = True
+    assert _unsuppressed(armed_san) == []
+
+
+def test_wait_for_is_clean(armed_san):
+    cond = SanCondition(armed_san, "C._cv")
+    with cond:
+        cond.wait_for(lambda: True, timeout=0.01)
+    assert _unsuppressed(armed_san) == []
+
+
+# -- teardown: leaked threads / fds -------------------------------------------
+
+
+def test_leaked_thread_detected_at_teardown(armed_san):
+    armed_san.snapshot_baseline()
+    release = threading.Event()
+    t = threading.Thread(target=release.wait, name="orphan-worker", daemon=True)
+    t.start()
+    try:
+        armed_san.teardown_check()
+        leaks = [
+            f for f in _unsuppressed(armed_san) if f["rule"] == "leaked-thread"
+        ]
+        assert [f["site"] for f in leaks] == ["orphan-worker"]
+    finally:
+        release.set()
+        t.join(5.0)
+
+
+def test_joined_thread_is_clean_and_suppression_table_applies(armed_san):
+    armed_san.snapshot_baseline()
+    t = threading.Thread(target=lambda: None, name="short-worker")
+    t.start()
+    t.join(5.0)
+    release = threading.Event()
+    # Name matches the justified lock-refresh suppression row.
+    d = threading.Thread(target=release.wait, name="lock-refresh-0", daemon=True)
+    d.start()
+    try:
+        armed_san.teardown_check()
+        assert _unsuppressed(armed_san) == []
+        sup = [
+            f for f in armed_san.report()["findings"] if "suppressed" in f
+        ]
+        assert len(sup) == 1 and sup[0]["site"] == "lock-refresh-0"
+    finally:
+        release.set()
+        d.join(5.0)
+
+
+def test_fd_leak_detected_with_slack(armed_san, monkeypatch):
+    armed_san._baseline_fds = 100
+    monkeypatch.setattr(sm, "_fd_count", lambda: 300)
+    armed_san.teardown_check()
+    assert any(f["rule"] == "fd-leak" for f in _unsuppressed(armed_san))
+
+
+def test_fd_growth_within_slack_is_clean(armed_san, monkeypatch):
+    armed_san._baseline_fds = 100
+    monkeypatch.setattr(sm, "_fd_count", lambda: 130)
+    armed_san.teardown_check()
+    assert not any(f["rule"] == "fd-leak" for f in _unsuppressed(armed_san))
+
+
+# -- profile / contention stats -----------------------------------------------
+
+
+def test_profile_counts_acquisitions_and_contention(armed_san):
+    lk = SanLock(armed_san, "P._lock")
+    with lk:
+        pass
+    hold = threading.Event()
+    entered = threading.Event()
+
+    def holder():
+        with lk:
+            entered.set()
+            hold.wait(5.0)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert entered.wait(5.0)
+    acquired = threading.Event()
+
+    def contender():
+        with lk:
+            acquired.set()
+
+    t2 = threading.Thread(target=contender)
+    t2.start()
+    # Let the contender actually block on the inner lock before releasing.
+    deadline = time.monotonic() + 5.0
+    while not lk.locked() and time.monotonic() < deadline:
+        pass
+    hold.set()
+    assert acquired.wait(5.0)
+    t.join(5.0)
+    t2.join(5.0)
+    prof = armed_san.profile()["P._lock"]
+    assert prof["acquisitions"] == 3
+    assert prof["contended"] >= 1
+    assert prof["wait_s"] >= 0.0
+    assert prof["hold_s"] > 0.0
+
+
+def test_report_shape_and_json_round_trip(armed_san, tmp_path):
+    lk = SanLock(armed_san, "R._lock")
+    with lk:
+        pass
+    out = tmp_path / "san.json"
+    armed_san.write_report(str(out))
+    rep = json.loads(out.read_text())
+    assert rep["mtpusan"] == 1
+    assert rep["armed"] is True
+    assert rep["unsuppressed"] == 0
+    assert "R._lock" in rep["lock_profile"]
+    assert set(rep) >= {
+        "findings", "lock_order_edges", "lock_profile", "hold_threshold_ms",
+    }
+
+
+# -- metrics exposition (armed only) ------------------------------------------
+
+
+def test_san_metrics_rendered_when_armed_and_lint_clean(armed_san):
+    from minio_tpu.control.metrics import MetricsSys
+
+    ms = MetricsSys()
+    lk = SanLock(armed_san, "M._lock")
+    with lk:
+        pass
+    text = ms.render_node()
+    assert 'minio_tpu_san_lock_acquisitions_total{lock="M._lock"}' in text
+    assert "minio_tpu_san_lock_hold_seconds_max" in text
+    assert "minio_tpu_san_lock_order_edges" in text
+    assert metrics_lint.validate_exposition(text) == []
+    assert metrics_lint.lint_exposition(text) == []
+
+
+def test_san_metrics_absent_when_disarmed():
+    if sm.armed():  # pragma: no cover - only under a sanitized outer run
+        pytest.skip("session armed")
+    from minio_tpu.control.metrics import MetricsSys
+
+    text = MetricsSys().render_node()
+    assert "minio_tpu_san_" not in text
+    assert metrics_lint.validate_exposition(text) == []
+
+
+def test_san_findings_metric_by_rule(armed_san):
+    from minio_tpu.control.metrics import MetricsSys
+
+    armed_san.add_finding("lock-held-long", "X._lock", "m")
+    armed_san.add_finding("lock-held-long", "Y._lock", "m")
+    text = MetricsSys().render_node()
+    assert 'minio_tpu_san_findings_total{rule="lock-held-long"} 2' in text
+
+
+# -- driver: merge + baseline gate --------------------------------------------
+
+
+def test_mtpusan_merge_dedupes_and_splits_suppressed():
+    from tools import mtpusan
+
+    reports = [
+        {"source": "a", "findings": [
+            {"rule": "lock-held-long", "site": "X._lock", "message": "m"},
+            {"rule": "leaked-thread", "site": "lock-refresh-0",
+             "message": "m", "suppressed": "why"},
+        ]},
+        {"source": "b", "findings": [
+            {"rule": "lock-held-long", "site": "X._lock", "message": "m"},
+            {"rule": "lock-held-long", "site": "Y._lock", "message": "m"},
+        ]},
+    ]
+    unsup, sup = mtpusan.merge_findings(reports)
+    assert sorted(f["site"] for f in unsup) == ["X._lock", "Y._lock"]
+    assert [f["site"] for f in sup] == ["lock-refresh-0"]
+
+
+def test_mtpusan_gate_baseline_round_trip(tmp_path, capsys):
+    from tools import mtpusan
+
+    baseline = tmp_path / "baseline.txt"
+    finding = {"rule": "lock-held-long", "site": "X._lock", "message": "m"}
+    # No baseline: the finding gates.
+    assert mtpusan.gate([finding], str(baseline), write=False) == 1
+    # Grandfather it, then the same finding passes ...
+    assert mtpusan.gate([finding], str(baseline), write=True) == 0
+    assert mtpusan.gate([finding], str(baseline), write=False) == 0
+    # ... but a new site still gates (shrink-only semantics).
+    extra = {"rule": "lock-held-long", "site": "Z._lock", "message": "m"}
+    assert mtpusan.gate([finding, extra], str(baseline), write=False) == 1
+
+
+def test_shipped_baseline_is_empty():
+    """The acceptance bar: no grandfathered runtime findings ship."""
+    from tools.mtpulint import load_baseline
+
+    assert load_baseline(str(_REPO / "tools" / "mtpusan_baseline.txt")) == {}

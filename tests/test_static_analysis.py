@@ -40,6 +40,7 @@ def test_mtpulint_lists_all_rules():
         "swallowed-except", "raw-transport", "deadline-rebind",
         "lock-blocking-io", "resource-leak", "stage-key",
         "metrics-rendered", "typed-errors", "unlocked-global",
+        "lock-order", "unjoined-thread", "cond-wait-loop", "shared-publish",
     ):
         assert rule_id in proc.stdout, f"rule {rule_id} missing from --list-rules"
 

@@ -201,6 +201,9 @@ class TestTiering:
             dts.stop()
 
     def test_sealed_tier_secrets_at_rest(self, srv):
+        pytest.importorskip(
+            "cryptography", reason="node boots KMS-less without the crypto backend"
+        )
         node = srv["node"]
         raw = node.pools and node.tiering.store.get(tiering_mod.CONFIG_PATH)
         assert raw is not None

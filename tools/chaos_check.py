@@ -16,7 +16,11 @@ Exit status is pytest's, so this drops straight into CI. Scenarios are
 collected from the scenario file directly (pytest accepts an explicit path
 regardless of its test-file naming convention). Before any scenario runs,
 the deadline-propagation lint (tools/deadline_lint.py) gates the tree: a
-hop that lost the budget plumbing fails here, not in a live cluster.
+hop that lost the budget plumbing fails here, not in a live cluster. With
+--invariants the FULL mtpulint rule set runs first, which since the mtpusan
+work includes the concurrency rules (lock-order, unjoined-thread,
+cond-wait-loop, shared-publish) -- the static half of what the runtime
+sanitizer (tools/mtpusan.py, MTPU_TSAN=1) checks dynamically.
 """
 
 from __future__ import annotations

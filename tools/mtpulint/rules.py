@@ -812,6 +812,517 @@ class UnlockedGlobalRule(Rule):
                         )
 
 
+# ---------------------------------------------------------------------------
+# lock-order
+# ---------------------------------------------------------------------------
+
+
+SANITIZER = "minio_tpu/control/sanitizer.py"
+
+_LOCK_HINTS = ("lock", "mutex", "_mu", "sem")
+
+
+def _class_spans(ctx) -> list[tuple[int, int, str]]:
+    spans = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ClassDef):
+            spans.append((node.lineno, node.end_lineno or node.lineno, node.name))
+    return spans
+
+
+def _enclosing_class(spans, lineno: int) -> str | None:
+    best = None
+    for lo, hi, name in spans:
+        if lo <= lineno <= hi and (best is None or lo > best[0]):
+            best = (lo, name)
+    return best[1] if best else None
+
+
+class LockOrderRule(Rule):
+    """Nested `with lock:` pairs must agree on one global acquisition order.
+
+    The static half of mtpusan's lock-order graph: every lexically nested
+    lock pair (`with A: ... with B:`) contributes an A->B edge, named by the
+    qualified form `ClassName.attr` (module locks: `filestem.name`). Two
+    checks over the cross-module digraph:
+      * a cycle (A->B somewhere, B->A somewhere else) is a potential
+        deadlock even if no run has wedged yet;
+      * a pair that contradicts the declared LOCK_ORDER table in
+        control/sanitizer.py (outermost first) is a hierarchy violation.
+    The runtime sanitizer catches orders composed dynamically through
+    calls; this rule catches the lexical ones before the code ever runs."""
+
+    id = "lock-order"
+    title = "nested lock acquisition order inverted"
+    scope = ("minio_tpu/",)
+
+    def _lock_name(self, expr: ast.AST, ctx, spans, lineno: int) -> str | None:
+        """Qualified lock-class name for a with-item, or None if not a lock
+        (or not statically nameable)."""
+        if isinstance(expr, ast.Subscript):
+            return self._lock_name(expr.value, ctx, spans, lineno)
+        attr = None
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            attr = expr.attr
+            owner = _enclosing_class(spans, lineno)
+            if owner is None:
+                return None
+        elif isinstance(expr, ast.Name):
+            attr = expr.id
+            owner = ctx.relpath.rsplit("/", 1)[-1][:-3]  # file stem
+        else:
+            return None
+        low = attr.lower()
+        if not any(h in low for h in _LOCK_HINTS):
+            return None
+        return f"{owner}.{attr}"
+
+    def _declared_order(self, project) -> list[str]:
+        ctx = project.get(SANITIZER)
+        if ctx is None:
+            return []
+        for node in ast.walk(ctx.tree):
+            tgt = value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                tgt, value = node.target, node.value
+            if isinstance(tgt, ast.Name) and tgt.id == "LOCK_ORDER":
+                return [
+                    s for s in (
+                        _str_const(e) for e in ast.walk(value)
+                        if isinstance(e, ast.Constant)
+                    ) if s
+                ]
+        return []
+
+    def _edges(self, project):
+        """Every lexically nested (outer, inner) lock pair in scope, with
+        the inner acquisition's location."""
+        for ctx in project.iter_files(*self.scope):
+            if ctx.relpath == SANITIZER:
+                continue
+            spans = _class_spans(ctx)
+
+            def scan(node, held):
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    inner_held = list(held)
+                    for item in node.items:
+                        name = self._lock_name(
+                            item.context_expr, ctx, spans, node.lineno
+                        )
+                        if name is not None:
+                            for outer in inner_held:
+                                yield (outer, name, ctx, node.lineno)
+                            inner_held.append(name)
+                    for child in node.body:
+                        yield from scan(child, inner_held)
+                    return
+                # A nested def's body runs later, outside these withs.
+                child_held = (
+                    []
+                    if isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+                    )
+                    else held
+                )
+                for child in ast.iter_child_nodes(node):
+                    yield from scan(child, child_held)
+
+            yield from scan(ctx.tree, [])
+
+    def check(self, project: ProjectContext):
+        order = self._declared_order(project)
+        rank = {name: i for i, name in enumerate(order)}
+        graph: dict[str, set[str]] = {}
+        first_at: dict[tuple[str, str], tuple] = {}
+        for outer, inner, ctx, lineno in self._edges(project):
+            if outer == inner:
+                continue
+            graph.setdefault(outer, set()).add(inner)
+            first_at.setdefault((outer, inner), (ctx, lineno))
+            if outer in rank and inner in rank and rank[outer] > rank[inner]:
+                yield Finding(
+                    self.id, ctx.relpath, lineno,
+                    f"acquires {inner!r} while holding {outer!r}, but "
+                    "LOCK_ORDER in control/sanitizer.py declares "
+                    f"{inner!r} before {outer!r} -- invert the nesting or "
+                    "amend the declared order",
+                )
+        seen_cycles: set[frozenset] = set()
+        for (a, b), (ctx, lineno) in sorted(
+            first_at.items(), key=lambda kv: (kv[1][0].relpath, kv[1][1])
+        ):
+            path = self._find_path(graph, b, a)
+            if path is None:
+                continue
+            cycle = frozenset([a] + path)
+            if cycle in seen_cycles:
+                continue
+            seen_cycles.add(cycle)
+            yield Finding(
+                self.id, ctx.relpath, lineno,
+                "lock-order cycle: " + " -> ".join([a] + path)
+                + " -- threads taking these in opposite orders can "
+                "deadlock; pick one global order",
+            )
+
+    @staticmethod
+    def _find_path(graph, src: str, dst: str) -> list[str] | None:
+        prev = {src: src}
+        frontier = [src]
+        while frontier:
+            nxt = []
+            for u in frontier:
+                for v in graph.get(u, ()):
+                    if v in prev:
+                        continue
+                    prev[v] = u
+                    if v == dst:
+                        path = [v]
+                        while path[-1] != src:
+                            path.append(prev[path[-1]])
+                        return list(reversed(path))
+                    nxt.append(v)
+            frontier = nxt
+        return None
+
+
+# ---------------------------------------------------------------------------
+# unjoined-thread
+# ---------------------------------------------------------------------------
+
+
+class UnjoinedThreadRule(Rule):
+    """`Thread(daemon=True)` without a registered stop/join path.
+
+    daemon=True means "the interpreter may kill this mid-write at exit" --
+    acceptable only for workers that also have an orderly shutdown. A
+    daemon thread started in a function that never joins anything, inside a
+    class with no stop/close/shutdown method that joins, is a worker nobody
+    can ever wait out: tests leak it, teardown races it, and mtpusan's
+    leaked-thread detector will fire at runtime. Give the owner a stop path
+    that joins, or suppress with the justification for a process-lifetime
+    daemon."""
+
+    id = "unjoined-thread"
+    title = "daemon thread started without a stop/join path"
+    scope = ("minio_tpu/",)
+
+    STOP_NAMES = {
+        "stop", "close", "shutdown", "stop_all", "cancel", "join",
+        "wait_all", "drain",
+    }
+
+    @staticmethod
+    def _is_thread_ctor(call: ast.Call) -> bool:
+        name = _call_name(call)
+        return name == "Thread" or name.endswith(".Thread")
+
+    @staticmethod
+    def _daemon_true(call: ast.Call) -> bool:
+        for kw in call.keywords:
+            if (
+                kw.arg == "daemon"
+                and isinstance(kw.value, ast.Constant)
+                and kw.value.value is True
+            ):
+                return True
+        return False
+
+    @staticmethod
+    def _has_join(node) -> bool:
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "join"
+            ):
+                return True
+        return False
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            fn_spans = [
+                (n.lineno, n.end_lineno or n.lineno, n)
+                for n in ast.walk(ctx.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            cls_spans = [
+                (n.lineno, n.end_lineno or n.lineno, n)
+                for n in ast.walk(ctx.tree)
+                if isinstance(n, ast.ClassDef)
+            ]
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call) or not self._is_thread_ctor(node):
+                    continue
+                if not self._daemon_true(node):
+                    continue
+                if self._joined_somewhere(node.lineno, fn_spans, cls_spans, ctx):
+                    continue
+                yield Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    "Thread(daemon=True) started here but neither this "
+                    "function nor any stop/close/shutdown method on the "
+                    "owning class ever join()s -- register a join path, or "
+                    "suppress with the process-lifetime justification",
+                )
+
+    def _joined_somewhere(self, lineno, fn_spans, cls_spans, ctx) -> bool:
+        fn = self._innermost(fn_spans, lineno)
+        if fn is not None and self._has_join(fn):
+            return True
+        cls = self._innermost(cls_spans, lineno)
+        if cls is not None:
+            for stmt in cls.body:
+                if (
+                    isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and stmt.name in self.STOP_NAMES
+                    and self._has_join(stmt)
+                ):
+                    return True
+            return False
+        if fn is None:
+            # Module-level start: any module-level join path counts.
+            return self._has_join(ctx.tree)
+        return False
+
+    @staticmethod
+    def _innermost(spans, lineno):
+        best = None
+        for lo, hi, node in spans:
+            if lo <= lineno <= hi and (best is None or lo > best[0]):
+                best = (lo, node)
+        return best[1] if best else None
+
+
+# ---------------------------------------------------------------------------
+# cond-wait-loop
+# ---------------------------------------------------------------------------
+
+
+class CondWaitLoopRule(Rule):
+    """`Condition.wait()` must sit inside a `while predicate:` loop.
+
+    Spurious wakeups and stolen notifies are real: a bare `if pred: wait()`
+    (or a naked wait) resumes with the predicate false and corrupts
+    whatever the waiter does next. Re-check the predicate in a `while`
+    loop, or use `wait_for(predicate)` which loops internally. Only names
+    assigned a Condition are checked -- `Event.wait` is level-triggered
+    and exempt."""
+
+    id = "cond-wait-loop"
+    title = "Condition.wait() outside a while-predicate loop"
+    scope = ("minio_tpu/",)
+
+    _COND_CTORS = {
+        "threading.Condition", "Condition", "san_condition",
+    }
+
+    def _condition_names(self, ctx) -> set[str]:
+        """Attr/var names bound to a Condition anywhere in the file."""
+        names: set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not (
+                isinstance(node.value, ast.Call)
+                and _call_name(node.value) in self._COND_CTORS
+            ):
+                continue
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                elif isinstance(tgt, ast.Attribute):
+                    names.add(tgt.attr)
+        return names
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            conds = self._condition_names(ctx)
+            if not conds:
+                continue
+
+            def scan(node, in_while: bool):
+                if isinstance(node, ast.While):
+                    for child in ast.iter_child_nodes(node):
+                        yield from scan(child, True)
+                    return
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    # A nested def's body executes outside this loop.
+                    for child in ast.iter_child_nodes(node):
+                        yield from scan(child, False)
+                    return
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"
+                    and not in_while
+                ):
+                    holder = node.func.value
+                    hname = (
+                        holder.attr if isinstance(holder, ast.Attribute)
+                        else holder.id if isinstance(holder, ast.Name) else None
+                    )
+                    if hname in conds:
+                        yield node
+                for child in ast.iter_child_nodes(node):
+                    yield from scan(child, in_while)
+
+            for call in scan(ctx.tree, False):
+                yield Finding(
+                    self.id, ctx.relpath, call.lineno,
+                    "Condition.wait() outside a `while predicate:` loop -- "
+                    "spurious wakeups break this; loop on the predicate or "
+                    "use wait_for()",
+                )
+
+
+# ---------------------------------------------------------------------------
+# shared-publish
+# ---------------------------------------------------------------------------
+
+
+class SharedPublishRule(Rule):
+    """Read-modify-write on shared state from a worker thread, outside any
+    lock.
+
+    Methods reachable from a `Thread(target=self.X)` run concurrently with
+    request threads; `self.counter += 1` there is a lost-update race (the
+    GIL makes single writes atomic, but += is load/add/store). Guard the
+    update with a lock. Plain assignments and list.append are exempt --
+    they are single atomic publishes under the GIL."""
+
+    id = "shared-publish"
+    title = "unlocked read-modify-write on shared state in a worker thread"
+    scope = ("minio_tpu/",)
+
+    @staticmethod
+    def _worker_methods(cls: ast.ClassDef) -> set[str]:
+        """Method names reachable from a Thread(target=self.X) started
+        anywhere in the class, expanded transitively through self.Y()
+        calls."""
+        methods = {
+            s.name: s for s in cls.body
+            if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        roots: set[str] = set()
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if not (name == "Thread" or name.endswith(".Thread")):
+                continue
+            for kw in node.keywords:
+                if (
+                    kw.arg == "target"
+                    and isinstance(kw.value, ast.Attribute)
+                    and isinstance(kw.value.value, ast.Name)
+                    and kw.value.value.id == "self"
+                    and kw.value.attr in methods
+                ):
+                    roots.add(kw.value.attr)
+        # Transitive closure through self.method() calls.
+        frontier = list(roots)
+        while frontier:
+            m = frontier.pop()
+            for node in ast.walk(methods[m]):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in methods
+                    and node.func.attr not in roots
+                ):
+                    roots.add(node.func.attr)
+                    frontier.append(node.func.attr)
+        return roots
+
+    @staticmethod
+    def _is_lock_expr(expr: ast.AST) -> bool:
+        name = ""
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        elif isinstance(expr, ast.Subscript):
+            return SharedPublishRule._is_lock_expr(expr.value)
+        low = name.lower()
+        return any(h in low for h in _LOCK_HINTS)
+
+    @classmethod
+    def _shared_target(cls, node: ast.AugAssign, globals_declared: set[str]):
+        tgt = node.target
+        if (
+            isinstance(tgt, ast.Attribute)
+            and isinstance(tgt.value, ast.Name)
+            and tgt.value.id == "self"
+        ):
+            return f"self.{tgt.attr}"
+        if (
+            isinstance(tgt, ast.Subscript)
+            and isinstance(tgt.value, ast.Attribute)
+            and isinstance(tgt.value.value, ast.Name)
+            and tgt.value.value.id == "self"
+        ):
+            return f"self.{tgt.value.attr}[...]"
+        if isinstance(tgt, ast.Name) and tgt.id in globals_declared:
+            return tgt.id
+        return None
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            for cls in ast.walk(ctx.tree):
+                if not isinstance(cls, ast.ClassDef):
+                    continue
+                workers = self._worker_methods(cls)
+                if not workers:
+                    continue
+                methods = {
+                    s.name: s for s in cls.body
+                    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+                for name in sorted(workers):
+                    yield from self._check_method(ctx, methods[name])
+
+    def _check_method(self, ctx, fn):
+        globals_declared: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globals_declared.update(node.names)
+
+        def scan(node, locked: bool):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                body_locked = locked or any(
+                    self._is_lock_expr(i.context_expr) for i in node.items
+                )
+                for child in node.body:
+                    yield from scan(child, body_locked)
+                return
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return
+            if isinstance(node, ast.AugAssign) and not locked:
+                what = self._shared_target(node, globals_declared)
+                if what is not None:
+                    yield (node, what)
+            for child in ast.iter_child_nodes(node):
+                yield from scan(child, locked)
+
+        for stmt in fn.body:
+            for node, what in scan(stmt, False):
+                yield Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    f"{what} read-modify-written in worker method "
+                    f"{fn.name!r} outside any lock -- += is load/add/store, "
+                    "concurrent updates lose increments; guard it",
+                )
+
+
 ALL_RULES: list[Rule] = [
     SwallowedExceptRule(),
     RawTransportRule(),
@@ -822,6 +1333,10 @@ ALL_RULES: list[Rule] = [
     MetricsRenderedRule(),
     TypedErrorsRule(),
     UnlockedGlobalRule(),
+    LockOrderRule(),
+    UnjoinedThreadRule(),
+    CondWaitLoopRule(),
+    SharedPublishRule(),
 ]
 
 # deadline_lint.py's historical surface: the two rules that together are the

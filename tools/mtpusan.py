@@ -1,0 +1,245 @@
+"""mtpusan driver: run suites/scenarios under the runtime concurrency
+sanitizer and gate on its findings.
+
+The dynamic half of the mtpusan pair (static rules live in tools/mtpulint:
+lock-order, unjoined-thread, cond-wait-loop, shared-publish). This driver:
+
+  1. re-runs the `pytest.mark.race` suites (same discovery as
+     tools/race_gate.py) with ``MTPU_TSAN=1``, so every SanLock acquisition
+     feeds the lock-order graph and every teardown is leak-checked;
+  2. replays a loadgen scenario (default: ``concurrent_put_collapse``, the
+     ROADMAP item-1 repro) sanitized, and keeps the per-lock
+     contention/hold-time profile the armed runner embeds in its report --
+     the measured serialization evidence the item-1 rewrite starts from;
+  3. merges every subprocess's findings artifact (written to
+     ``MTPU_TSAN_OUT`` at exit), drops rows the in-code SUPPRESSIONS table
+     already justified, applies the shrink-only baseline
+     (``tools/mtpusan_baseline.txt``, same relpath::rule::count format and
+     semantics as mtpulint's -- the site string rides in the relpath slot),
+     and fails on anything left.
+
+    python tools/mtpusan.py                 # suites + scenario, gate
+    python tools/mtpusan.py --suites-only
+    python tools/mtpusan.py --scenario-only --scenario mixed_smoke
+    python tools/mtpusan.py --out /tmp/mtpusan.json   # merged report JSON
+    python tools/mtpusan.py --write-baseline          # grandfather (shrink-only)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _HERE)
+sys.path.insert(0, ROOT)
+
+from mtpulint.engine import (  # noqa: E402
+    Finding,
+    apply_baseline,
+    format_baseline,
+    load_baseline,
+)
+from race_gate import discover_race_tests  # noqa: E402
+
+BASELINE_PATH = os.path.join(_HERE, "mtpusan_baseline.txt")
+DEFAULT_SCENARIO = "concurrent_put_collapse"
+TIMEOUT_S = int(os.environ.get("MTPUSAN_TIMEOUT_S", "1200"))
+
+
+def _san_env(out_path: str) -> dict:
+    env = dict(os.environ, MTPU_TSAN="1", MTPU_TSAN_OUT=out_path)
+    # The hold-time detector measures the PRODUCT's critical sections; under
+    # the sanitizer's own overhead + race-mode switch intervals a tighter
+    # threshold would mint schedule-noise findings.
+    env.setdefault("MTPU_TSAN_HOLD_MS", "400")
+    return env
+
+
+def _read_report(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def run_suites(reports: list[dict]) -> int:
+    """Race-marked suites, one sanitized pytest run. Returns the pytest rc."""
+    race_tests = discover_race_tests(ROOT)
+    if not race_tests:
+        print("[mtpusan] no race-marked suites found", file=sys.stderr)
+        return 2
+    print(f"[mtpusan] sanitized suite run: {', '.join(race_tests)}")
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        out = tf.name
+    try:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", "-q", "-x",
+             "-o", f"faulthandler_timeout={max(60, TIMEOUT_S - 120)}",
+             *race_tests],
+            cwd=ROOT, env=_san_env(out), timeout=TIMEOUT_S,
+        )
+        rep = _read_report(out)
+        if rep is not None:
+            rep["source"] = "race-suites"
+            reports.append(rep)
+        print(f"[mtpusan] suites: rc={proc.returncode} "
+              f"({time.time() - t0:.0f}s, "
+              f"{len(rep['findings']) if rep else '?'} raw finding(s))")
+        return proc.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[mtpusan] suites: DEADLOCK? timed out after {TIMEOUT_S}s",
+              file=sys.stderr)
+        return 1
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+
+
+def run_scenario(name: str, reports: list[dict], out_json: str | None) -> int:
+    """One sanitized loadgen replay; keeps the report's lock profile."""
+    scen = os.path.join(ROOT, "scenarios", f"{name}.yaml")
+    if not os.path.exists(scen):
+        print(f"[mtpusan] scenario not found: {scen}", file=sys.stderr)
+        return 2
+    print(f"[mtpusan] sanitized scenario replay: {name}")
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        out = tf.name
+    report_path = out_json or os.path.join(
+        tempfile.gettempdir(), f"mtpusan_{name}.json"
+    )
+    try:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_HERE, "loadgen.py"), scen,
+             "--out", report_path],
+            cwd=ROOT, env=_san_env(out), timeout=TIMEOUT_S,
+        )
+        rep = _read_report(out)
+        scen_rep = _read_report(report_path)
+        if rep is not None:
+            rep["source"] = f"scenario:{name}"
+            if scen_rep is not None:
+                # Prefer the profile snapshotted INSIDE the run (post-phases)
+                # over the atexit one; both exist, the runner's is canonical.
+                rep["lock_profile"] = scen_rep.get(
+                    "lock_profile", rep.get("lock_profile")
+                )
+            reports.append(rep)
+        n_locks = len((rep or {}).get("lock_profile") or {})
+        print(f"[mtpusan] scenario: rc={proc.returncode} "
+              f"({time.time() - t0:.0f}s, {n_locks} lock(s) profiled, "
+              f"report: {report_path})")
+        # The scenario's own SLO/compare verdict is tools/perf_gate.py's
+        # business; here only sanitizer findings gate, so a perf regression
+        # cannot mask (or be masked by) a concurrency finding.
+        return 0 if proc.returncode in (0, 1) else proc.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[mtpusan] scenario: timed out after {TIMEOUT_S}s", file=sys.stderr)
+        return 1
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+
+
+def merge_findings(reports: list[dict]) -> tuple[list[dict], list[dict]]:
+    """(unsuppressed, suppressed) across runs, deduped by (rule, site)."""
+    seen: set[tuple[str, str]] = set()
+    unsup: list[dict] = []
+    sup: list[dict] = []
+    for rep in reports:
+        for f in rep.get("findings", []):
+            key = (f.get("rule", "?"), f.get("site", "?"))
+            if key in seen:
+                continue
+            seen.add(key)
+            f = dict(f, source=rep.get("source", "?"))
+            (sup if "suppressed" in f else unsup).append(f)
+    return unsup, sup
+
+
+def gate(unsup: list[dict], baseline_path: str, write: bool) -> int:
+    """Apply the shrink-only baseline; 0 iff nothing new."""
+    as_findings = [
+        Finding(f["rule"], f["site"], 0, f.get("message", "")) for f in unsup
+    ]
+    if write:
+        header = (
+            "# mtpusan baseline -- grandfathered runtime findings\n"
+            "# (site::rule::count). Shrink-only: fix a finding, delete its\n"
+            "# line. Regenerate: python tools/mtpusan.py --write-baseline"
+        )
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(format_baseline(as_findings, header))
+        print(f"[mtpusan] baseline written: {len(as_findings)} finding(s) "
+              f"-> {baseline_path}")
+        return 0
+    new, stale = apply_baseline(as_findings, load_baseline(baseline_path))
+    for f in new:
+        print(f"[mtpusan] FINDING {f.rule} @ {f.relpath}: {f.message}",
+              file=sys.stderr)
+    for s in stale:
+        print(f"[mtpusan] stale baseline entry: {s}", file=sys.stderr)
+    if new:
+        print(f"[mtpusan] {len(new)} unsuppressed finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="mtpusan", description="runtime concurrency sanitizer driver"
+    )
+    ap.add_argument("--suites-only", action="store_true")
+    ap.add_argument("--scenario-only", action="store_true")
+    ap.add_argument("--scenario", default=DEFAULT_SCENARIO,
+                    help=f"loadgen scenario name (default: {DEFAULT_SCENARIO})")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather current findings (shrink-only) and exit 0")
+    ap.add_argument("--out", default=None,
+                    help="write the merged mtpusan report JSON here")
+    args = ap.parse_args(argv)
+
+    reports: list[dict] = []
+    rc = 0
+    if not args.scenario_only:
+        rc = max(rc, run_suites(reports))
+    if not args.suites_only:
+        rc = max(rc, run_scenario(args.scenario, reports, None))
+
+    unsup, sup = merge_findings(reports)
+    for f in sup:
+        print(f"[mtpusan] suppressed: {f['rule']} @ {f['site']} "
+              f"({f['suppressed']})")
+    profile = {}
+    for rep in reports:
+        profile.update(rep.get("lock_profile") or {})
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(
+                {"mtpusan": 1, "findings": unsup, "suppressed": sup,
+                 "lock_profile": profile, "runs": len(reports)},
+                f, indent=2, sort_keys=True,
+            )
+        print(f"[mtpusan] merged report: {args.out}")
+    gate_rc = gate(unsup, args.baseline, args.write_baseline)
+    rc = max(rc, gate_rc)
+    print(f"[mtpusan] {'PASS' if rc == 0 else 'FAIL'}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

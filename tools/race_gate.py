@@ -20,7 +20,14 @@ The reference runs its entire suite under the Go race detector
 normal mode (pytest) and this gate stresses the files where threads
 actually interleave.
 
-    python tools/race_gate.py [repeats]
+With --sanitize every round ALSO arms the mtpusan runtime sanitizer
+(MTPU_TSAN=1, minio_tpu/control/sanitizer.py): lock-order-inversion
+cycles, long holds, sleeps under locks, and teardown thread/fd leaks are
+collected per round and gated against tools/mtpusan_baseline.txt -- the
+lockdep side of the story, where this gate alone only catches races that
+actually fire.
+
+    python tools/race_gate.py [repeats] [--sanitize]
 """
 
 from __future__ import annotations
@@ -55,18 +62,31 @@ def discover_race_tests(root: str) -> list[str]:
 
 
 def main() -> int:
-    repeats = int(sys.argv[1]) if len(sys.argv) > 1 else 3
+    argv = sys.argv[1:]
+    sanitize = "--sanitize" in argv
+    argv = [a for a in argv if a != "--sanitize"]
+    repeats = int(argv[0]) if argv else 3
     root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     race_tests = discover_race_tests(root)
     if not race_tests:
         print("[race-gate] no tests marked pytest.mark.race -- the gate "
               "would silently cover nothing", file=sys.stderr)
         return 2
-    print(f"[race-gate] {len(race_tests)} marked file(s): {', '.join(race_tests)}")
+    print(f"[race-gate] {len(race_tests)} marked file(s): {', '.join(race_tests)}"
+          + (" [sanitized]" if sanitize else ""))
     env = dict(os.environ, MINIO_TPU_RACE="1")
+    san_reports: list[dict] = []
     failures = 0
     for i in range(repeats):
         t0 = time.time()
+        san_out = ""
+        if sanitize:
+            import tempfile
+
+            fd, san_out = tempfile.mkstemp(suffix=".json", prefix="mtpusan-")
+            os.close(fd)
+            env = dict(env, MTPU_TSAN="1", MTPU_TSAN_OUT=san_out)
+            env.setdefault("MTPU_TSAN_HOLD_MS", "400")
         try:
             proc = subprocess.run(
                 [
@@ -90,9 +110,50 @@ def main() -> int:
         except subprocess.TimeoutExpired:
             status = f"DEADLOCK? timed out after {TIMEOUT_S}s"
             failures += 1
+        if sanitize:
+            try:
+                with open(san_out, encoding="utf-8") as f:
+                    rep = __import__("json").load(f)
+                san_reports.append(rep)
+                status += f", {rep.get('unsuppressed', '?')} unsuppressed finding(s)"
+            except (OSError, ValueError):
+                status += ", NO sanitizer report (armed process died early?)"
+                failures += 1
+            finally:
+                try:
+                    os.unlink(san_out)
+                except OSError:
+                    pass
         print(f"[race-gate] round {i + 1}/{repeats}: {status} ({time.time() - t0:.0f}s)")
+    if sanitize and san_reports:
+        failures += _gate_sanitizer(root, san_reports)
     print(f"[race-gate] {'PASS' if not failures else 'FAIL'} ({repeats} rounds)")
     return 1 if failures else 0
+
+
+def _gate_sanitizer(root: str, reports: list[dict]) -> int:
+    """Merge per-round sanitizer findings, gate vs tools/mtpusan_baseline.txt
+    (mtpusan.py owns the heavier scenario flow; this is the suite-only gate)."""
+    sys.path.insert(0, os.path.join(root, "tools"))
+    from mtpulint.engine import Finding, apply_baseline, load_baseline
+
+    seen: set[tuple[str, str]] = set()
+    merged: list[Finding] = []
+    for rep in reports:
+        for f in rep.get("findings", []):
+            if "suppressed" in f:
+                continue
+            key = (f.get("rule", "?"), f.get("site", "?"))
+            if key not in seen:
+                seen.add(key)
+                merged.append(Finding(key[0], key[1], 0, f.get("message", "")))
+    new, _stale = apply_baseline(
+        merged, load_baseline(os.path.join(root, "tools", "mtpusan_baseline.txt"))
+    )
+    for f in new:
+        print(f"[race-gate] SANITIZER {f.rule} @ {f.relpath}: {f.message}",
+              file=sys.stderr)
+    return 1 if new else 0
 
 
 if __name__ == "__main__":
