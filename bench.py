@@ -141,6 +141,10 @@ def _stage_breakdown(snap: dict, phase: str, leaves: tuple[str, ...]) -> dict:
         leaf_total += h["sum"]
         rows[name] = {
             "total_ms": round(h["sum"] * 1e3, 1),
+            # Wall-vs-cpu attribution (thread_time deltas recorded alongside
+            # the span walls): cpu_ms ~= total_ms means the stage burns the
+            # core; cpu_ms << total_ms means it waits (GIL, device, disk).
+            "cpu_ms": round(h.get("cpu", 0.0) * 1e3, 1),
             "count": sum(h["counts"]),
             "p50_ms": round(quantile(h["counts"], 0.5) * 1e3, 3),
             "share": round(h["sum"] / e2e_s, 3) if e2e_s else 0.0,
@@ -150,7 +154,12 @@ def _stage_breakdown(snap: dict, phase: str, leaves: tuple[str, ...]) -> dict:
         "total_ms": round(other * 1e3, 1),
         "share": round(other / e2e_s, 3) if e2e_s else 0.0,
     }
-    return {"ops": n, "end_to_end_ms": round(e2e_s * 1e3, 1), "stages": rows}
+    return {
+        "ops": n,
+        "end_to_end_ms": round(e2e_s * 1e3, 1),
+        "end_to_end_cpu_ms": round(root.get("cpu", 0.0) * 1e3, 1) if root else 0.0,
+        "stages": rows,
+    }
 
 
 def object_layer_metrics(use_device: bool) -> dict:
@@ -164,9 +173,15 @@ def object_layer_metrics(use_device: bool) -> dict:
 
     from minio_tpu.control import tracing
     from minio_tpu.control.perf import GLOBAL_PERF
+    from minio_tpu.control.profiler import GLOBAL_PROFILER
     from minio_tpu.object.erasure import ErasureObjects
     from minio_tpu.storage import format as fmt
     from minio_tpu.storage.local import LocalDrive
+
+    # Arm the continuous profiling plane for the bench run: the BENCH JSON
+    # carries its summary (gil_load, top role stacks, copy ledger) so a
+    # number regression comes with its own attribution.
+    GLOBAL_PROFILER.ensure_started()
 
     codec = None
     if use_device:
@@ -251,6 +266,7 @@ def object_layer_metrics(use_device: bool) -> dict:
             ),
             "get": _stage_breakdown(get_snap, "bench.get", ("shard-read", "decode")),
         }
+        out["profile"] = GLOBAL_PROFILER.summary()
         layer.delete_object("bench", "getobj")
 
         # --- 8-concurrent-PUT aggregate (batching fan-in under load) -------

@@ -719,6 +719,50 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             headers={"Content-Disposition": 'attachment; filename="profiles.zip"'},
         )
 
+    def h_profile(request, body):
+        """Continuous profiling plane (control/profiler.py GLOBAL_PROFILER):
+        rotating windows of role-aggregated stacks, the calibrated GIL-load
+        gauge, and the copy ledger -- always on, no start/stop ceremony.
+
+        ?collapsed=1 downloads flamegraph collapsed-stack text;
+        ?summary=1 returns the compact report block (what loadgen embeds);
+        ?cluster=1 merges every peer's windows/copy ledger into one view
+        (gil_load stays per-node: GIL pressure doesn't sum across
+        interpreters); ?top=N bounds stacks per window (default 40)."""
+        from ..control.profiler import GLOBAL_PROFILER, merge_profiles
+
+        q = request.rel_url.query
+        try:
+            top = int(q.get("top", "40"))
+        except ValueError:
+            raise S3Error("InvalidArgument", "top must be an integer")
+
+        if q.get("collapsed", "") in ("1", "true"):
+            s = GLOBAL_PROFILER.sampler
+            return web.Response(
+                text=s.collapsed(top=top) if s is not None else "",
+                content_type="text/plain",
+                headers={
+                    "Content-Disposition": 'attachment; filename="profile.collapsed"'
+                },
+            )
+        if q.get("summary", "") in ("1", "true"):
+            return GLOBAL_PROFILER.summary()
+
+        out = GLOBAL_PROFILER.snapshot(top=top)
+        if q.get("cluster", "") in ("1", "true"):
+            snaps = [out]
+            peers = {}
+            for peer in _peer_clients():
+                try:
+                    r = peer.profile_snapshot(timeout=10.0)
+                    snaps.append(r.get("profile", {}))
+                    peers[peer.url] = {"ok": True}
+                except oerr.StorageError as e:
+                    peers[peer.url] = {"ok": False, "error": str(e)}
+            return {"node": out, "cluster": merge_profiles(snaps), "peers": peers}
+        return out
+
     # -- replication remote targets (bucket-targets.go admin surface) --------
 
     def h_set_target(request, body):
@@ -968,6 +1012,7 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_post("/speedtest", handler(h_speedtest))
     app.router.add_post("/profile/start", handler(h_profile_start))
     app.router.add_post("/profile/stop", handler(h_profile_stop))
+    app.router.add_get("/profile", handler(h_profile))
     app.router.add_get("/trace", handler(h_trace, stream=True))
     app.router.add_post("/replication/target", handler(h_set_target))
     app.router.add_get("/replication/target", handler(h_list_targets))

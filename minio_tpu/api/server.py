@@ -38,6 +38,7 @@ from ..control.iam import IAMSys
 from ..control.logging import GLOBAL_LOGGER
 from ..control import policy as policy_mod
 from ..control import tracing
+from ..control.profiler import COPIED, GLOBAL_PROFILER, MOVED
 from ..object.pools import ServerPools
 from ..object.types import (
     DeleteObjectOptions,
@@ -130,7 +131,11 @@ class _RequestBodyReader:
         if n <= 0:
             return b""
         fut = asyncio.run_coroutine_threadsafe(self._content.read(n), self._loop)
-        return fut.result(timeout=600)
+        data = fut.result(timeout=600)
+        # Copy-ledger hop: the event loop materializes each body chunk out
+        # of the socket buffer into a fresh bytes object.
+        GLOBAL_PROFILER.copy.record("socket-read", COPIED, len(data))
+        return data
 
 
 class _HashVerifyReader:
@@ -656,6 +661,9 @@ class S3Server:
             return await self._streaming_put_entry(request, bucket, key)
         with tracing.span("body-read", "api"):
             body = await request.read()
+        # Same hop as _RequestBodyReader, buffered flavor: the whole body
+        # materializes at once for non-streaming handlers.
+        GLOBAL_PROFILER.copy.record("socket-read", COPIED, len(body))
         # POST policy form uploads authenticate via the policy signature in
         # the form, not request headers (PostPolicyBucketHandler equivalent).
         ctype = request.headers.get("Content-Type", "")
@@ -2320,11 +2328,13 @@ class S3Server:
         # out of the (lazy) erasure read generator and pushing them onto
         # the socket -- the time a GET spends after headers.
         wr = tracing.span("response-write", "api", bytes=plan.content_length)
+        sent = 0
         try:
             while True:
                 chunk = await asyncio.to_thread(next, it, None)
                 if chunk is None:
                     break
+                sent += len(chunk)
                 await resp.write(chunk)
         except Exception as e:
             # Headers (and a Content-Length promise) are already on the
@@ -2333,6 +2343,10 @@ class S3Server:
             # the client waiting out the original length. Close the
             # connection instead so the client fails fast on truncation.
             wr.finish(error=type(e).__name__)
+            # Copy-ledger hop: chunks handed to aiohttp by reference --
+            # zero-copy from this layer's point of view (partial count on
+            # an aborted stream is honest: those bytes did cross the hop).
+            GLOBAL_PROFILER.copy.record("response-write", MOVED, sent)
             cur = tracing.current()
             if cur is not None:
                 cur.set(stream_aborted=type(e).__name__)
@@ -2342,6 +2356,7 @@ class S3Server:
                 request.transport.close()
         else:
             wr.finish()
+            GLOBAL_PROFILER.copy.record("response-write", MOVED, sent)
             with contextlib.suppress(Exception):
                 await resp.write_eof()
         return resp

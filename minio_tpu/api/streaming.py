@@ -19,6 +19,7 @@ import hashlib
 import hmac
 from typing import List, Tuple
 
+from ..control.profiler import COPIED, GLOBAL_PROFILER
 from .auth import Credentials, STREAMING_PAYLOAD, signing_key
 from .errors import S3Error
 
@@ -210,4 +211,8 @@ class SignedChunkReader:
             self._decode_one()
         out = bytes(self._out[:n])
         del self._out[:n]
+        # Copy-ledger hop: decode stages wire bytes into _raw, verified
+        # payload into _out, and every read() slices _out into a fresh
+        # bytes -- this hop copies by construction today.
+        GLOBAL_PROFILER.copy.record("sigv4-chunk-parse", COPIED, len(out))
         return out

@@ -29,6 +29,7 @@ from typing import Callable
 from aiohttp import web
 
 from ..control.logging import GLOBAL_LOGGER
+from ..control.profiler import COPIED, GLOBAL_PROFILER
 from ..control.sanitizer import san_lock, san_rlock
 
 
@@ -183,7 +184,11 @@ async def stream_hub_response(
             if line is None:
                 continue
             try:
-                await resp.write(line.encode() + b"\n")
+                data = line.encode() + b"\n"
+                await resp.write(data)
+                # Copy-ledger hop: every watcher line is serialized into a
+                # fresh buffer before the write (json.dumps + encode).
+                GLOBAL_PROFILER.copy.record("watch-stream", COPIED, len(data))
                 last_write = time.monotonic()
             except (ConnectionResetError, RuntimeError):
                 break

@@ -199,6 +199,7 @@ class MetricsSys:
         self._render_drives(metric)
         self._render_codec(metric)
         self._render_perf(lines)
+        self._render_profiler(metric)
         self._render_heal_scanner(metric)
         self._render_chaos(metric)
         self._render_degrade(metric)
@@ -452,6 +453,72 @@ class MetricsSys:
                 lines.append(f'{name}_bucket{{{lab},le="+Inf"}} {cum}')
                 lines.append(f'{name}_sum{{{lab}}} {round(row["sum"], 6)}')
                 lines.append(f'{name}_count{{{lab}}} {cum}')
+        # CPU attribution alongside the wall histogram: thread_time()
+        # seconds accumulated per stage. stage_cpu / stage_duration_sum
+        # close to 1 means the stage burns the core; close to 0 means it
+        # waits (GIL or I/O).
+        cname = "minio_tpu_stage_cpu_seconds_total"
+        lines.append(
+            f"# HELP {cname} CPU (thread_time) seconds attributed per stage."
+        )
+        lines.append(f"# TYPE {cname} counter")
+        for layer in sorted(stages):
+            for stage in sorted(stages[layer]):
+                row = stages[layer][stage]
+                lines.append(
+                    f'{cname}{{layer="{layer}",stage="{stage}"}} '
+                    f'{round(row.get("cpu", 0.0), 6)}'
+                )
+
+    def _render_profiler(self, metric) -> None:
+        """Continuous profiling plane (control/profiler.py). GIL/sampler
+        gauges render only while the plane is armed; the copy ledger is
+        always-on passive counters and renders whenever it has rows."""
+        from .profiler import GLOBAL_PROFILER
+
+        sampler = GLOBAL_PROFILER.sampler
+        if GLOBAL_PROFILER.armed and sampler is not None:
+            metric(
+                "minio_tpu_gil_load", round(GLOBAL_PROFILER.gil_load(), 4),
+                help_="Calibrated GIL-load estimate in [0,1] from the "
+                      "scheduling-jitter probe (0 until calibrated).",
+                type_="gauge",
+            )
+            metric(
+                "minio_tpu_profiler_overhead_ratio",
+                round(sampler.overhead_ratio(), 6),
+                help_="Continuous-sampler self-time as a fraction of wall "
+                      "time over the retained windows.",
+                type_="gauge",
+            )
+            metric(
+                "minio_tpu_profiler_samples_window",
+                sum(w["samples"] for w in sampler.windows(top=0)),
+                help_="Stack samples held across the retained profile windows.",
+                type_="gauge",
+            )
+            metric(
+                "minio_tpu_profiler_windows_rotated_total",
+                sampler.windows_rotated,
+                help_="Profile windows closed into the ring since start.",
+            )
+        hops = GLOBAL_PROFILER.copy.snapshot()["hops"]
+        for hop, row in sorted(hops.items()):
+            for kind, key in (("copied", "copied_bytes"), ("moved", "moved_bytes")):
+                metric(
+                    "minio_tpu_copy_bytes_total", row[key],
+                    {"hop": hop, "kind": kind},
+                    help_="Data-path bytes per hop, split copied (hop "
+                          "materialized a new buffer) vs moved (zero-copy "
+                          "pass-through).",
+                )
+        for hop, row in sorted(hops.items()):
+            for kind, key in (("copied", "copied_ops"), ("moved", "moved_ops")):
+                metric(
+                    "minio_tpu_copy_ops_total", row[key],
+                    {"hop": hop, "kind": kind},
+                    help_="Data-path buffer operations per hop, by kind.",
+                )
 
     def _render_heal_scanner(self, metric) -> None:
         """Heal + scanner progress counters (healmgr/MRF/disk-heal/scanner)."""

@@ -50,11 +50,12 @@ def bucket_index(seconds: float) -> int:
 
 
 class _Hist:
-    __slots__ = ("counts", "sum")
+    __slots__ = ("counts", "sum", "cpu")
 
     def __init__(self):
         self.counts = [0] * (N_BUCKETS + 1)  # [..edges.., +Inf]
         self.sum = 0.0
+        self.cpu = 0.0  # thread_time() seconds attributed alongside wall
 
 
 # -- stage registry -----------------------------------------------------------
@@ -118,7 +119,14 @@ class StageLedger:
         ]
         self._locks = [san_lock("StageLedger._locks") for _ in range(_N_SHARDS)]
 
-    def record(self, layer: str, stage: str, seconds: float) -> None:
+    def record(
+        self, layer: str, stage: str, seconds: float, cpu_seconds: float = 0.0
+    ) -> None:
+        """One observation. `cpu_seconds` is the recorder's time.thread_time()
+        delta over the same interval (0.0 when unknown -- e.g. a span that
+        finished on a different thread than it started on): wall >> cpu on a
+        stage means it waits (GIL or I/O), wall ~= cpu means it burns the
+        core."""
         key = (layer, stage)
         si = hash(key) & (_N_SHARDS - 1)
         with self._locks[si]:
@@ -128,6 +136,7 @@ class StageLedger:
                 h = shard[key] = _Hist()
             h.counts[bucket_index(seconds)] += 1
             h.sum += seconds
+            h.cpu += cpu_seconds
 
     def snapshot(self) -> dict:
         """JSON/msgpack-able copy: {"buckets_us": [...], "stages":
@@ -136,11 +145,12 @@ class StageLedger:
         stages: dict[str, dict[str, dict]] = {}
         for lock, shard in zip(self._locks, self._shards):
             with lock:
-                items = [(k, list(h.counts), h.sum) for k, h in shard.items()]
-            for (layer, stage), counts, total in items:
+                items = [(k, list(h.counts), h.sum, h.cpu) for k, h in shard.items()]
+            for (layer, stage), counts, total, cpu in items:
                 stages.setdefault(layer, {})[stage] = {
                     "counts": counts,
                     "sum": total,
+                    "cpu": cpu,
                 }
         return {"buckets_us": list(BUCKET_LE_US), "stages": stages}
 
@@ -167,12 +177,16 @@ def merge_snapshots(snaps: list[dict]) -> dict:
                     dst_layer[stage] = {
                         "counts": list(h["counts"]),
                         "sum": float(h["sum"]),
+                        # Tolerate pre-cpu snapshots (version skew): missing
+                        # cpu merges as zero instead of corrupting the sum.
+                        "cpu": float(h.get("cpu", 0.0)),
                     }
                 else:
                     dst["counts"] = [
                         a + b for a, b in zip(dst["counts"], h["counts"])
                     ]
                     dst["sum"] += h["sum"]
+                    dst["cpu"] = dst.get("cpu", 0.0) + float(h.get("cpu", 0.0))
     return {"buckets_us": list(BUCKET_LE_US), "stages": out}
 
 
@@ -218,6 +232,7 @@ def summarize(snap: dict) -> dict:
             out.setdefault(layer, {})[stage] = {
                 "count": n,
                 "total_ms": round(h["sum"] * 1e3, 3),
+                "cpu_seconds": round(h.get("cpu", 0.0), 6),
                 "mean_ms": round(h["sum"] / n * 1e3, 3) if n else 0.0,
                 "p50_ms": round(quantile(counts, 0.50) * 1e3, 3),
                 "p95_ms": round(quantile(counts, 0.95) * 1e3, 3),
@@ -407,8 +422,10 @@ class PerfSys:
         self.ledger = StageLedger()
         self.slow = SlowRequestCapture()
 
-    def on_span_finish(self, span, duration_s: float, error: str | None) -> None:
-        self.ledger.record(span.layer, span.name, duration_s)
+    def on_span_finish(
+        self, span, duration_s: float, error: str | None, cpu_s: float = 0.0
+    ) -> None:
+        self.ledger.record(span.layer, span.name, duration_s, cpu_s)
         if span.trace_id and self.slow.wants(span.trace_id):
             rec = {
                 "name": span.name,

@@ -97,6 +97,12 @@ SUPPRESSIONS: tuple[tuple[str, str, str], ...] = (
      "uvicorn serving thread lives for the process (cli.py serve)"),
     ("leaked-thread", "pytest_timeout",
      "pytest-timeout watchdog thread, not project code"),
+    ("leaked-thread", "prof-continuous",
+     "always-on continuous profiling sampler (control/profiler.py): one "
+     "process singleton; GLOBAL_PROFILER.stop() is the teardown hook"),
+    ("leaked-thread", "gil-probe",
+     "always-on GIL-load probe (control/profiler.py): one process "
+     "singleton; GLOBAL_PROFILER.stop() is the teardown hook"),
     ("leaked-thread", "asyncio_",
      "asyncio default executor worker owned by the event loop"),
     ("lock-held-long", "IAMSys._mutate_lock",

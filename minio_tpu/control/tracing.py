@@ -32,6 +32,7 @@ import contextvars
 import itertools
 import os
 import secrets
+import threading
 import time
 from typing import Iterator
 
@@ -104,6 +105,8 @@ class Span:
         "layer",
         "sys",
         "start",
+        "cpu_start",
+        "tid",
         "tags",
         "sampled",
         "_token",
@@ -127,6 +130,11 @@ class Span:
         self.parent_id = parent_id
         self.sys = sys
         self.start = time.perf_counter()
+        # CPU attribution rides every span: thread_time() is per-thread, so
+        # the delta is only meaningful when finish() runs on the same thread
+        # -- finish() checks the ident and reports cpu=0 (unknown) otherwise.
+        self.cpu_start = time.thread_time()
+        self.tid = threading.get_ident()
         self.tags = tags
         self.sampled = sampled
         self._token = None
@@ -155,10 +163,15 @@ class Span:
             return
         self._closed = True
         duration = time.perf_counter() - self.start
+        cpu = (
+            time.thread_time() - self.cpu_start
+            if threading.get_ident() == self.tid
+            else 0.0
+        )
         # The stage ledger records UNCONDITIONALLY -- attribution must not
         # depend on someone watching the hub OR on the sampling knob
         # (control/perf.py); only span PUBLICATION is sampled.
-        GLOBAL_PERF.on_span_finish(self, duration, error)
+        GLOBAL_PERF.on_span_finish(self, duration, error, cpu)
         if not self.sampled or not self.sys.enabled():
             return
         fields = dict(self.tags)

@@ -458,6 +458,13 @@ class Node:
             notifier=self.notifier,
         )
         self.s3.site_repl = self.site_repl
+        # Arm the always-on profiling plane (continuous stack sampler +
+        # GIL probe; MTPU_PROFILE=0 vetoes). Process-wide singleton:
+        # idempotent across the nodes of an in-process cluster, stopped by
+        # close_all().
+        from ..control.profiler import GLOBAL_PROFILER
+
+        GLOBAL_PROFILER.ensure_started()
         return self
 
     def refresh_bucket_notification(self, bucket: str) -> None:
@@ -548,6 +555,11 @@ class Node:
         lifetime."""
         for node in list(cls._live):
             node.close()
+        # The profiling plane is process-wide (not per-node), so it stops
+        # here -- after the last node -- rather than in close().
+        from ..control.profiler import GLOBAL_PROFILER
+
+        GLOBAL_PROFILER.stop()
 
     def make_app(self) -> web.Application:
         """One aiohttp app: internode routers first, S3 catch-all last

@@ -148,6 +148,16 @@ def make_peer_app(node, token: str) -> web.Application:
         p.stop()
         return {"text": p.report()}
 
+    def h_profile(a):
+        """This node's continuous-profiling snapshot (rotating stack windows,
+        GIL load, copy ledger): the admin /profile?cluster=1 endpoint merges
+        these into the cluster view (merge_profiles)."""
+        from ..control.profiler import GLOBAL_PROFILER
+
+        return {
+            "profile": GLOBAL_PROFILER.snapshot(top=int(a.get("top", 40)))
+        }
+
     def h_bandwidth(a):
         """This node's replication bandwidth monitor (merged cluster-wide by
         the admin endpoint; each node throttles its own replica traffic)."""
@@ -228,6 +238,7 @@ def make_peer_app(node, token: str) -> web.Application:
         "speedtest": h_speedtest,
         "profilestart": h_profile_start,
         "profilestop": h_profile_stop,
+        "profile": h_profile,
         "bandwidth": h_bandwidth,
         "metrics": h_node_metrics,
         "perf": h_perf,
@@ -291,6 +302,9 @@ class PeerClient:
 
     def profile_stop(self) -> dict:
         return self.client.call("/profilestop", {}, timeout=60.0)
+
+    def profile_snapshot(self, top: int = 40, timeout: float | None = None) -> dict:
+        return self.client.call("/profile", {"top": top}, timeout=timeout) or {}
 
     def listen_stream(self):
         """Live event stream from this peer (caller iterates lines + closes).

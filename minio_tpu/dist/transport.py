@@ -209,6 +209,7 @@ class RestClient:
         if rem is not None:
             headers[DEADLINE_HEADER] = f"{max(rem, 0.0):.3f}"
         t0 = time.monotonic()
+        c0 = time.thread_time()
         try:
             if body is not None:
                 r = self.session.post(
@@ -234,7 +235,10 @@ class RestClient:
             # which is a no-op outside request context) so background RPCs
             # -- heal, scanner, lock refresh -- are attributed too.
             GLOBAL_PERF.ledger.record(
-                "rpc-peer", f"{path}@{self._peer_label}", time.monotonic() - t0
+                "rpc-peer",
+                f"{path}@{self._peer_label}",
+                time.monotonic() - t0,
+                time.thread_time() - c0,
             )
             rpc.finish(error=type(e).__name__)
             # A timeout on a deadline-capped hop is the BUDGET expiring, not
@@ -257,7 +261,12 @@ class RestClient:
                 dt.log_failure()
             raise errors.DiskNotFound(f"{url}: {e}")
         elapsed = time.monotonic() - t0
-        GLOBAL_PERF.ledger.record("rpc-peer", f"{path}@{self._peer_label}", elapsed)
+        GLOBAL_PERF.ledger.record(
+            "rpc-peer",
+            f"{path}@{self._peer_label}",
+            elapsed,
+            time.thread_time() - c0,
+        )
         rpc.set(status=r.status_code)
         rpc.finish()
         self._mark(True)

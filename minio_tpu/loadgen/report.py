@@ -144,6 +144,7 @@ def build_report(
     degrade: dict,
     probe_cached: bool = False,
     lock_profile: dict | None = None,
+    profile: dict | None = None,
 ) -> dict:
     phases: dict = {}
     for pr in results:
@@ -177,6 +178,12 @@ def build_report(
         # Only present when the run was sanitized (MTPU_TSAN=1): per-lock
         # acquisition counts, contention, and hold/wait time over the phases.
         report["lock_profile"] = lock_profile
+    if profile:
+        # Only when the scenario asked for it (profile: true / --profile):
+        # the continuous-profiling summary -- gil_load, top role-aggregated
+        # stacks, sampler overhead, and the per-hop copy ledger -- so the
+        # report names the bottleneck, not just the tails.
+        report["profile"] = profile
     cmp = _evaluate_compare(scenario, phases)
     if cmp is not None:
         report["compare"] = cmp

@@ -153,9 +153,10 @@ class ScenarioRunner:
                     return
                 op = ops[i]
                 t0 = time.perf_counter()
+                c0 = time.thread_time()
                 res = self._execute(op)
                 dt = time.perf_counter() - t0
-                pr.ledger.record("loadgen", op.kind, dt)
+                pr.ledger.record("loadgen", op.kind, dt, time.thread_time() - c0)
                 sec = int(time.monotonic() - start)
                 with stats_lock:
                     pr.executed += 1
@@ -239,6 +240,14 @@ class ScenarioRunner:
         from .report import build_report
 
         sc = self.scenario
+        if sc.profile:
+            # Arm the continuous profiling plane before the clock starts so
+            # the run's windows cover the measured phases.
+            try:
+                armed = self.admin.start_profile()
+                self._log(f"profiling plane {'armed' if armed else 'UNAVAILABLE'}")
+            except Exception:  # noqa: BLE001 - a live target may deny admin
+                pass
         self._log(f"prepopulating {sc.prepopulate} objects into {sc.bucket!r}")
         self.prepopulate()
         # A clean measurement window: setup traffic must not pollute the
@@ -262,6 +271,12 @@ class ScenarioRunner:
             degrade = self.admin.degrade()
         except Exception:  # noqa: BLE001
             degrade = {}
+        profile = None
+        if sc.profile:
+            try:
+                profile = self.admin.profile_summary() or None
+            except Exception:  # noqa: BLE001
+                profile = None
         from ..control.sanitizer import profile_if_armed
 
         return build_report(
@@ -271,4 +286,5 @@ class ScenarioRunner:
             degrade=degrade,
             probe_cached=bool(getattr(self.admin, "probe_cached", False)),
             lock_profile=profile_if_armed(),
+            profile=profile,
         )

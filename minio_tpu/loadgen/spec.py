@@ -94,6 +94,8 @@ class Scenario:
     phases: list[Phase] = field(default_factory=list)
     compare: dict | None = None    # {"a": phase, "b": phase, "op": kind,
     #                                 "metric": ..., "min_ratio": r}
+    profile: bool = False          # embed the continuous-profiling summary
+    #                                (gil_load, role stacks, copy ledger)
 
 
 def _parse_sizes(doc, path: str) -> dict:
@@ -222,6 +224,7 @@ def parse_scenario(doc: dict) -> Scenario:
         sizes=_parse_sizes(_require(doc, "$", "sizes", dict, default={"kind": "fixed", "bytes": 4096}), "$.sizes"),
         slo=_parse_slo(doc.get("slo"), "$.slo"),
         compare=_require(doc, "$", "compare", dict, default=None),
+        profile=bool(_require(doc, "$", "profile", bool, default=False)),
     )
     mp = _require(doc, "$", "multipart", dict, default={})
     sc.multipart_parts = int(_number(mp, "$.multipart", "parts", default=3, minimum=1))

@@ -249,6 +249,16 @@ class InProcessAdmin:
 
         REGISTRY.disarm(fault_id)
 
+    def start_profile(self) -> bool:
+        from ..control.profiler import GLOBAL_PROFILER
+
+        return GLOBAL_PROFILER.ensure_started()
+
+    def profile_summary(self) -> dict:
+        from ..control.profiler import GLOBAL_PROFILER
+
+        return GLOBAL_PROFILER.summary()
+
 
 class EndpointAdmin:
     """Admin surface over the wire (live-endpoint mode): the signed admin
@@ -293,3 +303,11 @@ class EndpointAdmin:
     def disarm_fault(self, fault_id: str) -> None:
         self.target.request("DELETE", ADMIN + "/chaos",
                             query=[("fault-id", fault_id)])
+
+    def start_profile(self) -> bool:
+        # The plane is armed server-side at node build; asking for the
+        # summary confirms it's live (armed=False in the block otherwise).
+        return bool(self._get_json(ADMIN + "/profile").get("armed"))
+
+    def profile_summary(self) -> dict:
+        return self._get_json(ADMIN + "/profile", query=[("summary", "1")])

@@ -28,6 +28,7 @@ from typing import Iterator
 
 from ..control import tracing
 from ..control.degrade import GLOBAL_DEGRADE
+from ..control.profiler import COPIED, GLOBAL_PROFILER, MOVED
 from ..ops import bitrot as bitrot_mod
 from ..utils import deadline
 from ..storage.interface import StorageAPI
@@ -110,7 +111,11 @@ def _read_full(reader, n: int) -> bytes:
 
 
 def _iter_blocks(reader, first: bytes) -> Iterator[bytes]:
-    """Yield BLOCK_SIZE blocks from `first` + reader; last may be short."""
+    """Yield BLOCK_SIZE blocks from `first` + reader; last may be short.
+
+    Copy-ledger hop: every block leaves here as a fresh ``bytes`` sliced out
+    of the staging buffer -- the erasure batch staging copy on the PUT path.
+    """
     buf = bytearray(first)
     while True:
         if len(buf) < BLOCK_SIZE:
@@ -119,9 +124,11 @@ def _iter_blocks(reader, first: bytes) -> Iterator[bytes]:
                 break
             buf += chunk
             continue
+        GLOBAL_PROFILER.copy.record("erasure-stage", COPIED, BLOCK_SIZE)
         yield bytes(buf[:BLOCK_SIZE])
         del buf[:BLOCK_SIZE]
     if buf:
+        GLOBAL_PROFILER.copy.record("erasure-stage", COPIED, len(buf))
         yield bytes(buf)
 
 
@@ -256,6 +263,11 @@ class ShardStageWriter:
             self.disks[i].append_file(META_BUCKET, self.stage_path(i), row_frames[row])
 
         self._appended = True
+        # Copy-ledger hop: shard frames are handed to the drives by
+        # reference -- the fan-out moves bytes without another copy.
+        GLOBAL_PROFILER.copy.record(
+            "shard-fanout", MOVED, sum(len(f) for f in row_frames)
+        )
         with tracing.span("shard-fanout", "object", drives=len(self.disks)):
             for i, (_, e) in enumerate(meta_mod.parallel_map(wr, range(len(self.disks)))):
                 if e is not None:
@@ -1113,6 +1125,9 @@ class ErasureObjects:
                             file_len,
                         )
                     parsed = _parse_frames(blob, window_sizes)
+                    # Copy-ledger hop: frame parsing slices memoryviews over
+                    # the read blob -- zero-copy by construction.
+                    GLOBAL_PROFILER.copy.record("frame-parse", MOVED, len(blob))
                     # Verify here, in the parallel read thread: the native
                     # verifier releases the GIL, so rows verify concurrently.
                     return parsed, _verify_frames(blob, window_sizes, parsed)
@@ -1275,6 +1290,11 @@ class ErasureObjects:
                         for wi, (chunks, _) in zip(idxs, results):
                             for slot, j in enumerate(want):
                                 rows_by_block[wi][j] = chunks[slot]
+                                # Copy-ledger hop: a degraded read rebuilds
+                                # the missing rows into fresh buffers.
+                                GLOBAL_PROFILER.copy.record(
+                                    "decode", COPIED, len(chunks[slot])
+                                )
 
             for b in range(g0, g1 + 1):
                 joined = _join_block_rows(rows_by_block[b - g0], k, block_len(b))

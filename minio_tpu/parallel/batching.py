@@ -153,11 +153,16 @@ class BatchingDeviceCodec(BlockCodec):
             for i, req in enumerate(batch):
                 arr[i] = req.shards
             t0 = _time.perf_counter()
+            c0 = _time.thread_time()
             shards, digests = pipe.encode(arr)
             dt = _time.perf_counter() - t0
             # Ledger record, not a span: worker threads run outside any
-            # request context, so a span here would be a silent no-op.
-            GLOBAL_PERF.ledger.record("codec", "encode-batch", dt)
+            # request context, so a span here would be a silent no-op. The
+            # cpu delta separates device wait (wall >> cpu) from host-side
+            # marshalling burning the core.
+            GLOBAL_PERF.ledger.record(
+                "codec", "encode-batch", dt, _time.thread_time() - c0
+            )
             with self._stats_lock:
                 self.device_encode_seconds += dt
                 self.batches_run += 1
@@ -234,11 +239,14 @@ class BatchingDeviceCodec(BlockCodec):
             _, surv, s = plan
             self._ensure_worker(k, m)
             t0 = _time.perf_counter()
+            c0 = _time.thread_time()
             out = run_device_reconstruct(
                 self._pipelines[(k, m)], rows_batch, k, tuple(want), surv, s, with_digests
             )
             dt = _time.perf_counter() - t0
-            GLOBAL_PERF.ledger.record("codec", "reconstruct-batch", dt)
+            GLOBAL_PERF.ledger.record(
+                "codec", "reconstruct-batch", dt, _time.thread_time() - c0
+            )
             with self._stats_lock:
                 self.device_recon_seconds += dt
                 self.recon_batches_run += 1
@@ -297,9 +305,12 @@ class BatchingDeviceCodec(BlockCodec):
             for i, c in enumerate(sub):
                 arr[i, 0] = np.frombuffer(c, dtype=np.uint8)
             t0 = _time.perf_counter()
+            c0 = _time.thread_time()
             digs = np.asarray(pipe.verify_digests(arr))  # [n_pad, 1, 32]
             dt = _time.perf_counter() - t0
-            GLOBAL_PERF.ledger.record("codec", "verify-batch", dt)
+            GLOBAL_PERF.ledger.record(
+                "codec", "verify-batch", dt, _time.thread_time() - c0
+            )
             with self._stats_lock:
                 self.device_verify_seconds += dt
                 self.verify_batches_run += 1

@@ -14,6 +14,7 @@ import threading
 import time
 
 from ..control.perf import GLOBAL_PERF
+from ..control.profiler import COPIED, GLOBAL_PROFILER, MOVED
 from ..control.sanitizer import san_lock, san_rlock
 
 # StorageAPI methods that hit the disk (the metered set).
@@ -25,6 +26,12 @@ _METERED = frozenset(
         "rename_data rename_file list_dir walk_dir verify_file"
     ).split()
 )
+
+# Copy-ledger hop classification for the drive boundary: writes hand the
+# caller's buffer straight to the OS (moved); reads materialize fresh bytes
+# from the page cache (copied).
+_WRITE_BYTES = frozenset({"write_all", "create_file", "append_file"})
+_READ_BYTES = frozenset({"read_file", "read_all"})
 
 _EWMA_ALPHA = 0.3  # same smoothing idea as the reference's diskMaxTimeout ewma
 
@@ -47,13 +54,16 @@ class MeteredDrive:
         if name not in _METERED or not callable(attr):
             return attr
 
-        def record(t0: float, failed: bool) -> None:
+        def record(t0: float, c0: float, failed: bool) -> None:
             dt = time.perf_counter() - t0
             ms = dt * 1e3
             # Always-on attribution: storage calls feed the stage ledger
             # directly (one bucket increment) -- drive fan-out pool threads
-            # have no span context, so Span.finish can't cover them.
-            GLOBAL_PERF.ledger.record("storage", name, dt)
+            # have no span context, so Span.finish can't cover them. The
+            # thread_time delta is valid because record runs on the calling
+            # thread: wall >> cpu here means the drive (or page cache) is
+            # the wait, not the interpreter.
+            GLOBAL_PERF.ledger.record("storage", name, dt, time.thread_time() - c0)
             with self._lock:
                 if failed:
                     self._errors[name] = self._errors.get(name, 0) + 1
@@ -96,23 +106,31 @@ class MeteredDrive:
             # raised mid-stream — timing creation alone would always read 0.
             def timed_gen(*args, **kwargs):
                 t0 = time.perf_counter()
+                c0 = time.thread_time()
                 try:
                     yield from attr(*args, **kwargs)
                 except Exception:
-                    record(t0, failed=True)
+                    record(t0, c0, failed=True)
                     raise
-                record(t0, failed=False)
+                record(t0, c0, failed=False)
 
             return timed_gen
 
         def timed(*args, **kwargs):
             t0 = time.perf_counter()
+            c0 = time.thread_time()
             try:
                 out = attr(*args, **kwargs)
             except Exception:
-                record(t0, failed=True)
+                record(t0, c0, failed=True)
                 raise
-            record(t0, failed=False)
+            record(t0, c0, failed=False)
+            if name in _WRITE_BYTES:
+                data = kwargs.get("data") if len(args) < 3 else args[2]
+                if data is not None:
+                    GLOBAL_PROFILER.copy.record("drive-write", MOVED, len(data))
+            elif name in _READ_BYTES and out is not None:
+                GLOBAL_PROFILER.copy.record("drive-read", COPIED, len(out))
             return out
 
         return timed

@@ -1,0 +1,612 @@
+"""Continuous profiling plane: windows, GIL probe, copy ledger, /profile.
+
+Covers control/profiler.py end to end -- window rotation under a bounded
+ring, thread-role aggregation, the calibrated GIL-load probe (loaded vs
+idle ordering), copy-ledger conservation across a real in-process PUT+GET,
+the cluster-merged /mtpu/admin/v1/profile surface, and the sampler's
+self-measured overhead bound -- plus the SamplingProfiler elapsed-time
+regressions and a smoke of tools/profile_diff.py.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from minio_tpu.control.profiler import (
+    COPIED,
+    GLOBAL_PROFILER,
+    MOVED,
+    ROLE_PREFIXES,
+    ContinuousProfiler,
+    CopyLedger,
+    GilLoadProbe,
+    ProfilerSys,
+    SamplingProfiler,
+    merge_profiles,
+    thread_role,
+)
+
+_REPO = Path(__file__).resolve().parent.parent
+_spec = importlib.util.spec_from_file_location(
+    "profile_diff", _REPO / "tools" / "profile_diff.py"
+)
+profile_diff = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(profile_diff)
+
+
+class TestThreadRoles:
+    def test_known_prefixes_map_to_roles(self):
+        cases = {
+            "asyncio_0": "api-executor",
+            "http-server": "api-loop",
+            "lg-worker-3": "loadgen",
+            "drive-io-7": "drive-io",
+            "encode-batch-1": "codec-batch",
+            "codec-warmup": "codec-batch",
+            "etag-md5": "hash",
+            "peer-stream-pump": "rpc",
+            "lock-refresh": "rpc",
+            "data-scanner": "scanner",
+            "mrf-heal": "scanner",
+            "prof-continuous": "profiler",
+            "gil-probe": "profiler",
+            "MainThread": "main",
+        }
+        for name, role in cases.items():
+            assert thread_role(name) == role, name
+
+    def test_unknown_names_fall_into_other(self):
+        assert thread_role("ThreadPoolExecutor-0_0") == "other"
+        assert thread_role("") == "other"
+
+
+class TestSamplingProfilerElapsed:
+    """The two elapsed-time bugs the ISSUE names: report() before stop()
+    used to claim "over 0.0s", and a stop() arriving long after the
+    max_duration_s safety valve inflated the denominator."""
+
+    def test_report_mid_run_shows_live_elapsed(self):
+        p = SamplingProfiler(interval_s=0.002)
+        p.start()
+        try:
+            time.sleep(0.15)
+            rpt = p.report()
+            assert p.elapsed_s > 0.05
+            assert "over 0.0s" not in rpt
+        finally:
+            p.stop()
+
+    def test_late_stop_after_valve_does_not_inflate_elapsed(self):
+        p = SamplingProfiler(interval_s=0.002, max_duration_s=0.05)
+        p.start()
+        t = p._thread
+        t.join(5)
+        assert not t.is_alive(), "safety valve never fired"
+        # A stop() arriving long after the valve must not grow elapsed.
+        frozen = p.elapsed_s
+        time.sleep(0.3)
+        p.stop()
+        assert p.elapsed_s == frozen
+        assert p.elapsed_s < 0.25, p.elapsed_s
+
+    def test_samples_attributed_per_thread(self):
+        stop = threading.Event()
+
+        def busy():
+            while not stop.is_set():
+                sum(i * i for i in range(500))
+
+        w = threading.Thread(target=busy, daemon=True, name="lg-busy-sampled")
+        w.start()
+        p = SamplingProfiler(interval_s=0.002)
+        p.start()
+        time.sleep(0.2)
+        p.stop()
+        stop.set()
+        w.join(2)
+        # A full pytest run leaves hundreds of parked pool threads alive;
+        # an unbounded report keeps the assertion independent of how many
+        # share the top-60 rows.
+        assert "[lg-busy-sampled]" in p.report(top=10**6)
+
+
+class TestContinuousWindows:
+    def test_rotation_and_ring_bound(self):
+        cp = ContinuousProfiler(interval_s=0.002, window_s=0.04, max_windows=3)
+        cp.start()
+        try:
+            time.sleep(0.5)
+        finally:
+            cp.stop()
+        assert cp.windows_rotated >= 3
+        wins = cp.windows()
+        # stop() folds the live window into the same bounded ring.
+        assert 1 <= len(wins) <= 3
+        for w in wins:
+            assert w["closed"] is True
+            assert w["samples"] >= 1
+            assert w["duration_s"] > 0
+            assert w["overhead_ratio"] >= 0
+            assert set(w["roles"]) <= {r for _, r in ROLE_PREFIXES} | {"other"}
+
+    def test_collapsed_output_is_flamegraph_format(self):
+        cp = ContinuousProfiler(interval_s=0.002, window_s=10.0)
+        cp.start()
+        try:
+            time.sleep(0.1)
+        finally:
+            cp.stop()
+        text = cp.collapsed()
+        assert text, "no stacks sampled"
+        for line in text.strip().splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert stack and count.isdigit(), line
+            # role;file:func;file:func
+            role = stack.split(";", 1)[0]
+            assert role and ":" not in role, line
+            assert ":" in stack.split(";", 1)[1], line
+
+    def test_overhead_ratio_stays_low(self):
+        cp = ContinuousProfiler(interval_s=0.010, window_s=10.0)
+        cp.start()
+        try:
+            time.sleep(0.4)
+        finally:
+            cp.stop()
+        # Self-measured duty cycle: each tick costs ~100us against a 10 ms
+        # interval. The bound is generous (CI noise) but still catches a
+        # sampler that busy-loops.
+        assert 0.0 <= cp.overhead_ratio() < 0.2
+
+
+class TestGilProbe:
+    def test_value_zero_until_calibrated(self):
+        probe = GilLoadProbe()
+        assert probe.value() == 0.0
+
+    def test_loaded_interpreter_reads_higher_than_idle(self):
+        probe = GilLoadProbe(interval_s=0.004)
+        probe.start()
+        try:
+            deadline = time.monotonic() + 10
+            # Calibration floor + a ring of idle delays first.
+            while probe.ticks < probe._CALIB_TICKS + 12:
+                assert time.monotonic() < deadline, "probe never calibrated"
+                time.sleep(0.01)
+            idle = probe.value()
+
+            stop = threading.Event()
+
+            def burn():
+                while not stop.is_set():
+                    sum(i * i for i in range(2000))
+
+            workers = [
+                threading.Thread(target=burn, daemon=True, name=f"lg-burn-{i}")
+                for i in range(4)
+            ]
+            for w in workers:
+                w.start()
+            time.sleep(0.5)
+            loaded = probe.value()
+            stop.set()
+            for w in workers:
+                w.join(2)
+        finally:
+            probe.stop()
+        assert loaded > idle, (loaded, idle)
+        assert loaded > 0.05, loaded
+        assert 0.0 <= idle <= 1.0 and 0.0 <= loaded <= 1.0
+
+
+class TestCopyLedger:
+    def test_record_and_snapshot(self):
+        cl = CopyLedger()
+        cl.record("socket-read", COPIED, 100)
+        cl.record("socket-read", COPIED, 50)
+        cl.record("drive-write", MOVED, 400)
+        cl.record("drive-write", COPIED, 0)   # no-op
+        cl.record("drive-write", COPIED, -5)  # no-op
+        snap = cl.snapshot()
+        assert snap["hops"]["socket-read"] == {
+            "copied_bytes": 150, "copied_ops": 2,
+            "moved_bytes": 0, "moved_ops": 0,
+        }
+        assert snap["hops"]["drive-write"] == {
+            "copied_bytes": 0, "copied_ops": 0,
+            "moved_bytes": 400, "moved_ops": 1,
+        }
+
+    def test_merge_sums_elementwise(self):
+        a = {"hops": {"h": {"copied_bytes": 10, "copied_ops": 1,
+                            "moved_bytes": 0, "moved_ops": 0}}}
+        b = {"hops": {"h": {"copied_bytes": 5, "copied_ops": 2,
+                            "moved_bytes": 7, "moved_ops": 1},
+                      "g": {"copied_bytes": 1, "copied_ops": 1,
+                            "moved_bytes": 0, "moved_ops": 0}}}
+        m = CopyLedger.merge([a, b, None, {}])
+        assert m["hops"]["h"]["copied_bytes"] == 15
+        assert m["hops"]["h"]["copied_ops"] == 3
+        assert m["hops"]["h"]["moved_bytes"] == 7
+        assert m["hops"]["g"]["copied_ops"] == 1
+
+    def test_reset_clears(self):
+        cl = CopyLedger()
+        cl.record("h", COPIED, 9)
+        cl.reset()
+        assert cl.snapshot() == {"hops": {}}
+
+
+class TestCopyConservation:
+    """The ledger against a real erasure PUT+GET: every hop the ISSUE's
+    data-path walk names must see at least the object's bytes."""
+
+    SIZE = 1 << 20  # > SMALL_FILE_THRESHOLD: takes the streaming shard path
+
+    def test_put_get_hops_account_for_object_bytes(self, tmp_path):
+        from minio_tpu.storage.metered import MeteredDrive
+        from tests.harness import ErasureHarness
+
+        hz = ErasureHarness(tmp_path, n_disks=8)
+        # Production nodes wrap every drive (dist/node.py); the drive-write/
+        # drive-read hops live on that metered boundary.
+        hz.layer.disks = [MeteredDrive(d) for d in hz.layer.disks]
+        hz.layer.make_bucket("cb")
+        data = bytes(range(256)) * (self.SIZE // 256)
+
+        GLOBAL_PROFILER.copy.reset()
+        hz.layer.put_object("cb", "obj", data)
+        put_hops = GLOBAL_PROFILER.copy.snapshot()["hops"]
+        # Staging copies at least the object into erasure blocks; the shard
+        # fan-out and drive writes pass those buffers along by reference
+        # (bytes >= size because parity shards ride the same hops).
+        assert put_hops["erasure-stage"]["copied_bytes"] >= self.SIZE
+        assert put_hops["shard-fanout"]["moved_bytes"] >= self.SIZE
+        assert put_hops["drive-write"]["moved_bytes"] >= self.SIZE
+        assert put_hops["drive-write"]["moved_ops"] >= 1
+
+        GLOBAL_PROFILER.copy.reset()
+        _, got = hz.layer.get_object("cb", "obj")
+        assert got == data
+        get_hops = GLOBAL_PROFILER.copy.snapshot()["hops"]
+        # Healthy read: drive frames are fresh buffers (copied), frame
+        # parsing slices them zero-copy, and no decode happens.
+        assert get_hops["drive-read"]["copied_bytes"] >= self.SIZE
+        assert get_hops["frame-parse"]["moved_bytes"] >= self.SIZE
+        assert "decode" not in get_hops
+
+    def test_degraded_read_pays_the_decode_copy(self, tmp_path):
+        from tests.harness import ErasureHarness
+
+        hz = ErasureHarness(tmp_path, n_disks=8)
+        hz.layer.make_bucket("cb")
+        data = b"d" * self.SIZE
+        hz.layer.put_object("cb", "obj", data)
+
+        # The shard layout is a per-object permutation: with 4 parity slots
+        # on 8 drives, at least one of drives 0..4 holds a DATA row, so
+        # knocking each out in turn must trigger reconstruction at least
+        # once (pigeonhole) while parity keeps every read succeeding.
+        decoded = 0
+        for i in range(5):
+            hz.take_offline(i)
+            GLOBAL_PROFILER.copy.reset()
+            _, got = hz.layer.get_object("cb", "obj")
+            assert got == data
+            hops = GLOBAL_PROFILER.copy.snapshot()["hops"]
+            decoded += hops.get("decode", {}).get("copied_bytes", 0)
+            hz.bring_online(i)
+        assert decoded > 0, "no offline drive ever forced a decode"
+
+
+class TestMergeProfiles:
+    def _snap(self, node, stack_n, gil):
+        return {
+            "node": node,
+            "armed": True,
+            "gil_load": gil,
+            "copy": {"hops": {"socket-read": {
+                "copied_bytes": 10, "copied_ops": 1,
+                "moved_bytes": 0, "moved_ops": 0}}},
+            "windows": [{
+                "samples": stack_n,
+                "roles": {"api-executor": stack_n},
+                "stacks": {"api-executor;server.py:handle": stack_n},
+            }],
+        }
+
+    def test_stacks_sum_and_gil_stays_per_node(self):
+        m = merge_profiles([self._snap("n0", 3, 0.2), self._snap("n1", 5, 0.9)])
+        assert m["samples"] == 8
+        assert m["stacks"]["api-executor;server.py:handle"] == 8
+        assert m["roles"]["api-executor"] == 8
+        # GIL pressure is per-interpreter: merged as a dict, never summed.
+        assert m["gil_load"] == {"n0": 0.2, "n1": 0.9}
+        assert m["copy"]["hops"]["socket-read"]["copied_bytes"] == 20
+
+    def test_empty_and_missing_snaps_tolerated(self):
+        m = merge_profiles([None, {}, self._snap("a", 1, 0.0)])
+        assert m["samples"] == 1
+        assert list(m["gil_load"]) == ["a"]
+
+
+class TestProfilerSys:
+    def test_mtpu_profile_0_vetoes(self, monkeypatch):
+        monkeypatch.setenv("MTPU_PROFILE", "0")
+        ps = ProfilerSys()
+        assert ps.ensure_started() is False
+        assert ps.armed is False
+        assert ps.sampler is None
+
+    def test_lifecycle_snapshot_and_summary(self, monkeypatch):
+        monkeypatch.delenv("MTPU_PROFILE", raising=False)
+        ps = ProfilerSys()
+        try:
+            assert ps.ensure_started(interval_s=0.002, window_s=0.05,
+                                     max_windows=2) is True
+            assert ps.ensure_started() is True  # idempotent
+            assert ps.armed
+            time.sleep(0.2)
+            ps.copy.record("socket-read", COPIED, 42)
+
+            snap = ps.snapshot(top=5)
+            assert snap["profile"] == 1 and snap["armed"] is True
+            assert 0.0 <= snap["gil_load"] <= 1.0
+            assert snap["copy"]["hops"]["socket-read"]["copied_bytes"] == 42
+            assert snap["sampler"]["windows_rotated"] >= 1
+            assert snap["windows"], "no windows retained"
+            assert all(w["samples"] >= 1 for w in snap["windows"])
+
+            summ = ps.summary(top=3)
+            for k in ("armed", "gil_load", "samples", "sampler_overhead_ratio",
+                      "roles", "top_stacks", "copy"):
+                assert k in summ, k
+            assert summ["samples"] >= 1
+            assert len(summ["top_stacks"]) <= 3
+            for row in summ["top_stacks"]:
+                assert 0.0 <= row["share"] <= 1.0
+        finally:
+            ps.stop()
+        assert ps.armed is False
+        # Counters and windows survive the stop; only the threads die.
+        assert ps.summary()["samples"] >= 1
+
+    def test_snapshot_without_stacks(self, monkeypatch):
+        monkeypatch.delenv("MTPU_PROFILE", raising=False)
+        ps = ProfilerSys()
+        try:
+            ps.ensure_started(interval_s=0.002, window_s=0.05)
+            time.sleep(0.1)
+            snap = ps.snapshot(include_stacks=False)
+            assert snap["windows"]
+            assert all("stacks" not in w for w in snap["windows"])
+        finally:
+            ps.stop()
+
+
+@pytest.fixture(scope="module")
+def lg_cluster(tmp_path_factory):
+    from minio_tpu.loadgen.cluster import InProcessCluster
+
+    tmp = tmp_path_factory.mktemp("prof-cluster")
+    cluster = InProcessCluster(str(tmp), n_nodes=2, drives_per_node=4)
+    yield cluster
+    cluster.stop()
+
+
+class TestProfileEndpoint:
+    """GET /mtpu/admin/v1/profile on a real 2-node cluster: node snapshot,
+    collapsed download, summary block, and the ?cluster=1 peer merge."""
+
+    def _client(self, cluster):
+        from tests.s3client import S3TestClient
+
+        return S3TestClient(cluster.urls[0], cluster.root_user,
+                            cluster.root_password)
+
+    def _warm(self, client):
+        client.make_bucket("profb")
+        assert client.put_object(
+            "profb", "p.bin", b"z" * (256 << 10)).status_code == 200
+        assert client.get_object("profb", "p.bin").status_code == 200
+
+    def test_node_snapshot_armed_with_windows(self, lg_cluster):
+        client = self._client(lg_cluster)
+        self._warm(client)
+        deadline = time.monotonic() + 10
+        while True:
+            r = client.request("GET", "/mtpu/admin/v1/profile")
+            assert r.status_code == 200, r.text
+            doc = r.json()
+            assert doc["armed"] is True, "node build did not arm the plane"
+            if doc.get("windows") and any(w["samples"] for w in doc["windows"]):
+                break
+            assert time.monotonic() < deadline, "sampler never took a sample"
+            time.sleep(0.1)
+        assert doc["sampler"]["interval_ms"] > 0
+        assert doc["sampler"]["overhead_ratio"] < 0.2
+        # The PUT above walked the data path: its hops are in the ledger.
+        hops = doc["copy"]["hops"]
+        for hop in ("socket-read", "erasure-stage", "drive-write"):
+            assert hops.get(hop, {}).get("copied_bytes", 0) + \
+                hops.get(hop, {}).get("moved_bytes", 0) > 0, hop
+
+    def test_collapsed_download(self, lg_cluster):
+        client = self._client(lg_cluster)
+        r = client.request("GET", "/mtpu/admin/v1/profile",
+                           query=[("collapsed", "1")])
+        assert r.status_code == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        assert "profile.collapsed" in r.headers.get("Content-Disposition", "")
+        for line in r.text.strip().splitlines():
+            stack, _, count = line.rpartition(" ")
+            assert stack and count.isdigit(), line
+
+    def test_summary_block(self, lg_cluster):
+        client = self._client(lg_cluster)
+        r = client.request("GET", "/mtpu/admin/v1/profile",
+                           query=[("summary", "1")])
+        assert r.status_code == 200, r.text
+        doc = r.json()
+        for k in ("armed", "gil_load", "samples", "sampler_overhead_ratio",
+                  "roles", "top_stacks", "copy"):
+            assert k in doc, k
+
+    def test_cluster_merge(self, lg_cluster):
+        client = self._client(lg_cluster)
+        r = client.request("GET", "/mtpu/admin/v1/profile",
+                           query=[("cluster", "1")])
+        assert r.status_code == 200, r.text
+        doc = r.json()
+        assert doc["peers"], "no peers consulted"
+        assert all(p["ok"] for p in doc["peers"].values()), doc["peers"]
+        merged = doc["cluster"]
+        node_samples = sum(w["samples"] for w in doc["node"].get("windows", []))
+        assert merged["samples"] >= node_samples
+        assert isinstance(merged["gil_load"], dict) and merged["gil_load"]
+        assert merged["copy"]["hops"]
+        assert merged["stacks"]
+
+    def test_bad_top_is_invalid_argument(self, lg_cluster):
+        client = self._client(lg_cluster)
+        r = client.request("GET", "/mtpu/admin/v1/profile",
+                           query=[("top", "abc")])
+        assert r.status_code == 400
+
+    def test_profiler_series_reach_prometheus(self, lg_cluster):
+        lint_spec = importlib.util.spec_from_file_location(
+            "metrics_lint", _REPO / "tools" / "metrics_lint.py")
+        metrics_lint = importlib.util.module_from_spec(lint_spec)
+        lint_spec.loader.exec_module(metrics_lint)
+
+        client = self._client(lg_cluster)
+        r = client.request("GET", "/minio/v2/metrics/node")
+        assert r.status_code == 200
+        text = r.text
+        for series in (
+            "minio_tpu_gil_load",
+            "minio_tpu_profiler_overhead_ratio",
+            "minio_tpu_profiler_samples_window",
+            "minio_tpu_profiler_windows_rotated_total",
+            "minio_tpu_copy_bytes_total",
+            "minio_tpu_copy_ops_total",
+            "minio_tpu_stage_cpu_seconds_total",
+        ):
+            assert series in text, series
+        assert metrics_lint.validate_exposition(text) == []
+        assert metrics_lint.lint_exposition(text) == []
+
+
+class TestLoadgenProfileBlock:
+    def test_profile_true_embeds_summary_in_report(self, tmp_path):
+        from minio_tpu.loadgen import parse_scenario
+        from minio_tpu.loadgen.cluster import InProcessCluster
+        from minio_tpu.loadgen.runner import ScenarioRunner
+        from minio_tpu.loadgen.target import InProcessAdmin, S3Target
+
+        sc = parse_scenario(
+            {
+                "name": "prof_smoke",
+                "seed": 3,
+                "bucket": "lgprof",
+                "profile": True,
+                "cluster": {"nodes": 2, "drives_per_node": 4},
+                "keyspace": {"keys": 8, "prepopulate": 4, "prefix": "pf/",
+                             "zipf_theta": 0.9},
+                "sizes": {"kind": "fixed", "bytes": 2048},
+                "slo": {"GET": {"p99_ms": 30000, "error_budget": 0.25},
+                        "PUT": {"p99_ms": 30000, "error_budget": 0.25}},
+                "phases": [
+                    {"name": "mixed", "mix": {"GET": 0.5, "PUT": 0.5},
+                     "concurrency": 2, "ops": 12}
+                ],
+            }
+        )
+        assert sc.profile is True
+        cluster = InProcessCluster(str(tmp_path), n_nodes=2, drives_per_node=4)
+        try:
+            target = S3Target(cluster.urls, cluster.root_user,
+                              cluster.root_password)
+            report = ScenarioRunner(sc, target, InProcessAdmin()).run()
+        finally:
+            cluster.stop()
+
+        prof = report.get("profile")
+        assert prof, "profile: true did not embed the summary block"
+        assert prof["armed"] is True
+        for k in ("gil_load", "samples", "sampler_overhead_ratio",
+                  "roles", "top_stacks", "copy"):
+            assert k in prof, k
+        assert prof["samples"] >= 1
+        # The run's PUTs left data-path hops in the embedded copy ledger.
+        assert any(
+            row["copied_bytes"] + row["moved_bytes"] > 0
+            for row in prof["copy"].values()
+        )
+
+    def test_canonical_collapse_scenario_opts_in(self):
+        from minio_tpu.loadgen import load_scenario
+
+        sc = load_scenario(str(_REPO / "scenarios" / "concurrent_put_collapse.yaml"))
+        assert sc.profile is True, (
+            "concurrent_put_collapse must embed the profile block so the "
+            "report names its bottleneck"
+        )
+
+
+class TestProfileDiff:
+    def _write(self, tmp_path, name, text):
+        p = tmp_path / name
+        p.write_text(text)
+        return str(p)
+
+    def test_collapsed_text_round_trip_and_diff(self, tmp_path):
+        before = self._write(
+            tmp_path, "before.collapsed",
+            "api-executor;a.py:f 80\ncodec-batch;b.py:g 20\n")
+        after = self._write(
+            tmp_path, "after.collapsed",
+            "api-executor;a.py:f 40\ncodec-batch;b.py:g 60\n")
+        b = profile_diff.load_capture(before)
+        a = profile_diff.load_capture(after)
+        rows = profile_diff.diff_captures(b, a)
+        by_stack = {r["stack"]: r for r in rows}
+        assert by_stack["codec-batch;b.py:g"]["delta"] == pytest.approx(0.4)
+        assert by_stack["api-executor;a.py:f"]["delta"] == pytest.approx(-0.4)
+
+    def test_json_payloads_load(self, tmp_path):
+        node = self._write(tmp_path, "node.json", json.dumps({
+            "windows": [{"stacks": {"s1": 3}}, {"stacks": {"s1": 2, "s2": 5}}],
+        }))
+        merged = self._write(tmp_path, "cluster.json", json.dumps({
+            "stacks": {"s1": 10, "s2": 1},
+        }))
+        assert profile_diff.load_capture(node) == {"s1": 5.0, "s2": 5.0}
+        assert profile_diff.load_capture(merged) == {"s1": 10.0, "s2": 1.0}
+
+    def test_main_exit_codes_and_output(self, tmp_path, capsys):
+        before = self._write(tmp_path, "b.collapsed", "x;a:f 10\ny;b:g 10\n")
+        after = self._write(tmp_path, "a.collapsed", "x;a:f 30\ny;b:g 10\n")
+        assert profile_diff.main([before, after]) == 0
+        out = capsys.readouterr().out
+        assert "regressed (share grew):" in out
+        assert "improved (share shrank):" in out
+        assert "x;a:f" in out
+
+        assert profile_diff.main([before, after, "--json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["diff"]
+
+        assert profile_diff.main([before, str(tmp_path / "missing")]) == 2
+        assert "profile_diff:" in capsys.readouterr().err
+
+    def test_bad_capture_is_a_typed_failure(self, tmp_path):
+        bad = self._write(tmp_path, "bad.json", json.dumps({"not": "profile"}))
+        with pytest.raises(ValueError):
+            profile_diff.load_capture(bad)

@@ -45,6 +45,10 @@ def main(argv: list[str]) -> int:
     ap.add_argument("--drives", type=int, default=0,
                     help="override spec cluster.drives_per_node")
     ap.add_argument("--seed", type=int, default=None, help="override spec seed")
+    ap.add_argument("--profile", action="store_true",
+                    help="arm the continuous profiling plane and embed its "
+                         "summary (gil_load, role stacks, copy ledger) in "
+                         "the report (same as `profile: true` in the spec)")
     ap.add_argument("--out", default="", help="write pretty report JSON here")
     ap.add_argument("--metrics-out", default="",
                     help="write Prometheus exposition of the report here")
@@ -74,6 +78,8 @@ def main(argv: list[str]) -> int:
         scenario.nodes = args.nodes
     if args.drives:
         scenario.drives_per_node = args.drives
+    if args.profile:
+        scenario.profile = True
 
     cluster = None
     workdir = ""

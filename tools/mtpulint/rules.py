@@ -29,6 +29,7 @@ TRANSPORT = "minio_tpu/dist/transport.py"
 PERF = "minio_tpu/control/perf.py"
 METRICS = "minio_tpu/control/metrics.py"
 DEGRADE = "minio_tpu/control/degrade.py"
+PROFILER = "minio_tpu/control/profiler.py"
 
 
 def _call_name(node: ast.Call) -> str:
@@ -539,15 +540,15 @@ class MetricsRenderedRule(Rule):
 
     A counter nobody exports is a measurement nobody sees: the increment
     costs a lock on the hot path and buys zero observability. Every public
-    `self.<name> += ...` / keyed-dict bump in DegradeStats and
-    SlowRequestCapture must appear (as a string key or attribute) in the
-    exposition renderer."""
+    `self.<name> += ...` / keyed-dict bump in DegradeStats,
+    SlowRequestCapture, and the profiling plane's CopyLedger must appear
+    (as a string key or attribute) in the exposition renderer."""
 
     id = "metrics-rendered"
     title = "counter incremented but never rendered in control/metrics.py"
-    scope = (DEGRADE, PERF)
+    scope = (DEGRADE, PERF, PROFILER)
 
-    _COUNTER_CLASSES = {"DegradeStats", "SlowRequestCapture"}
+    _COUNTER_CLASSES = {"DegradeStats", "SlowRequestCapture", "CopyLedger"}
 
     def _counters(self, ctx) -> list[tuple[str, int]]:
         out: list[tuple[str, int]] = []

@@ -1,0 +1,145 @@
+#!/usr/bin/env python3
+"""Diff two continuous-profiling captures: which frames got hotter?
+
+Usage:
+    python tools/profile_diff.py BEFORE AFTER [--top N] [--min-delta PCT]
+
+BEFORE/AFTER are either flamegraph collapsed-stack text files (the
+`/mtpu/admin/v1/profile?collapsed=1` download: one "role;file:fn;... count"
+line per stack) or `/profile` JSON payloads (a node snapshot with
+"windows", or a ?cluster=1 merge with a flat "stacks" map).
+
+Counts are normalized to per-capture SHARES before diffing -- two captures
+rarely cover the same wall time, so raw sample deltas would just measure
+capture length. Output: the top regressed (share grew) and improved (share
+shrank) stacks, with before/after shares side by side.
+
+Exit 0 always (it's a lens, not a gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _shares(counts: dict[str, float]) -> dict[str, float]:
+    total = sum(counts.values())
+    if total <= 0:
+        return {}
+    return {k: v / total for k, v in counts.items()}
+
+
+def _from_json(doc) -> dict[str, float] | None:
+    """Stack counts from a /profile payload, or None if it isn't one."""
+    if not isinstance(doc, dict):
+        return None
+    counts: dict[str, float] = {}
+    if isinstance(doc.get("stacks"), dict):  # ?cluster=1 merge / summary-ish
+        for k, v in doc["stacks"].items():
+            counts[str(k)] = counts.get(str(k), 0.0) + float(v)
+        return counts
+    if isinstance(doc.get("windows"), list):  # node snapshot
+        for w in doc["windows"]:
+            for k, v in (w.get("stacks") or {}).items():
+                counts[str(k)] = counts.get(str(k), 0.0) + float(v)
+        return counts
+    return None
+
+
+def load_capture(path: str) -> dict[str, float]:
+    """Collapsed-stack text OR /profile JSON -> {stack: samples}."""
+    with open(path) as f:
+        raw = f.read()
+    stripped = raw.lstrip()
+    if stripped.startswith("{"):
+        counts = _from_json(json.loads(stripped))
+        if counts is None:
+            raise ValueError(f"{path}: JSON but not a /profile payload")
+        return counts
+    counts = {}
+    for ln, line in enumerate(raw.splitlines(), 1):
+        line = line.strip()
+        if not line:
+            continue
+        stack, _, n = line.rpartition(" ")
+        if not stack:
+            raise ValueError(f"{path}:{ln}: not a 'stack count' line: {line!r}")
+        try:
+            counts[stack] = counts.get(stack, 0.0) + float(n)
+        except ValueError:
+            raise ValueError(f"{path}:{ln}: bad count {n!r}")
+    return counts
+
+
+def diff_captures(
+    before: dict[str, float], after: dict[str, float], min_delta: float = 0.005
+) -> list[dict]:
+    """Per-stack share deltas, biggest absolute movement first."""
+    sa, sb = _shares(before), _shares(after)
+    rows = []
+    for stack in set(sa) | set(sb):
+        b, a = sa.get(stack, 0.0), sb.get(stack, 0.0)
+        d = a - b
+        if abs(d) < min_delta:
+            continue
+        rows.append(
+            {
+                "stack": stack,
+                "before_share": round(b, 4),
+                "after_share": round(a, 4),
+                "delta": round(d, 4),
+            }
+        )
+    rows.sort(key=lambda r: -abs(r["delta"]))
+    return rows
+
+
+def _fmt(rows: list[dict], top: int, sign: int) -> list[str]:
+    out = []
+    picked = [r for r in rows if (r["delta"] > 0) == (sign > 0)][:top]
+    for r in picked:
+        out.append(
+            f"  {r['delta']:+7.2%}  {r['before_share']:6.2%} -> "
+            f"{r['after_share']:6.2%}  {r['stack']}"
+        )
+    return out or ["  (none)"]
+
+
+def main(argv: list[str]) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("before", help="collapsed-stack text or /profile JSON")
+    ap.add_argument("after", help="collapsed-stack text or /profile JSON")
+    ap.add_argument("--top", type=int, default=10, help="rows per direction")
+    ap.add_argument(
+        "--min-delta", type=float, default=0.005,
+        help="ignore stacks whose share moved less than this fraction",
+    )
+    ap.add_argument("--json", action="store_true", help="emit the diff as JSON")
+    args = ap.parse_args(argv)
+
+    try:
+        before = load_capture(args.before)
+        after = load_capture(args.after)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"profile_diff: {e}", file=sys.stderr)
+        return 2
+
+    rows = diff_captures(before, after, min_delta=args.min_delta)
+    if args.json:
+        print(json.dumps({"diff": rows[: 2 * args.top]}, sort_keys=True))
+        return 0
+    print(
+        f"profile_diff: {len(before)} stacks before, {len(after)} after, "
+        f"{len(rows)} moved >= {args.min_delta:.1%}"
+    )
+    print("regressed (share grew):")
+    print("\n".join(_fmt(rows, args.top, +1)))
+    print("improved (share shrank):")
+    print("\n".join(_fmt(rows, args.top, -1)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
