@@ -1,0 +1,178 @@
+"""Pre-fork front end: N accept processes sharing one port (SO_REUSEPORT).
+
+The GIL bounds a single CPython process to ~1 core of pure-Python work no
+matter how many I/O worker threads the data plane runs. The zero-copy PUT
+pipeline moves the hot loops into buffer-protocol C calls (readinto,
+writev, the native codec) that RELEASE the GIL, but request parsing,
+signing, and metadata work still serialize. The classic escape is nginx's:
+fork N workers before any runtime state exists, each binding the same
+address with SO_REUSEPORT so the kernel load-balances accepted connections
+across processes -- no shared accept lock, no proxy hop.
+
+Opt-in and gated:
+
+  * ``MTPU_WORKERS=N`` (N > 1) turns the model on; unset keeps the
+    single-process server exactly as before.
+  * :func:`plan_workers` probes the platform first -- no ``fork()``, no
+    ``SO_REUSEPORT``, or a free-threaded interpreter (``python -X gil=0``,
+    where in-process pools already scale past one core and forking would
+    only multiply memory) all fall back to one process, with the reason
+    logged rather than silently ignored.
+
+Failure semantics (docs/RELIABILITY.md "Worker death"): each worker owns
+only sockets and in-flight request state. A crashed worker resets its open
+connections -- clients see ECONNRESET and retry per normal S3 client
+behavior -- but never loses committed data: PUTs stage to per-drive tmp
+files and commit by atomic rename, so a worker dying mid-PUT leaves only
+garbage tmp state that the next scanner pass sweeps. The master respawns
+crashed workers up to a budget (``MTPU_WORKER_RESPAWNS`` per worker slot,
+default 2) and exits once every worker has exited after a signal.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import signal
+import socket
+import sys
+import time
+
+__all__ = ["gil_enabled", "plan_workers", "run_master", "WORKER_ENV", "WORKER_ID_ENV"]
+
+# Children carry these so the serve() entry point knows not to re-fork and
+# the logs can name the worker.
+WORKER_ENV = "MTPU_PREFORK_CHILD"
+WORKER_ID_ENV = "MTPU_WORKER_ID"
+
+_DEFAULT_RESPAWNS = 2
+
+
+def gil_enabled() -> bool:
+    """True when this interpreter serializes Python bytecode on a GIL.
+
+    Free-threaded CPython (3.13+, ``--disable-gil`` builds) exposes
+    ``sys._is_gil_enabled``; anything older is by definition GIL-bound."""
+    probe = getattr(sys, "_is_gil_enabled", None)
+    if probe is None:
+        return True
+    try:
+        return bool(probe())
+    except Exception:  # pragma: no cover - defensive: probe is CPython-private
+        return True
+
+
+def plan_workers(env: dict | None = None) -> tuple[int, str]:
+    """Resolve MTPU_WORKERS against the platform gates.
+
+    Returns ``(n, reason)``: n == 1 means serve in-process (reason says
+    why); n > 1 means pre-fork that many accept workers."""
+    env = os.environ if env is None else env
+    raw = str(env.get("MTPU_WORKERS", "") or "").strip()
+    if not raw:
+        return 1, "MTPU_WORKERS unset"
+    try:
+        n = int(raw)
+    except ValueError:
+        return 1, f"MTPU_WORKERS={raw!r} is not an integer; serving single-process"
+    if n <= 1:
+        return 1, f"MTPU_WORKERS={n} <= 1"
+    if env.get(WORKER_ENV):
+        # Already inside a worker: never fork recursively.
+        return 1, "pre-fork worker child"
+    if not hasattr(os, "fork"):
+        return 1, "platform has no fork(); serving single-process"
+    if not hasattr(socket, "SO_REUSEPORT"):
+        return 1, "platform has no SO_REUSEPORT; serving single-process"
+    if not gil_enabled():
+        return 1, (
+            "free-threaded interpreter detected: in-process worker pools "
+            "already scale past one core; serving single-process"
+        )
+    return n, f"pre-forking {n} accept workers (SO_REUSEPORT)"
+
+
+def _spawn(worker_id: int, child_main) -> int:
+    """Fork one worker; the child runs child_main(worker_id) and _exits."""
+    pid = os.fork()
+    if pid == 0:
+        # Child: mark the environment so serve() won't re-fork, restore
+        # default signal dispositions (the child installs its own), run.
+        os.environ[WORKER_ENV] = "1"
+        os.environ[WORKER_ID_ENV] = str(worker_id)
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        signal.signal(signal.SIGINT, signal.SIG_DFL)
+        code = 1
+        try:
+            code = int(child_main(worker_id) or 0)
+        except SystemExit as e:
+            code = int(e.code or 0) if not isinstance(e.code, str) else 1
+        except BaseException as e:  # noqa: BLE001 - the child must not unwind into the master's stack
+            print(f"worker[{worker_id}] crashed: {e!r}", file=sys.stderr)
+        finally:
+            os._exit(code)
+    return pid
+
+
+def run_master(n: int, child_main, log=None) -> int:
+    """Fork n workers running ``child_main(worker_id)`` and babysit them.
+
+    The master holds no runtime state -- it forks BEFORE drives, codec, or
+    event loops exist, so each worker builds its own stack and binds the
+    shared port with SO_REUSEPORT. SIGTERM/SIGINT fan out to the workers;
+    a worker that dies without a signal is respawned up to
+    MTPU_WORKER_RESPAWNS times (default 2) per slot."""
+    log = log or (lambda msg: print(msg, file=sys.stderr))
+    budget = int(os.environ.get("MTPU_WORKER_RESPAWNS", str(_DEFAULT_RESPAWNS)))
+    pids: dict[int, int] = {}  # pid -> worker_id
+    respawns = dict.fromkeys(range(n), 0)
+    stopping = False
+
+    def _forward(signum, frame):
+        nonlocal stopping
+        stopping = True
+        for pid in list(pids):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+
+    signal.signal(signal.SIGTERM, _forward)
+    signal.signal(signal.SIGINT, _forward)
+
+    for i in range(n):
+        pids[_spawn(i, child_main)] = i
+    log(f"prefork master {os.getpid()}: {n} workers {sorted(pids)}")
+
+    worst = 0
+    while pids:
+        try:
+            pid, status = os.wait()
+        except OSError as e:
+            if e.errno == errno.EINTR:
+                continue
+            if e.errno == errno.ECHILD:
+                break
+            raise
+        except KeyboardInterrupt:
+            _forward(signal.SIGINT, None)
+            continue
+        wid = pids.pop(pid, None)
+        if wid is None:  # not ours (pre-fork inherits no other children)
+            continue
+        code = os.waitstatus_to_exitcode(status)
+        worst = max(worst, abs(code))
+        if stopping:
+            continue
+        if respawns[wid] < budget:
+            respawns[wid] += 1
+            log(
+                f"worker[{wid}] exited {code}; respawn "
+                f"{respawns[wid]}/{budget} (connections on it were reset; "
+                "committed objects are unaffected)"
+            )
+            time.sleep(0.2)  # crash-loop brake
+            pids[_spawn(wid, child_main)] = wid
+        else:
+            log(f"worker[{wid}] exited {code}; respawn budget spent")
+    return 0 if stopping else min(worst, 125)

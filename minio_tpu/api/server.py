@@ -117,25 +117,53 @@ def _read_all(reader, chunk: int = 1 << 20) -> bytes:
 
 
 class _RequestBodyReader:
-    """Sync .read(n) over an aiohttp request body.
+    """Sync .read(n) / .readinto(buf) over an aiohttp request body.
 
-    The object layer streams from a worker thread; each read hops to the
+    The object layer streams from a worker thread; each refill hops to the
     event loop for the next body chunk (readahead pipelining: the socket
-    fills while the previous block encodes)."""
+    fills while the previous block encodes). ``readany()`` hands back
+    aiohttp's buffered chunk as-is -- ``content.read(n)`` would re-slice
+    and re-join it -- and ``readinto`` lands it straight into the caller's
+    pooled buffer: one landing, no intermediate bytes staging (the
+    recv_into fix for the socket-read double copy)."""
 
     def __init__(self, request: web.Request, loop: asyncio.AbstractEventLoop):
         self._content = request.content
         self._loop = loop
+        self._chunk: bytes = b""
+        self._pos = 0
+
+    def _refill(self) -> bool:
+        fut = asyncio.run_coroutine_threadsafe(self._content.readany(), self._loop)
+        self._chunk = fut.result(timeout=600)
+        self._pos = 0
+        return bool(self._chunk)
 
     def read(self, n: int) -> bytes:
         if n <= 0:
             return b""
-        fut = asyncio.run_coroutine_threadsafe(self._content.read(n), self._loop)
-        data = fut.result(timeout=600)
-        # Copy-ledger hop: the event loop materializes each body chunk out
-        # of the socket buffer into a fresh bytes object.
+        if self._pos >= len(self._chunk) and not self._refill():
+            return b""
+        take = min(n, len(self._chunk) - self._pos)
+        data = self._chunk[self._pos : self._pos + take]
+        self._pos += take
+        # Copy-ledger hop: slicing materializes a fresh bytes object.
         GLOBAL_PROFILER.copy.record("socket-read", COPIED, len(data))
         return data
+
+    def readinto(self, dest) -> int:
+        """Land the next body bytes directly into `dest`; 0 at EOF."""
+        if len(dest) == 0:
+            return 0
+        if self._pos >= len(self._chunk) and not self._refill():
+            return 0
+        take = min(len(dest), len(self._chunk) - self._pos)
+        dest[:take] = self._chunk[self._pos : self._pos + take]
+        self._pos += take
+        # Copy-ledger hop: the socket chunk lands once in the caller's
+        # (pooled) buffer and is passed along as views from here on.
+        GLOBAL_PROFILER.copy.record("socket-read", MOVED, take)
+        return take
 
 
 class _HashVerifyReader:
@@ -155,25 +183,56 @@ class _HashVerifyReader:
         self._n = 0
         self._checked = False
 
+    def _consumed(self, nbytes: int, view=None) -> None:
+        self._n += nbytes
+        if self._n > self._limit:
+            raise S3Error("EntityTooLarge")
+        if self._sha is not None:
+            self._sha.update(view)
+        if self._md5 is not None:
+            self._md5.update(view)
+
+    def _at_eof(self) -> None:
+        if self._checked:
+            return
+        self._checked = True
+        if self._sha is not None and self._sha.hexdigest() != self._want_sha:
+            raise S3Error("XAmzContentSHA256Mismatch")
+        if self._md5 is not None:
+            want = base64.b64decode(self._want_md5)
+            if self._md5.digest() != want:
+                raise S3Error("BadDigest")
+
     def read(self, n: int) -> bytes:
         chunk = self._r.read(n)
         if chunk:
-            self._n += len(chunk)
-            if self._n > self._limit:
-                raise S3Error("EntityTooLarge")
-            if self._sha is not None:
-                self._sha.update(chunk)
-            if self._md5 is not None:
-                self._md5.update(chunk)
-        elif not self._checked:
-            self._checked = True
-            if self._sha is not None and self._sha.hexdigest() != self._want_sha:
-                raise S3Error("XAmzContentSHA256Mismatch")
-            if self._md5 is not None:
-                want = base64.b64decode(self._want_md5)
-                if self._md5.digest() != want:
-                    raise S3Error("BadDigest")
+            self._consumed(len(chunk), chunk)
+        else:
+            self._at_eof()
         return chunk
+
+    def readinto(self, dest) -> int:
+        """Zero-copy pass-through: delegate landing to the inner reader and
+        hash the landed view in place."""
+        ri = getattr(self._r, "readinto", None)
+        if ri is not None:
+            got = ri(dest)
+        else:
+            b = self._r.read(len(dest))
+            got = len(b)
+            dest[:got] = b
+        if got:
+            self._consumed(got, dest[:got])
+        else:
+            self._at_eof()
+        return got
+
+    def md5_hexdigest(self) -> str | None:
+        """Hex MD5 of the verified body (valid after EOF): lets the PUT
+        path keep a true-MD5 ETag when the client declared Content-Md5."""
+        if self._md5 is None or not self._checked:
+            return None
+        return self._md5.hexdigest()
 
 
 class _StreamPlan:
@@ -611,6 +670,40 @@ class S3Server:
                     return
         raise S3Error("AccessDenied", resource=f"/{bucket}/{key}")
 
+    @staticmethod
+    async def _read_buffered_body(request: web.Request) -> bytes | bytearray:
+        """Buffered body for non-streaming handlers, landed once.
+
+        When Content-Length is declared, socket chunks land straight into
+        one exact-size buffer (the readinto analogue of request.read(),
+        which stages every chunk and then joins them -- the duplicate copy
+        this replaces). Unknown lengths keep the join fallback."""
+        clen = request.content_length
+        if clen is None or clen > MAX_OBJECT_SIZE + (1 << 20):
+            body = await request.read()
+            # Copy-ledger hop: chunk staging + join materializes the body.
+            GLOBAL_PROFILER.copy.record("socket-read", COPIED, len(body))
+            return body
+        if clen == 0:
+            return b""
+        buf = bytearray(clen)
+        view = memoryview(buf)
+        pos = 0
+        content = request.content
+        while pos < clen:
+            chunk = await content.readany()
+            if not chunk:
+                break
+            take = min(len(chunk), clen - pos)
+            view[pos : pos + take] = chunk[:take]
+            pos += take
+        if pos < clen:
+            del buf[pos:]
+        # Copy-ledger hop: one landing into the right-sized buffer; handlers
+        # consume the bytearray in place.
+        GLOBAL_PROFILER.copy.record("socket-read", MOVED, pos)
+        return buf
+
     async def _dispatch(self, request: web.Request, request_id: str) -> web.Response:
         if (
             request.method == "OPTIONS"
@@ -660,10 +753,7 @@ class S3Server:
         ):
             return await self._streaming_put_entry(request, bucket, key)
         with tracing.span("body-read", "api"):
-            body = await request.read()
-        # Same hop as _RequestBodyReader, buffered flavor: the whole body
-        # materializes at once for non-streaming handlers.
-        GLOBAL_PROFILER.copy.record("socket-read", COPIED, len(body))
+            body = await self._read_buffered_body(request)
         # POST policy form uploads authenticate via the policy signature in
         # the form, not request headers (PostPolicyBucketHandler equivalent).
         ctype = request.headers.get("Content-Type", "")
@@ -1889,9 +1979,9 @@ class S3Server:
         # (streaming readers were quota-checked at dispatch with the decoded
         # content length, _streaming_put_entry)
         opts = self._put_opts(bucket, request, key)
-        body: bytes | None = None
+        body: bytes | bytearray | None = None
         if isinstance(data, (bytes, bytearray)):
-            body = bytes(data)
+            body = data  # consumed in place -- no defensive copy of the payload
             if len(body) > MAX_OBJECT_SIZE:
                 raise S3Error("EntityTooLarge")
             if "Content-Md5" in request.headers:
@@ -1907,6 +1997,15 @@ class S3Server:
             payload = self._transform_put(bucket, key, body, request, opts)
             oi = self.layer.put_object(bucket, key, payload, opts)
         else:
+            # A declared Content-MD5 pins the etag up front (the reader
+            # verifies the digest at EOF and aborts the PUT on mismatch);
+            # otherwise the erasure layer's streaming etag applies.
+            want_md5 = request.headers.get("Content-Md5", "")
+            if want_md5 and not opts.etag:
+                try:
+                    opts.etag = base64.b64decode(want_md5).hex()
+                except (ValueError, TypeError):
+                    raise S3Error("InvalidDigest")
             oi = self.layer.put_object(bucket, key, data, opts)
         headers = {"ETag": f'"{oi.etag}"'}
         headers.update(self._sse_response_headers(oi))

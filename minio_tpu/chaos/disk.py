@@ -33,7 +33,7 @@ from . import faults as faults_mod
 # Same seam as the metered set: every StorageAPI method that hits the disk.
 _FAULTABLE = _METERED
 
-_BITROT_WRITE_OPS = frozenset({"create_file", "append_file", "write_all"})
+_BITROT_WRITE_OPS = frozenset({"create_file", "append_file", "append_iov", "write_all"})
 _BITROT_READ_OPS = frozenset({"read_file", "read_all"})
 
 _DEFAULT_HANG_MS = 100.0
@@ -109,7 +109,10 @@ class FaultyDisk:
         elif kind == faults_mod.DRIVE_ERROR:
             raise errors.FaultyDisk(f"chaos: injected I/O error on {ep}.{op}")
         elif kind == faults_mod.BITROT:
-            if op in _BITROT_WRITE_OPS and len(args) > 2 and isinstance(
+            if op == "append_iov" and len(args) > 2 and isinstance(args[2], list):
+                # Gathered write: corrupt the joined payload, keep the shape.
+                args = (args[0], args[1], [flip_byte(b"".join(bytes(v) for v in args[2]))])
+            elif op in _BITROT_WRITE_OPS and len(args) > 2 and isinstance(
                 args[2], (bytes, bytearray, memoryview)
             ):
                 args = (args[0], args[1], flip_byte(bytes(args[2]))) + args[3:]

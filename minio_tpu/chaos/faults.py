@@ -57,7 +57,7 @@ _LOCK_PATH_MARKER = "/mtpu/lock/"
 # were computed before the wrapper sees the bytes), so the corruption is
 # at-rest and every later read fails HighwayHash verify until heal rewrites
 # the shard. Arm with explicit ops=("read_file",) for read-side flips.
-_DEFAULT_OPS = {BITROT: ("create_file", "append_file")}
+_DEFAULT_OPS = {BITROT: ("create_file", "append_file", "append_iov")}
 
 
 @dataclass

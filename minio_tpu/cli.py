@@ -21,6 +21,8 @@ serverHandleEnvVars):
     MINIO_STORAGE_CLASS_STANDARD=EC:4          parity drive count
     MINIO_REGION                               cluster region
     MINIO_KMS_SECRET_KEY                       static KMS master key
+    MTPU_WORKERS=N                             pre-fork N accept workers
+                                               (SO_REUSEPORT; api/prefork.py)
 """
 
 from __future__ import annotations
@@ -146,6 +148,18 @@ def serve(argv: list[str]) -> int:
     rrs = os.environ.get("MINIO_STORAGE_CLASS_RRS", "")
     if rrs.startswith("EC:"):
         rrs_parity = int(rrs[3:])
+
+    # Opt-in pre-fork accept workers (MTPU_WORKERS=N): fork NOW, before any
+    # runtime state exists (threads, codec, event loops -- forking after
+    # those is undefined behavior), and let each worker run this same body
+    # single-process, binding the shared port with SO_REUSEPORT. Gated on
+    # the platform probes in plan_workers (fork, SO_REUSEPORT, a real GIL).
+    from .api import prefork
+
+    n_workers, why = prefork.plan_workers()
+    if n_workers > 1:
+        _log(a.quiet, a.json, msg="prefork", workers=n_workers, detail=why)
+        return prefork.run_master(n_workers, lambda _wid: serve(argv))
 
     if not a.no_selftest:
         t0 = time.perf_counter()
@@ -306,7 +320,14 @@ def _run_app_until(app, host, port, stop_evt):
         runner = web.AppRunner(app)
         try:
             loop.run_until_complete(runner.setup())
-            site = web.TCPSite(runner, host, port)
+            # Pre-fork workers share the port: SO_REUSEPORT lets the kernel
+            # load-balance accepts across the sibling processes.
+            from .api.prefork import WORKER_ENV
+
+            site = web.TCPSite(
+                runner, host, port,
+                reuse_port=bool(os.environ.get(WORKER_ENV)) or None,
+            )
             loop.run_until_complete(site.start())
         except BaseException as e:  # noqa: BLE001 - surfaced to the main thread
             thread_error.append(e)

@@ -86,6 +86,7 @@ STAGES: frozenset = frozenset({
     # object/codec.py + parallel/batching.py codec spans
     ("erasure", "erasure.encode"),
     ("erasure", "erasure.encode_frames"),
+    ("erasure", "erasure.encode_group"),
     ("erasure", "erasure.reconstruct"),
     # parallel/batching.py worker-side direct ledger records
     ("codec", "encode-batch"),

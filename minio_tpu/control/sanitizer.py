@@ -69,6 +69,11 @@ LOCK_ORDER: tuple[str, ...] = (
     "IAMSys._lock",            # ... wraps the IAM state lock
     "BatchingDeviceCodec._lock",       # worker/pipeline management ...
     "BatchingDeviceCodec._stats_lock", # ... may publish stats inside
+    # Data-plane pool locks are LEAVES: they guard queue/free-list
+    # bookkeeping only (never I/O, never another lock). Any lock may wrap
+    # them; they wrap nothing.
+    "LanePool._lock",          # drive-I/O lane queues (utils/iopool.py)
+    "BufferPool._lock",        # window free list + refcounts (utils/bufpool.py)
 )
 
 _HOLD_MS_DEFAULT = 200.0
@@ -105,6 +110,14 @@ SUPPRESSIONS: tuple[tuple[str, str, str], ...] = (
      "singleton; GLOBAL_PROFILER.stop() is the teardown hook"),
     ("leaked-thread", "asyncio_",
      "asyncio default executor worker owned by the event loop"),
+    ("leaked-thread", "drive-io",
+     "process-wide drive I/O worker pools (object/metadata.py _POOL "
+     "'drive-io' and utils/iopool.py 'drive-io-lane'): singletons shared by "
+     "every PUT's shard fan-out, alive for the process by design"),
+    ("leaked-thread", "put-stager",
+     "PUT readahead stage (object/erasure.py _ReadaheadWindows): joined by "
+     "windows.close() on every exit path; a straggler here is one bounded "
+     "fill finishing, not an unjoined loop"),
     ("lock-held-long", "IAMSys._mutate_lock",
      "IAM mutations serialize the whole refresh->apply->persist cycle "
      "(including cluster IAM lock RPCs and store writes) under one barrier "

@@ -112,12 +112,21 @@ def evaluate_slo(scenario: Scenario, merged_ops: dict) -> dict:
     return out
 
 
-def _evaluate_compare(scenario: Scenario, phases: dict) -> dict | None:
-    """Cross-phase ratio check (e.g. single-stream vs concurrent PUT
-    throughput -- the collapse repro)."""
+def _evaluate_compare(
+    scenario: Scenario, phases: dict
+) -> dict | list | None:
+    """Cross-phase ratio check(s): the historical single block (dict in,
+    dict out) or a sweep (list in, list out -- e.g. put_scaling's one
+    ratio per concurrency rung)."""
     cmp = scenario.compare
     if not cmp:
         return None
+    if isinstance(cmp, list):
+        return [_evaluate_one(c, phases) for c in cmp]
+    return _evaluate_one(cmp, phases)
+
+
+def _evaluate_one(cmp: dict, phases: dict) -> dict:
     op = str(cmp.get("op", "PUT")).upper()
     metric = str(cmp.get("metric", "bytes_per_s"))
     min_ratio = float(cmp.get("min_ratio", 1.0))

@@ -223,7 +223,7 @@ def parse_scenario(doc: dict) -> Scenario:
         zipf_theta=float(_number(ks, "$.keyspace", "zipf_theta", default=0.99, minimum=0)),
         sizes=_parse_sizes(_require(doc, "$", "sizes", dict, default={"kind": "fixed", "bytes": 4096}), "$.sizes"),
         slo=_parse_slo(doc.get("slo"), "$.slo"),
-        compare=_require(doc, "$", "compare", dict, default=None),
+        compare=_require(doc, "$", "compare", (dict, list), default=None),
         profile=bool(_require(doc, "$", "profile", bool, default=False)),
     )
     mp = _require(doc, "$", "multipart", dict, default={})
@@ -242,11 +242,21 @@ def parse_scenario(doc: dict) -> Scenario:
     if len(set(names)) != len(names):
         raise SpecError("$.phases", f"duplicate phase names: {names}")
     if sc.compare is not None:
-        for k in ("a", "b"):
-            pn = _require(sc.compare, "$.compare", k, str, required=True)
-            if pn not in names:
-                raise SpecError(f"$.compare.{k}", f"unknown phase {pn!r}")
-        _number(sc.compare, "$.compare", "min_ratio", default=1.0, minimum=0)
+        # One block (dict, the historical shape) or a list of blocks (e.g.
+        # a concurrency sweep asserting one ratio per rung).
+        is_list = isinstance(sc.compare, list)
+        blocks = sc.compare if is_list else [sc.compare]
+        if not blocks:
+            raise SpecError("$.compare", "must not be empty")
+        for bi, blk in enumerate(blocks):
+            loc = f"$.compare[{bi}]" if is_list else "$.compare"
+            if not isinstance(blk, dict):
+                raise SpecError(loc, "compare entry must be an object")
+            for k in ("a", "b"):
+                pn = _require(blk, loc, k, str, required=True)
+                if pn not in names:
+                    raise SpecError(f"{loc}.{k}", f"unknown phase {pn!r}")
+            _number(blk, loc, "min_ratio", default=1.0, minimum=0)
     return sc
 
 

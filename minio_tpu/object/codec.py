@@ -96,6 +96,47 @@ class BlockCodec(abc.ABC):
             rows.append(b"".join(parts))
         return rows
 
+    def encode_group(self, blocks: list, k: int, m: int) -> "EncodedGroup":
+        """Scatter form of encode_frames: per-row IOVEC LISTS instead of
+        joined row images, so the fan-out hands each drive its whole group
+        as views (one os.writev) and never materializes row bytes. The
+        concatenation of a row's iovecs is byte-identical to
+        encode_frames()[row]. Also carries the data-row digest stream the
+        fast etag hashes (block-major, rows 0..k-1)."""
+        encoded = self.encode(blocks, k, m)
+        iovecs: list[list] = []
+        for row in range(k + m):
+            vecs: list = []
+            for chunks, digests in encoded:
+                vecs.append(digests[row])
+                vecs.append(chunks[row])
+            iovecs.append(vecs)
+        digest_stream = b"".join(
+            digests[row] for chunks, digests in encoded for row in range(k)
+        )
+        return EncodedGroup(iovecs, digest_stream)
+
+
+class EncodedGroup:
+    """One encoded window, scatter layout.
+
+    iovecs[row] is the buffer sequence whose concatenation is that drive's
+    staged-file frame image for the group (digest||chunk per block). The
+    views alias storage allocated per call and kept alive by the iovecs
+    themselves, never the caller's input window -- so the PUT pipeline can
+    recycle its pooled read buffer and encode group g+1 while group g's
+    writes are still in flight. digest_stream is the concatenated data-row
+    digests feeding the streaming etag."""
+
+    __slots__ = ("iovecs", "digest_stream")
+
+    def __init__(self, iovecs: list[list], digest_stream: bytes):
+        self.iovecs = iovecs
+        self.digest_stream = digest_stream
+
+    def row_nbytes(self, row: int) -> int:
+        return sum(len(v) for v in self.iovecs[row])
+
 
 def _split_block(block: bytes, k: int) -> np.ndarray:
     return rs_matrix.split(np.frombuffer(block, dtype=np.uint8), k)
@@ -166,6 +207,43 @@ class HostCodec(BlockCodec):
                 flat[len(block):] = 0  # zero-pad the tail shard (Split semantics)
                 self._native.rs_encode(stacked[i, :k], pm, out=stacked[i, k:])
             return self._native.hh256_frame_rows(stacked, hh.MAGIC_KEY)
+
+    def encode_group(self, blocks, k, m):
+        """Native scatter path: one [G, K+M, S] buffer takes split + parity
+        (rs_encode `out` views), ONE batched hash call digests every shard
+        chunk ([G*(K+M), S] view -- ~6x cheaper than the per-row interleave
+        in hh256_frame_rows, which also copies every chunk into joined row
+        images), and the iovecs are views over that buffer: nothing is
+        rejoined. Irregular groups (mixed sizes / no native kernels) fall
+        back to the encode()-based default."""
+        if (
+            self._native is None
+            or not blocks
+            or len({len(b) for b in blocks}) != 1
+            or len(blocks[0]) == 0
+        ):
+            return super().encode_group(blocks, k, m)
+        with tracing.span(
+            "erasure.encode_group", "erasure", blocks=len(blocks), k=k, m=m, host=True
+        ):
+            pm = np.ascontiguousarray(rs_matrix.parity_matrix(k, m))
+            g = len(blocks)
+            t = k + m
+            s = rs_matrix.shard_size(len(blocks[0]), k)
+            stacked = np.empty((g, t, s), dtype=np.uint8)
+            for i, block in enumerate(blocks):
+                flat = stacked[i, :k].reshape(-1)
+                flat[: len(block)] = np.frombuffer(block, dtype=np.uint8)
+                flat[len(block):] = 0
+                self._native.rs_encode(stacked[i, :k], pm, out=stacked[i, k:])
+            digests = self._native.hh256_batch(
+                stacked.reshape(g * t, s), hh.MAGIC_KEY
+            ).reshape(g, t, 32)
+            iovecs = [
+                [v for i in range(g) for v in (memoryview(digests[i, row]), memoryview(stacked[i, row]))]
+                for row in range(t)
+            ]
+            return EncodedGroup(iovecs, digests[:, :k, :].tobytes())
 
     def reconstruct(self, shards, k, m, want):
         arrs: list[np.ndarray | None] = [

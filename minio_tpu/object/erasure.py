@@ -21,9 +21,11 @@ import concurrent.futures as _cf
 import hashlib
 import io
 import os
+import queue as _queue
 import threading
 import time
 import uuid
+from collections import deque
 from typing import Iterator
 
 from ..control import tracing
@@ -35,6 +37,8 @@ from ..storage.interface import StorageAPI
 from ..storage.types import ErasureInfo, FileInfo, ObjectPartInfo, now
 from ..storage.xlmeta import SMALL_FILE_THRESHOLD
 from ..utils import errors
+from ..utils import bufpool
+from ..utils import iopool
 from ..utils.hashes import hash_order
 from . import codec as codec_mod
 from . import metadata as meta_mod
@@ -90,46 +94,193 @@ def _rank_read_slots(by_shard: list, k: int) -> list[int]:
     return [j for _, _, j in scored]
 
 
-def _as_reader(data) -> io.BufferedIOBase:
-    """bytes | file-like -> .read(n) reader."""
-    if isinstance(data, (bytes, bytearray, memoryview)):
-        return io.BytesIO(bytes(data))
-    if hasattr(data, "read"):
-        return data
-    raise TypeError(f"put_object data must be bytes or a reader, got {type(data)!r}")
+# -- zero-copy window pipeline -------------------------------------------------
+#
+# The PUT path stages data in WINDOW_BYTES (= one codec group) windows:
+# buffer-like payloads are sliced as memoryviews in place, reader payloads
+# land ONCE into pooled bytearrays (utils/bufpool.py) via readinto, and
+# every downstream hop -- block split, codec staging, shard fan-out --
+# operates on views over that storage. The old _iter_blocks staging loop
+# re-materialized every block as fresh bytes (the erasure-stage `copied`
+# column this PR flips to `moved`).
+
+WINDOW_BYTES = GROUP_BLOCKS * BLOCK_SIZE
 
 
-def _read_full(reader, n: int) -> bytes:
-    """Read exactly n bytes unless EOF intervenes (short read = EOF)."""
-    out = bytearray()
-    while len(out) < n:
-        chunk = reader.read(n - len(out))
+class _Window:
+    """One pipeline window: a memoryview over the caller's buffer or over a
+    pooled bytearray; release() recycles the latter."""
+
+    __slots__ = ("view", "_pb")
+
+    def __init__(self, view: memoryview, pb=None):
+        self.view = view
+        self._pb = pb
+
+    def __len__(self) -> int:
+        return len(self.view)
+
+    def blocks(self) -> list[memoryview]:
+        v = self.view
+        return [v[off : off + BLOCK_SIZE] for off in range(0, len(v), BLOCK_SIZE)]
+
+    def release(self) -> None:
+        if self._pb is not None:
+            self._pb.release()
+            self._pb = None
+
+
+def _uniform_runs(blocks: list) -> list[list]:
+    """Split a window's blocks into uniform-size runs so every run takes the
+    codec's native scatter path (a short tail block becomes its own
+    single-block group; the digest stream is per-block, so grouping never
+    changes the etag)."""
+    if len(blocks) > 1 and len(blocks[-1]) != len(blocks[0]):
+        return [blocks[:-1], blocks[-1:]]
+    return [blocks]
+
+
+def _fill_window(reader, view: memoryview) -> int:
+    """Fill `view` from the reader; a short count means EOF.
+
+    readinto readers land payload straight into the window (the reader
+    records its own landing hop: socket-read / sigv4-chunk-parse); the
+    legacy read() fallback copies each chunk in and says so."""
+    n = len(view)
+    pos = 0
+    ri = getattr(reader, "readinto", None)
+    if ri is not None:
+        while pos < n:
+            got = ri(view[pos:])
+            if not got:
+                break
+            pos += got
+        if pos:
+            GLOBAL_PROFILER.copy.record("erasure-stage", MOVED, pos)
+        return pos
+    while pos < n:
+        chunk = reader.read(n - pos)
         if not chunk:
             break
-        out += chunk
-    return bytes(out)
+        view[pos : pos + len(chunk)] = chunk
+        pos += len(chunk)
+    if pos:
+        GLOBAL_PROFILER.copy.record("erasure-stage", COPIED, pos)
+    return pos
 
 
-def _iter_blocks(reader, first: bytes) -> Iterator[bytes]:
-    """Yield BLOCK_SIZE blocks from `first` + reader; last may be short.
+def _buffer_windows(data) -> Iterator[_Window]:
+    """Windows over an in-memory payload: pure views, no staging at all."""
+    mv = memoryview(data)
+    for off in range(0, len(mv), WINDOW_BYTES):
+        win = mv[off : off + WINDOW_BYTES]
+        GLOBAL_PROFILER.copy.record("erasure-stage", MOVED, len(win))
+        yield _Window(win)
 
-    Copy-ledger hop: every block leaves here as a fresh ``bytes`` sliced out
-    of the staging buffer -- the erasure batch staging copy on the PUT path.
-    """
-    buf = bytearray(first)
-    while True:
-        if len(buf) < BLOCK_SIZE:
-            chunk = reader.read(BLOCK_SIZE - len(buf))
-            if not chunk:
-                break
-            buf += chunk
-            continue
-        GLOBAL_PROFILER.copy.record("erasure-stage", COPIED, BLOCK_SIZE)
-        yield bytes(buf[:BLOCK_SIZE])
-        del buf[:BLOCK_SIZE]
-    if buf:
-        GLOBAL_PROFILER.copy.record("erasure-stage", COPIED, len(buf))
-        yield bytes(buf)
+
+def _stream_windows(reader, pool, pb, filled: int) -> Iterator[_Window]:
+    """Windows over a reader, starting from an already-filled first buffer.
+
+    Ownership: each yielded _Window owns its pooled buffer (consumer
+    releases); a buffer the generator still holds when it exits -- EOF or
+    close() -- is released here, so abandoned PUTs leak nothing."""
+    try:
+        while True:
+            win = _Window(pb.view(0, filled), pb)
+            pb = None
+            yield win
+            if filled < WINDOW_BYTES:
+                return  # EOF landed inside the last fill
+            pb = pool.acquire()
+            filled = _fill_window(reader, pb.view())
+            if filled == 0:
+                return  # payload was an exact window multiple
+    finally:
+        if pb is not None:
+            pb.release()
+
+
+class _ReadaheadWindows:
+    """Pipelined PUT read stage: a 'put-stager' thread fills window g+1
+    while the caller encodes / fans out window g (the write mirror of the
+    GET readahead). Depth = MTPU_PUT_READAHEAD windows in flight."""
+
+    def __init__(self, src, depth: int):
+        self._src = src
+        self._q: "_queue.Queue" = _queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._run, name="put-stager", daemon=True)
+        self._t.start()
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        try:
+            for win in self._src:
+                if not self._put(("win", win)):
+                    win.release()  # consumer gone; recycle, stop reading
+                    return
+        # mtpulint: disable=swallowed-except -- stored, re-raised at __next__
+        except BaseException as e:  # noqa: BLE001 - surfaced to the PUT loop
+            self._put(("err", e))
+            return
+        self._put(("end", None))
+
+    def __iter__(self) -> "_ReadaheadWindows":
+        return self
+
+    def __next__(self) -> _Window:
+        kind, val = self._q.get()
+        if kind == "win":
+            return val
+        if kind == "err":
+            raise val
+        raise StopIteration
+
+    def close(self) -> None:
+        """Stop the stager, recycle queued windows, join the thread."""
+        self._stop.set()
+        try:
+            while True:
+                kind, val = self._q.get_nowait()
+                if kind == "win":
+                    val.release()
+        except _queue.Empty:
+            pass
+        self._t.join(timeout=10)
+        closer = getattr(self._src, "close", None)
+        if closer is not None:
+            closer()
+
+
+def _wrap_readahead(src):
+    depth = int(os.environ.get("MTPU_PUT_READAHEAD", "1"))
+    return _ReadaheadWindows(src, depth) if depth > 0 else src
+
+
+def data_windows(data) -> "Iterator[_Window]":
+    """bytes-like | .read()/.readinto() stream -> window iterator (the
+    multipart entry point; put_object opens the stream itself so it can
+    peek the first window for the inline-threshold decision)."""
+    if isinstance(data, (bytes, bytearray, memoryview)):
+        return _buffer_windows(data)
+    if hasattr(data, "read") or hasattr(data, "readinto"):
+        pool = bufpool.window_pool()
+        pb = pool.acquire()
+        try:
+            filled = _fill_window(data, pb.view())
+        except BaseException:
+            pb.release()
+            raise
+        return _wrap_readahead(_stream_windows(data, pool, pb, filled))
+    raise TypeError(f"put data must be bytes or a reader, got {type(data)!r}")
 
 
 class _PipelinedMD5:
@@ -190,14 +341,54 @@ def make_etag_md5():
     return _PipelinedMD5() if cores > 1 else hashlib.md5()
 
 
+def _etag_update(h, view) -> None:
+    """Feed a window block to the etag hasher. The pipelined hasher's queue
+    holds blocks PAST the window's release, so it gets a private copy; the
+    synchronous hasher consumes the view in place."""
+    if isinstance(h, _PipelinedMD5):
+        h.update(bytes(view))  # mtpulint: disable=hot-path-copy -- hashed on a side thread after the pooled window is recycled
+    else:
+        h.update(view)
+
+
+def use_fast_etag(opts) -> bool:
+    """Streaming PUTs default to the digest-stream etag (free: the bitrot
+    digests are already computed per group). MTPU_FAST_ETAG=0 restores the
+    content-md5 etag; a client-declared Content-MD5 (opts.etag) always
+    wins so the header contract stays exact."""
+    return (
+        not opts.etag
+        and not opts.bitrot_algorithm
+        and os.environ.get("MTPU_FAST_ETAG", "1") != "0"
+    )
+
+
+def fast_etag(data, k: int, m: int, codec=None) -> str:
+    """Expected streaming-path etag for `data` (tests and tooling compute
+    it independently): md5 over the concatenated per-block data-row bitrot
+    digests, in block order -- the same stream the PUT pipeline hashes for
+    free. Grouping never affects it (the stream is per-block), and every
+    codec produces bit-identical digests, so the etag is deterministic."""
+    codec = codec or codec_mod.default_codec()
+    h = hashlib.md5()
+    mv = memoryview(data)
+    for off in range(0, len(mv), BLOCK_SIZE):
+        h.update(codec.encode_group([mv[off : off + BLOCK_SIZE]], k, m).digest_stream)
+    return h.hexdigest()
+
+
 class ShardStageWriter:
     """Grouped-encode + per-drive staged shard appends with quorum tracking.
 
     The streaming-write engine shared by put_object and multipart part
     uploads: each GROUP_BLOCKS batch of 1 MiB blocks goes through the codec
-    as one device call, and each drive's shard-row frames are appended to its
-    staged file in parallel. Failed drives are dropped from subsequent
-    appends; the caller checks `alive()` against its write quorum.
+    as one scatter-encode call, and each drive gets its whole group frame as
+    ONE gathered append (append_iov) submitted on that drive's I/O lane
+    (utils/iopool.py) -- writes overlap the next group's read+encode, with
+    per-drive FIFO keeping the staged file's append order. Failed drives are
+    dropped as their writes are harvested; the caller checks `alive()`
+    against its write quorum and MUST call drain() (success) or abort()
+    (failure) so no write is in flight when it commits or deletes tmp files.
     (The reference's parallelWriter + Encode loop, erasure-encode.go:29-109.)
     """
 
@@ -219,6 +410,11 @@ class ShardStageWriter:
             [self.algo.new() for _ in range(k + m)] if self.algo is not None else None
         )
         self._appended = False
+        self._lanes = iopool.shard_writer_pool()
+        self._pending: deque = deque()  # deque[list[(drive index, Future)]]
+        # In-flight group bound: memory stays O(inflight x group frames)
+        # while write g-1 overlaps encode g.
+        self._inflight = max(1, int(os.environ.get("MTPU_PUT_INFLIGHT", "2")))
 
     def finalize(self) -> None:
         """Ensure staged shard files exist before commit. Appends create
@@ -237,15 +433,34 @@ class ShardStageWriter:
             if e is not None:
                 self.ok[i] = False
 
-    def append_group(self, group: list[bytes]) -> None:
+    def _collect(self, futs) -> None:
+        for i, f in futs:
+            try:
+                f.result()
+            except Exception:  # mtpulint: disable=swallowed-except -- drive marked failed; the quorum check raises
+                self.ok[i] = False
+
+    def _reap(self) -> None:
+        """Harvest groups whose writes have all landed, without blocking."""
+        while self._pending and all(f.done() for _, f in self._pending[0]):
+            self._collect(self._pending.popleft())
+
+    def append_group(self, group: list) -> bytes | None:
+        """Encode one uniform group and submit each drive's gathered append.
+
+        Returns the group's data-row digest stream (the fast-etag input) on
+        the streaming layout, None on the legacy whole-file layout. Writes
+        are asynchronous: a drive failure surfaces in ok[] at the next
+        harvest (or drain()), exactly like the reference's parallelWriter
+        noticing a broken disk one buffer later."""
         if not group:
-            return
+            return None
         # Stage marks feed the always-on perf ledger: "encode" is the codec
-        # call, "shard-fanout" the parallel staged appends -- the two halves
-        # of where a streaming PUT's group time goes.
+        # call, "shard-fanout" the blocking part of the staged appends -- the
+        # two halves of where a streaming PUT's group time goes.
         with tracing.span("encode", "object", blocks=len(group)):
             if self._hashers is None:
-                row_frames = self.codec.encode_frames(group, self.k, self.m)
+                eg = self.codec.encode_group(group, self.k, self.m)
             else:
                 # Whole-file layout: raw chunks, one running digest per row.
                 encoded = self.codec.encode(group, self.k, self.m)
@@ -254,24 +469,64 @@ class ShardStageWriter:
                     chunks = [e[0][row] for e in encoded]
                     for c in chunks:
                         self._hashers[row].update(c)
-                    row_frames.append(b"".join(chunks))
-
-        def wr(i):
-            if not self.ok[i]:
-                return
-            row = self.distribution[i] - 1
-            self.disks[i].append_file(META_BUCKET, self.stage_path(i), row_frames[row])
-
+                    row_frames.append(b"".join(chunks))  # mtpulint: disable=hot-path-copy -- legacy whole-file layout appends one contiguous frame
         self._appended = True
-        # Copy-ledger hop: shard frames are handed to the drives by
-        # reference -- the fan-out moves bytes without another copy.
+
+        if self._hashers is not None:
+            def wr(i):
+                if not self.ok[i]:
+                    return
+                row = self.distribution[i] - 1
+                self.disks[i].append_file(META_BUCKET, self.stage_path(i), row_frames[row])
+
+            GLOBAL_PROFILER.copy.record(
+                "shard-fanout", MOVED, sum(len(f) for f in row_frames)
+            )
+            with tracing.span("shard-fanout", "object", drives=len(self.disks)):
+                for i, (_, e) in enumerate(meta_mod.parallel_map(wr, range(len(self.disks)))):
+                    if e is not None:
+                        self.ok[i] = False
+            return None
+
+        # Copy-ledger hop: each drive receives its whole group frame as
+        # iovec VIEWS over the encoder's buffer -- the fan-out moves bytes
+        # without joining or re-staging them.
         GLOBAL_PROFILER.copy.record(
-            "shard-fanout", MOVED, sum(len(f) for f in row_frames)
+            "shard-fanout", MOVED, sum(eg.row_nbytes(r) for r in range(self.k + self.m))
         )
+        self._reap()
+        while len(self._pending) >= self._inflight:
+            with tracing.span("shard-fanout", "object", drives=len(self.disks)):
+                self._collect(self._pending.popleft())
+        futs = []
+        for i, d in enumerate(self.disks):
+            if not self.ok[i]:
+                continue
+            row = self.distribution[i] - 1
+            futs.append(
+                (
+                    i,
+                    self._lanes.submit(
+                        d.endpoint(), d.append_iov, META_BUCKET, self.stage_path(i), eg.iovecs[row]
+                    ),
+                )
+            )
+        self._pending.append(futs)
+        return eg.digest_stream
+
+    def drain(self) -> None:
+        """Block until every in-flight group write has landed; ok[] is final
+        after this returns. Callers drain before commit AND before deleting
+        staged files (a late write racing a tmp cleanup would resurrect the
+        file)."""
+        if not self._pending:
+            return
         with tracing.span("shard-fanout", "object", drives=len(self.disks)):
-            for i, (_, e) in enumerate(meta_mod.parallel_map(wr, range(len(self.disks)))):
-                if e is not None:
-                    self.ok[i] = False
+            while self._pending:
+                self._collect(self._pending.popleft())
+
+    def abort(self) -> None:
+        self.drain()
 
     def alive(self) -> int:
         return sum(self.ok)
@@ -323,7 +578,7 @@ def _join_block_rows(rows, k: int, need: int) -> bytes:
         need -= take
         if need <= 0:
             break
-    return b"".join(pieces)
+    return b"".join(pieces)  # mtpulint: disable=hot-path-copy -- GET assembles the decoded block for the response
 
 
 def _whole_layout(metas) -> bool:
@@ -360,7 +615,7 @@ def _frame_shard(chunks: list[bytes], digests: list[bytes]) -> bytes:
     for d, c in zip(digests, chunks):
         parts.append(d)
         parts.append(c)
-    return b"".join(parts)
+    return b"".join(parts)  # mtpulint: disable=hot-path-copy -- heal rebuilds a contiguous shard frame
 
 
 def _parse_frames(
@@ -403,9 +658,9 @@ def _verify_frames(blob, chunk_sizes: list[int], parsed) -> list[bool]:
         flags = list(native.hh256_verify_frames(blob, chunk_sizes[0], same, MAGIC_KEY) != 0)
         for i in range(same, n):
             d, c = parsed[i]
-            flags.append(bitrot_mod.digest_of(bytes(c)) == d)
+            flags.append(bitrot_mod.digest_of(bytes(c)) == d)  # mtpulint: disable=hot-path-copy -- bitrot hasher needs contiguous bytes
         return flags
-    digs = bitrot_mod.digests_of_batch([bytes(c) for _, c in parsed])
+    digs = bitrot_mod.digests_of_batch([bytes(c) for _, c in parsed])  # mtpulint: disable=hot-path-copy -- bitrot hasher needs contiguous bytes
     return [digs[i] == parsed[i][0] for i in range(n)]
 
 
@@ -639,20 +894,46 @@ class ErasureObjects:
                     f"unknown bitrot algorithm {opts.bitrot_algorithm!r}",
                 ) from None
 
-        reader = _as_reader(data)
-        head = _read_full(reader, SMALL_FILE_THRESHOLD)
         with tracing.span(
             "object.PutObject", "object", bucket=bucket, object=object_name
         ) as sp:
             # Whole-file bitrot objects always take the streaming (shard-file)
-            # path: the legacy layout has no inline representation.
-            if len(head) < SMALL_FILE_THRESHOLD and not wants_whole:
-                oi = self._put_inline(
-                    bucket, object_name, head, opts, k, m, distribution, version_id, mod_time
-                )
+            # path: the legacy layout has no inline representation. Buffer
+            # payloads are windowed as views in place; readers land once
+            # into a pooled window -- peeked here for the inline decision.
+            if isinstance(data, (bytes, bytearray, memoryview)):
+                if len(data) < SMALL_FILE_THRESHOLD and not wants_whole:
+                    oi = self._put_inline(
+                        bucket, object_name, data, opts, k, m, distribution, version_id, mod_time
+                    )
+                else:
+                    oi = self._put_streaming(
+                        bucket, object_name, _buffer_windows(data), opts, k, m,
+                        distribution, version_id, mod_time,
+                    )
+            elif hasattr(data, "read") or hasattr(data, "readinto"):
+                pool = bufpool.window_pool()
+                pb = pool.acquire()
+                try:
+                    filled = _fill_window(data, pb.view())
+                except BaseException:
+                    pb.release()
+                    raise
+                if filled < SMALL_FILE_THRESHOLD and not wants_whole:
+                    head = bytes(pb.view(0, filled))  # mtpulint: disable=hot-path-copy -- sub-threshold inline blob outlives the pooled window
+                    pb.release()
+                    oi = self._put_inline(
+                        bucket, object_name, head, opts, k, m, distribution, version_id, mod_time
+                    )
+                else:
+                    windows = _wrap_readahead(_stream_windows(data, pool, pb, filled))
+                    oi = self._put_streaming(
+                        bucket, object_name, windows, opts, k, m,
+                        distribution, version_id, mod_time,
+                    )
             else:
-                oi = self._put_streaming(
-                    bucket, object_name, reader, head, opts, k, m, distribution, version_id, mod_time
+                raise TypeError(
+                    f"put_object data must be bytes or a reader, got {type(data)!r}"
                 )
             sp.set(size=oi.size)
             return oi
@@ -770,11 +1051,12 @@ class ErasureObjects:
         return oi
 
     def _put_streaming(
-        self, bucket, object_name, reader, head: bytes, opts, k, m, distribution,
+        self, bucket, object_name, windows, opts, k, m, distribution,
         version_id, mod_time,
     ) -> ObjectInfo:
-        """Large object: grouped block encode + per-drive staged appends,
-        committed with rename_data under the namespace lock."""
+        """Large object: pipelined window encode + gathered staged appends,
+        committed with rename_data under the namespace lock. `windows`
+        yields _Window views (released here as each group's encode lands)."""
         n = k + m
         data_dir = str(uuid.uuid4())
         upload_id = str(uuid.uuid4())
@@ -808,45 +1090,61 @@ class ErasureObjects:
 
             meta_mod.parallel_map(rm, list(indices))
 
-        # Created immediately before the try so every failure path reaches
-        # the shutdown handler.
-        md5h = None if opts.etag else make_etag_md5()
+        # Etag strategy: digest-stream md5 rides the encode for free; the
+        # content-md5 fallback (MTPU_FAST_ETAG=0 / explicit algorithms)
+        # hashes blocks as they stream. Created immediately before the try
+        # so every failure path reaches the shutdown handler.
+        etag_h = hashlib.md5() if use_fast_etag(opts) else None
+        md5h = make_etag_md5() if (not opts.etag and etag_h is None) else None
         try:
-            group: list[bytes] = []
-            for block in _iter_blocks(reader, head):
-                if md5h is not None:
-                    md5h.update(block)
-                size += len(block)
-                group.append(block)
-                if len(group) >= GROUP_BLOCKS:
-                    # Budget check at the group boundary: an expired deadline
-                    # aborts into the cleanup path below (stage shards
-                    # deleted locally, no budget needed), so a slow client
-                    # or slow drives can't stream past the caller's patience.
+            try:
+                for win in windows:
+                    # Budget check at the window boundary: an expired
+                    # deadline aborts into the cleanup path below (stage
+                    # shards deleted locally, no budget needed), so a slow
+                    # client or slow drives can't stream past the caller's
+                    # patience.
                     try:
                         deadline.check("erasure put")
                     except errors.DeadlineExceeded:
                         GLOBAL_DEGRADE.record_deadline_abort("erasure-put")
                         raise
-                    writer.append_group(group)
-                    group = []
+                    blocks = win.blocks()
+                    size += len(win)
+                    if md5h is not None:
+                        for b in blocks:
+                            _etag_update(md5h, b)
+                    for run in _uniform_runs(blocks):
+                        stream = writer.append_group(run)
+                        if etag_h is not None and stream:
+                            etag_h.update(stream)
+                    # The group's writes hold encoder-owned views, never the
+                    # window -- recycle it before the next read lands.
+                    win.release()
                     if writer.alive() < write_quorum:
                         raise errors.ErasureWriteQuorum(
                             bucket, object_name, f"write quorum {write_quorum} lost mid-stream"
                         )
-            writer.append_group(group)
-            writer.finalize()  # zero-byte payloads still commit a shard file
-            if writer.alive() < write_quorum:
-                raise errors.ErasureWriteQuorum(
-                    bucket, object_name, f"write quorum {write_quorum} lost mid-stream"
-                )
-        except BaseException:
-            if isinstance(md5h, _PipelinedMD5):
-                md5h.shutdown()  # never leak the etag thread on a failed put
-            cleanup(range(n))
-            raise
+                writer.drain()
+                writer.finalize()  # zero-byte payloads still commit a shard file
+                if writer.alive() < write_quorum:
+                    raise errors.ErasureWriteQuorum(
+                        bucket, object_name, f"write quorum {write_quorum} lost mid-stream"
+                    )
+            except BaseException:
+                # Writes must settle before cleanup deletes tmp (a late
+                # append racing the delete would resurrect the staged file).
+                writer.abort()
+                if isinstance(md5h, _PipelinedMD5):
+                    md5h.shutdown()  # never leak the etag thread on a failed put
+                cleanup(range(n))
+                raise
+        finally:
+            closer = getattr(windows, "close", None)
+            if closer is not None:
+                closer()  # stop the stager thread, recycle queued windows
 
-        etag = opts.etag or md5h.hexdigest()
+        etag = opts.etag or (etag_h.hexdigest() if etag_h is not None else md5h.hexdigest())
         base_meta = {"etag": etag, "content-type": opts.content_type, **opts.user_defined}
         row_sums = writer.whole_checksums()
 
@@ -983,7 +1281,7 @@ class ErasureObjects:
         length: int = -1,
     ) -> tuple[ObjectInfo, bytes]:
         oi, stream = self.get_object_stream(bucket, object_name, opts, offset, length)
-        return oi, b"".join(stream)
+        return oi, b"".join(stream)  # mtpulint: disable=hot-path-copy -- buffered get_object() convenience; zero-copy callers use get_object_stream
 
     def get_object_stream(
         self,
@@ -1834,7 +2132,7 @@ class ErasureObjects:
                             per_row[j].append((digests[idx], chunks[idx]))
                 for j in bad_rows:
                     if whole:
-                        raw = b"".join(c for _, c in per_row[j])
+                        raw = b"".join(c for _, c in per_row[j])  # mtpulint: disable=hot-path-copy -- heal materializes the rebuilt part
                         rebuilt_files[j][part.number] = raw
                         rebuilt_sums[j].append(
                             {

@@ -117,11 +117,11 @@ class MultipartManager:
         rename, so a re-upload of the same part number never leaves a
         half-written file behind."""
         from .erasure import (
-            GROUP_BLOCKS,
             ShardStageWriter,
             _PipelinedMD5,
-            _as_reader,
-            _iter_blocks,
+            _etag_update,
+            _uniform_runs,
+            data_windows,
             make_etag_md5,
         )
 
@@ -132,7 +132,7 @@ class MultipartManager:
         n = self.eo.drive_count
         k, m = self._geometry(meta_doc)
         distribution = hash_order(f"{bucket}/{object_name}", n)
-        reader = _as_reader(data)
+        windows = data_windows(data)
         udir = _upload_dir(bucket, object_name, upload_id)
         stage = f"part.{part_number}.tmp.{uuid.uuid4().hex[:8]}"
         disks = self.eo._online()
@@ -154,14 +154,10 @@ class MultipartManager:
 
             meta_mod.parallel_map(rm, list(range(n)))
 
-        md5h = make_etag_md5()  # pipelined on multi-core (part etag)
+        md5h = make_etag_md5()  # pipelined on multi-core (part etag stays md5)
         try:
-            group: list[bytes] = []
-            for block in _iter_blocks(reader, b""):
-                md5h.update(block)
-                size += len(block)
-                group.append(block)
-                if len(group) >= GROUP_BLOCKS:
+            try:
+                for win in windows:
                     # Deadline expiry aborts into cleanup() below -- stage
                     # files are deleted, nothing leaks into the upload dir.
                     try:
@@ -169,21 +165,31 @@ class MultipartManager:
                     except errors.DeadlineExceeded:
                         GLOBAL_DEGRADE.record_deadline_abort("multipart-put")
                         raise
-                    writer.append_group(group)
-                    group = []
+                    blocks = win.blocks()
+                    size += len(win)
+                    for b in blocks:
+                        _etag_update(md5h, b)
+                    for run in _uniform_runs(blocks):
+                        writer.append_group(run)
+                    win.release()
                     if writer.alive() < write_quorum:
                         raise errors.ErasureWriteQuorum(
                             bucket, object_name, "upload part quorum lost mid-stream"
                         )
-            writer.append_group(group)
-            writer.finalize()  # zero-byte parts still commit a shard file
-            if writer.alive() < write_quorum:
-                raise errors.ErasureWriteQuorum(bucket, object_name, "upload part quorum")
-        except BaseException:
-            if isinstance(md5h, _PipelinedMD5):
-                md5h.shutdown()
-            cleanup()
-            raise
+                writer.drain()
+                writer.finalize()  # zero-byte parts still commit a shard file
+                if writer.alive() < write_quorum:
+                    raise errors.ErasureWriteQuorum(bucket, object_name, "upload part quorum")
+            except BaseException:
+                writer.abort()  # writes settle before cleanup deletes stage files
+                if isinstance(md5h, _PipelinedMD5):
+                    md5h.shutdown()
+                cleanup()
+                raise
+        finally:
+            closer = getattr(windows, "close", None)
+            if closer is not None:
+                closer()
 
         etag = md5h.hexdigest()
         mod_time = now()

@@ -66,6 +66,15 @@ class StorageAPI(abc.ABC):
     @abc.abstractmethod
     def append_file(self, volume: str, path: str, data: bytes) -> None: ...
 
+    def append_iov(self, volume: str, path: str, iovecs: list) -> None:
+        """Append a sequence of buffers as one logical write.
+
+        The coalesced shard fan-out hands each drive its whole group as
+        digest/chunk views; LocalDrive turns this into a single os.writev.
+        The default keeps remote/test drives working through append_file
+        (one join, one append)."""
+        self.append_file(volume, path, b"".join(iovecs))
+
     @abc.abstractmethod
     def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes: ...
 

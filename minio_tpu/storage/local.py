@@ -268,6 +268,28 @@ class LocalDrive(StorageAPI):
         with f:
             f.write(data)
 
+    def append_iov(self, volume: str, path: str, iovecs: list) -> None:
+        """Gathered append: the whole group's digest/chunk views go down in
+        one os.writev (releases the GIL) instead of per-block appends."""
+        p = self._file_path(volume, path)
+        try:
+            fd = os.open(p, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        except FileNotFoundError:
+            os.makedirs(os.path.dirname(p), exist_ok=True)
+            fd = os.open(p, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
+        try:
+            vecs = [memoryview(v) for v in iovecs if len(v)]
+            while vecs:
+                written = os.writev(fd, vecs)
+                # Short writev: drop fully-written vecs, trim the partial one.
+                while vecs and written >= len(vecs[0]):
+                    written -= len(vecs[0])
+                    vecs.pop(0)
+                if written:
+                    vecs[0] = vecs[0][written:]
+        finally:
+            os.close(fd)
+
     def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes:
         p = self._file_path(volume, path)
         if self._use_native_io(length):

@@ -21,7 +21,7 @@ from ..control.sanitizer import san_lock, san_rlock
 _METERED = frozenset(
     (
         "disk_info make_vol stat_vol list_vols delete_vol write_all read_all "
-        "delete create_file append_file read_file stat_file read_xl "
+        "delete create_file append_file append_iov read_file stat_file read_xl "
         "read_version write_metadata update_metadata delete_version "
         "rename_data rename_file list_dir walk_dir verify_file"
     ).split()
@@ -125,7 +125,13 @@ class MeteredDrive:
                 record(t0, c0, failed=True)
                 raise
             record(t0, c0, failed=False)
-            if name in _WRITE_BYTES:
+            if name == "append_iov":
+                iovecs = kwargs.get("iovecs") if len(args) < 3 else args[2]
+                if iovecs:
+                    GLOBAL_PROFILER.copy.record(
+                        "drive-write", MOVED, sum(len(v) for v in iovecs)
+                    )
+            elif name in _WRITE_BYTES:
                 data = kwargs.get("data") if len(args) < 3 else args[2]
                 if data is not None:
                     GLOBAL_PROFILER.copy.record("drive-write", MOVED, len(data))

@@ -1,0 +1,140 @@
+"""Bounded reusable buffer pool for the zero-copy data plane.
+
+Role of the reference's internal/bpool (bpool.BytePoolCap): the PUT path
+lands socket bytes into pooled ``bytearray`` windows once, and every
+downstream hop (sigv4 chunk parse, erasure staging, shard slicing) operates
+on ``memoryview``s over the same storage. The pool bounds steady-state
+memory (capacity x buf_size) while never blocking a request: when the free
+list is empty an overflow buffer is allocated and simply dropped on release
+instead of being retained.
+
+Lifecycle is explicit refcounting, not GC: ``acquire`` hands out a
+PooledBuffer with one reference; pipeline stages that hold the buffer past
+the caller's scope (readahead queue, in-flight drive writes) ``retain`` it
+and ``release`` when done. The last release recycles the storage. Tests
+pigeonhole this: after any PUT -- including chaos-faulted ones -- the pool
+reports zero outstanding buffers.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..control.sanitizer import san_lock
+
+
+class PooledBuffer:
+    """A refcounted bytearray window handed out by a BufferPool."""
+
+    __slots__ = ("data", "_pool", "_refs")
+
+    def __init__(self, data: bytearray, pool: "BufferPool | None"):
+        self.data = data
+        self._pool = pool
+        self._refs = 1
+
+    def __len__(self) -> int:
+        return len(self.data)
+
+    def view(self, start: int = 0, end: int | None = None) -> memoryview:
+        """Writable window over the storage. Views must not outlive the
+        buffer's last release -- the storage is reused afterwards."""
+        return memoryview(self.data)[start:end]
+
+    def retain(self) -> "PooledBuffer":
+        pool = self._pool
+        if pool is None:  # detached (pool-less) buffer: no accounting
+            return self
+        with pool._lock:
+            if self._refs <= 0:
+                raise RuntimeError("retain() on a released PooledBuffer")
+            self._refs += 1
+        return self
+
+    def release(self) -> None:
+        pool = self._pool
+        if pool is None:
+            return
+        with pool._lock:
+            if self._refs <= 0:
+                raise RuntimeError("release() on an already-released PooledBuffer")
+            self._refs -= 1
+            if self._refs == 0:
+                pool._recycle_locked(self)
+
+
+class BufferPool:
+    """Bounded free-list of equal-size bytearrays. acquire() never blocks:
+    past `capacity` it allocates overflow buffers that are dropped (not
+    pooled) on release, so a burst degrades to plain allocation instead of
+    deadlocking the data plane on its own memory bound."""
+
+    def __init__(self, buf_size: int, capacity: int, name: str = "bufpool"):
+        if buf_size <= 0 or capacity <= 0:
+            raise ValueError("buf_size and capacity must be positive")
+        self.buf_size = buf_size
+        self.capacity = capacity
+        self.name = name
+        self._lock = san_lock("BufferPool._lock")
+        self._free: list[bytearray] = []
+        self._outstanding = 0
+        self._gets = 0
+        self._reuses = 0
+        self._overflow = 0
+
+    def acquire(self) -> PooledBuffer:
+        with self._lock:
+            self._gets += 1
+            self._outstanding += 1
+            if self._free:
+                self._reuses += 1
+                return PooledBuffer(self._free.pop(), self)
+            if self._outstanding > self.capacity:
+                self._overflow += 1
+        # Allocation happens outside the lock: a multi-MiB bytearray fill is
+        # not something to serialize the whole data plane behind.
+        return PooledBuffer(bytearray(self.buf_size), self)
+
+    def _recycle_locked(self, pb: PooledBuffer) -> None:
+        self._outstanding -= 1
+        if len(self._free) < self.capacity and len(pb.data) == self.buf_size:
+            self._free.append(pb.data)
+        pb.data = bytearray(0)  # poison: stale views see an empty buffer
+
+    def outstanding(self) -> int:
+        with self._lock:
+            return self._outstanding
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "name": self.name,
+                "buf_size": self.buf_size,
+                "capacity": self.capacity,
+                "free": len(self._free),
+                "outstanding": self._outstanding,
+                "gets": self._gets,
+                "reuses": self._reuses,
+                "overflow_allocs": self._overflow,
+            }
+
+
+# -- process-wide window pool --------------------------------------------------
+
+# The PUT pipeline lands body bytes in GROUP-sized windows (16 MiB: see
+# object/erasure.py GROUP_BLOCKS x BLOCK_SIZE). Capacity bounds steady-state
+# pool memory at capacity x 16 MiB; concurrent bursts overflow-allocate.
+WINDOW_BYTES = 16 * (1 << 20)
+
+_GLOBAL: BufferPool | None = None
+_global_lock = san_lock("bufpool._global_lock")
+
+
+def window_pool() -> BufferPool:
+    """The shared PUT window pool (MTPU_POOL_BUFFERS sizes it, default 8)."""
+    global _GLOBAL
+    with _global_lock:
+        if _GLOBAL is None:
+            cap = max(1, int(os.environ.get("MTPU_POOL_BUFFERS", "8")))
+            _GLOBAL = BufferPool(WINDOW_BYTES, cap, name="put-window")
+        return _GLOBAL

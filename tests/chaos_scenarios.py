@@ -97,7 +97,7 @@ class TestRegistry:
             FaultSpec.from_dict({"target": "no-kind"})
         spec = FaultSpec.from_dict({"kind": BITROT, "count": 3, "seed": 7})
         # Bitrot defaults to the write side (corruption at rest).
-        assert spec.ops == ("create_file", "append_file")
+        assert spec.ops == ("create_file", "append_file", "append_iov")
         assert FaultSpec.from_dict(spec.to_dict()).ops == spec.ops
 
     def test_fixed_seed_reproduces_schedule(self):

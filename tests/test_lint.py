@@ -19,6 +19,7 @@ from tools.mtpulint import (
 from tools.mtpulint.rules import (
     CondWaitLoopRule,
     DeadlineRebindRule,
+    HotPathCopyRule,
     LockBlockingIORule,
     LockOrderRule,
     MetricsRenderedRule,
@@ -814,4 +815,71 @@ def test_shared_publish_exempts_atomic_publishes_and_request_path(tmp_path):
                     self.requests += 1     # not reachable from the worker
         """,
     }, SharedPublishRule())
+    assert findings == []
+
+# -- hot-path-copy ------------------------------------------------------------
+
+
+def test_hot_path_copy_fires_on_bytes_join_and_augassign(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/erasure.py": """
+            def f(view, parts):
+                blob = bytes(view)
+                joined = b"".join(parts)
+                out = bytearray()
+                for p in parts:
+                    out += p
+                return blob, joined, out
+        """,
+    }, HotPathCopyRule())
+    assert [f.rule for f in findings] == ["hot-path-copy"] * 3
+    assert sorted(f.line for f in findings) == [2, 3, 6]
+
+
+def test_hot_path_copy_quiet_on_text_allocs_and_counters(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/api/streaming.py": """
+            import os
+
+            def f(raw, names, blocks):
+                header = bytes(raw[:12]).decode("latin-1")   # text parse
+                zeros = bytes(64)                            # alloc, not a copy
+                path = os.path.join("a", "b")                # not a byte join
+                csv = ",".join(names)                        # str join
+                total = 0
+                for b in blocks:
+                    total += len(b)                          # int counter
+                return header, zeros, path, csv, total
+        """,
+    }, HotPathCopyRule())
+    assert findings == []
+
+
+def test_hot_path_copy_augassign_tracks_per_scope_accumulators(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/local.py": """
+            def f(parts):
+                out = []
+                for p in parts:
+                    out += [p]
+                return out
+
+            def g(parts):
+                out = b""
+                for p in parts:
+                    out += p
+                return out
+        """,
+    }, HotPathCopyRule())
+    assert [f.rule for f in findings] == ["hot-path-copy"]
+    assert findings[0].line == 10
+
+
+def test_hot_path_copy_scoped_to_data_plane_files(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/metrics.py": """
+            def f(view):
+                return bytes(view)
+        """,
+    }, HotPathCopyRule())
     assert findings == []

@@ -89,6 +89,22 @@ class TestSpecParser:
             parse_scenario(_doc(compare={"a": "p0", "b": "ghost"}))
         assert ei.value.path == "$.compare.b"
 
+    def test_compare_sweep_list_validates_each_entry(self):
+        ok = parse_scenario(_doc(compare=[
+            {"a": "p0", "b": "p0", "min_ratio": 1.0},
+            {"a": "p0", "b": "p0"},
+        ]))
+        assert isinstance(ok.compare, list) and len(ok.compare) == 2
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(compare=[
+                {"a": "p0", "b": "p0"},
+                {"a": "p0", "b": "ghost"},
+            ]))
+        assert ei.value.path == "$.compare[1].b"
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(compare=[]))
+        assert ei.value.path == "$.compare"
+
     def test_prepopulate_bounded_by_keyspace(self):
         with pytest.raises(SpecError) as ei:
             parse_scenario(_doc(keyspace={"keys": 4, "prepopulate": 9}))
@@ -346,6 +362,36 @@ class TestReportAndSlo:
         cmp = rep["compare"]
         assert cmp["ratio"] == pytest.approx(4000 / 1600, rel=1e-3)
         assert cmp["reproduced"] is True  # 2.5x >= 2.0
+
+    def test_build_report_compare_sweep_emits_one_verdict_per_rung(self):
+        sc = parse_scenario(
+            _doc(
+                phases=[
+                    {"name": "c1", "mix": {"PUT": 1.0}, "ops": 2},
+                    {"name": "c4", "mix": {"PUT": 1.0}, "ops": 8},
+                ],
+                compare=[
+                    {"a": "c4", "b": "c1", "op": "PUT",
+                     "metric": "bytes_per_s", "min_ratio": 1.0},
+                    {"a": "c4", "b": "c1", "op": "PUT",
+                     "metric": "bytes_per_s", "min_ratio": 9.0},
+                ],
+            )
+        )
+        a = _phase_result(
+            "c1", {"PUT": {"ok": 2, "bytes": 1000, "errors": {}}},
+            {"PUT": [0.01] * 2}, wall_s=1.0,
+        )
+        b = _phase_result(
+            "c4", {"PUT": {"ok": 8, "bytes": 3000, "errors": {}}},
+            {"PUT": [0.01] * 8}, wall_s=1.0,
+        )
+        rep = build_report(sc, [a, b], stage_breakdown={}, degrade={})
+        cmp = rep["compare"]
+        assert isinstance(cmp, list) and len(cmp) == 2
+        assert cmp[0]["reproduced"] is True   # 3x >= 1.0
+        assert cmp[1]["reproduced"] is False  # 3x < 9.0
+        assert cmp[1]["ratio"] == pytest.approx(3.0, rel=1e-3)
 
     def test_render_prometheus_is_lint_clean(self):
         sc = self._scenario()

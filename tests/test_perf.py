@@ -483,4 +483,23 @@ class TestPerfGateSlo:
 
     def test_partial_shapes_tolerated(self):
         assert perf_gate.compare_slo({}, {}) == []
+
+    def test_compare_violation_single_block(self):
+        new = {"compare": {"a": "concurrent", "b": "single", "op": "PUT",
+                           "metric": "bytes_per_s", "ratio": 0.9,
+                           "min_ratio": 1.2, "reproduced": False}}
+        findings = perf_gate.compare_slo({}, new)
+        assert [f["kind"] for f in findings] == ["compare-violation"]
+        assert findings[0]["ratio"] == 0.9
+
+    def test_compare_violation_sweep_flags_only_missed_rungs(self):
+        new = {"compare": [
+            {"a": "c4", "b": "c1", "ratio": 1.4, "min_ratio": 1.0,
+             "reproduced": True},
+            {"a": "c16", "b": "c1", "ratio": 0.7, "min_ratio": 1.0,
+             "reproduced": False},
+        ]}
+        findings = perf_gate.compare_slo({}, new)
+        assert [f["kind"] for f in findings] == ["compare-violation"]
+        assert findings[0]["a"] == "c16"
         assert perf_gate.compare_slo({"ops": None}, {"ops": {"GET": "oops"}}) == []

@@ -1,0 +1,96 @@
+"""Pre-fork front end (api/prefork.py): gating probes and master lifecycle.
+
+The fork tests use trivial children (bind a shared SO_REUSEPORT port, touch
+a file, exit) -- the full-server path is exercised by the same serve() body
+the single-process tests already cover; what needs pinning here is the
+fork/wait/respawn plumbing and the opt-in gates."""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+
+import pytest
+
+from minio_tpu.api import prefork
+
+_HAS_FORK = hasattr(os, "fork") and hasattr(socket, "SO_REUSEPORT")
+
+
+class TestPlanWorkers:
+    def test_unset_serves_single_process(self):
+        n, why = prefork.plan_workers({})
+        assert n == 1 and "unset" in why
+
+    def test_garbage_value_serves_single_process(self):
+        n, why = prefork.plan_workers({"MTPU_WORKERS": "lots"})
+        assert n == 1 and "not an integer" in why
+
+    def test_one_or_less_serves_single_process(self):
+        assert prefork.plan_workers({"MTPU_WORKERS": "1"})[0] == 1
+        assert prefork.plan_workers({"MTPU_WORKERS": "0"})[0] == 1
+
+    def test_worker_child_never_reforks(self):
+        n, why = prefork.plan_workers(
+            {"MTPU_WORKERS": "4", prefork.WORKER_ENV: "1"}
+        )
+        assert n == 1 and "child" in why
+
+    def test_opt_in_respects_platform_gates(self):
+        n, why = prefork.plan_workers({"MTPU_WORKERS": "4"})
+        if not _HAS_FORK:
+            assert n == 1
+        elif not prefork.gil_enabled():
+            assert n == 1 and "free-threaded" in why
+        else:
+            assert n == 4 and "SO_REUSEPORT" in why
+
+
+@pytest.fixture
+def restored_signals():
+    """run_master installs its own SIGTERM/SIGINT handlers; put the test
+    process's handlers back afterwards."""
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    yield
+    signal.signal(signal.SIGTERM, old_term)
+    signal.signal(signal.SIGINT, old_int)
+
+
+@pytest.mark.skipif(not _HAS_FORK, reason="needs fork() + SO_REUSEPORT")
+class TestRunMaster:
+    def test_workers_share_one_port(self, tmp_path, restored_signals, monkeypatch):
+        monkeypatch.setenv("MTPU_WORKER_RESPAWNS", "0")
+        probe = socket.socket()
+        probe.bind(("127.0.0.1", 0))
+        port = probe.getsockname()[1]
+        probe.close()
+
+        def child(wid: int) -> int:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+            s.bind(("127.0.0.1", port))  # both workers: same port, no EADDRINUSE
+            s.listen(1)
+            (tmp_path / f"bound{wid}").write_text(str(port))
+            s.close()
+            return 0
+
+        rc = prefork.run_master(2, child, log=lambda _m: None)
+        assert rc == 0
+        assert sorted(p.name for p in tmp_path.glob("bound*")) == ["bound0", "bound1"]
+
+    def test_crashed_worker_respawns_up_to_budget(
+        self, tmp_path, restored_signals, monkeypatch
+    ):
+        monkeypatch.setenv("MTPU_WORKER_RESPAWNS", "1")
+
+        def child(_wid: int) -> int:
+            runs = len(list(tmp_path.glob("run*")))
+            (tmp_path / f"run{runs}").write_text("")
+            return 3
+
+        rc = prefork.run_master(1, child, log=lambda _m: None)
+        assert rc == 3
+        # Initial spawn + exactly one respawn, then the budget is spent.
+        assert len(list(tmp_path.glob("run*"))) == 2

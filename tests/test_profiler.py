@@ -245,7 +245,9 @@ class TestCopyLedger:
 
 class TestCopyConservation:
     """The ledger against a real erasure PUT+GET: every hop the ISSUE's
-    data-path walk names must see at least the object's bytes."""
+    data-path walk names must see at least the object's bytes -- and since
+    the zero-copy PUT pipeline, the pooled PUT hops must see them as MOVES,
+    not copies."""
 
     SIZE = 1 << 20  # > SMALL_FILE_THRESHOLD: takes the streaming shard path
 
@@ -263,10 +265,12 @@ class TestCopyConservation:
         GLOBAL_PROFILER.copy.reset()
         hz.layer.put_object("cb", "obj", data)
         put_hops = GLOBAL_PROFILER.copy.snapshot()["hops"]
-        # Staging copies at least the object into erasure blocks; the shard
-        # fan-out and drive writes pass those buffers along by reference
-        # (bytes >= size because parity shards ride the same hops).
-        assert put_hops["erasure-stage"]["copied_bytes"] >= self.SIZE
+        # Zero-copy staging: a buffer input is sliced into block windows by
+        # reference, the encoder scatter-writes iovec views, and the drive
+        # append is a gathered writev -- every PUT hop moves, nothing
+        # copies (bytes >= size because parity shards ride the same hops).
+        assert put_hops["erasure-stage"]["moved_bytes"] >= self.SIZE
+        assert put_hops["erasure-stage"]["copied_bytes"] == 0
         assert put_hops["shard-fanout"]["moved_bytes"] >= self.SIZE
         assert put_hops["drive-write"]["moved_bytes"] >= self.SIZE
         assert put_hops["drive-write"]["moved_ops"] >= 1

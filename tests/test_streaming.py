@@ -57,6 +57,14 @@ class RecordingCodec(HostCodec):
             self.encode_sizes.append(len(blocks))
         return super().encode_frames(blocks, k, m)
 
+    def encode_group(self, blocks, k, m):
+        # The PUT pipeline's scatter entry point: count native-path groups
+        # directly; irregular groups recurse into encode() which counts.
+        uniform = self._native is not None and blocks and len({len(b) for b in blocks}) == 1
+        if uniform:
+            self.encode_sizes.append(len(blocks))
+        return super().encode_group(blocks, k, m)
+
 
 @pytest.fixture
 def counted(tmp_path):

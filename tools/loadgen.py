@@ -138,7 +138,9 @@ def main(argv: list[str]) -> int:
     slo_ok = all(
         row.get("ok", True) for row in report.get("slo", {}).values()
     )
-    cmp_ok = report.get("compare", {}).get("reproduced", True) if "compare" in report else True
+    cmp = report.get("compare")
+    cmp_blocks = cmp if isinstance(cmp, list) else [cmp] if isinstance(cmp, dict) else []
+    cmp_ok = all(b.get("reproduced", True) for b in cmp_blocks)
     if not slo_ok:
         _log("SLO VIOLATED (see report.slo)")
     if not cmp_ok:

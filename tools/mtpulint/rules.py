@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import ast
 
-from .engine import Finding, ProjectContext, Rule
+from .engine import FileContext, Finding, ProjectContext, Rule
 
 # Hot-path packages: where a swallowed error means silent data-plane damage.
 HOT_PATHS = (
@@ -1324,6 +1324,127 @@ class SharedPublishRule(Rule):
                 )
 
 
+# ---------------------------------------------------------------------------
+# hot-path-copy
+# ---------------------------------------------------------------------------
+
+
+class HotPathCopyRule(Rule):
+    """Byte-copying constructs on the zero-copy data plane.
+
+    PR 9 rebuilt the socket -> sigv4 -> erasure-stage -> shard-fanout
+    pipeline around pooled buffers and memoryviews; a casual `bytes(view)`,
+    `b"".join(parts)`, or `buf += chunk` quietly reintroduces an
+    O(object size) copy that the copy ledger then reports as a regression.
+    Sites that MUST materialize (header text being decoded, inline blobs
+    outliving a pooled window, client-side test helpers, legacy whole-file
+    bitrot algorithms) carry a justified
+    `# mtpulint: disable=hot-path-copy -- why`."""
+
+    id = "hot-path-copy"
+    title = "byte-copying construct on the zero-copy data plane"
+    scope = (
+        "minio_tpu/api/streaming.py",
+        "minio_tpu/object/erasure.py",
+        "minio_tpu/storage/local.py",
+    )
+
+    @staticmethod
+    def _bytesish(value: ast.AST | None) -> bool:
+        """Is this initializer a byte accumulator? (b"..." literal, or a
+        bytes()/bytearray() construction.)"""
+        if isinstance(value, ast.Constant) and isinstance(value.value, bytes):
+            return True
+        return (
+            isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id in ("bytes", "bytearray")
+        )
+
+    @classmethod
+    def _shallow(cls, node: ast.AST):
+        """Pre-order walk that does not descend into nested function scopes
+        (each scope tracks its own accumulator names)."""
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from cls._shallow(child)
+
+    def _check_calls(self, ctx: FileContext):
+        parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(ctx.tree):
+            for child in ast.iter_child_nodes(parent):
+                parents[child] = parent
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # b"".join(parts): materializes a contiguous copy of every part.
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr == "join"
+                and isinstance(func.value, ast.Constant)
+                and isinstance(func.value.value, bytes)
+            ):
+                yield Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    'b"".join(...) copies every part into one contiguous '
+                    "buffer -- hand the pieces to a scatter write "
+                    "(append_iov) or stream them",
+                )
+                continue
+            # bytes(buffer): a full copy of whatever the buffer holds.
+            if (
+                isinstance(func, ast.Name)
+                and func.id == "bytes"
+                and node.args
+                and not isinstance(node.args[0], ast.Constant)
+            ):
+                parent = parents.get(node)
+                if isinstance(parent, ast.Attribute) and parent.attr == "decode":
+                    continue  # small header text being decoded, not payload
+                yield Finding(
+                    self.id, ctx.relpath, node.lineno,
+                    "bytes(...) copies the underlying buffer -- pass the "
+                    "memoryview through, or justify the materialization",
+                )
+
+    def _check_augments(self, ctx: FileContext):
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            body = scope.body if not isinstance(scope, ast.Module) else scope.body
+            nodes = [n for stmt in body for n in self._shallow(stmt)]
+            accumulators = {
+                t.id
+                for n in nodes
+                if isinstance(n, ast.Assign) and self._bytesish(n.value)
+                for t in n.targets
+                if isinstance(t, ast.Name)
+            }
+            for n in nodes:
+                if (
+                    isinstance(n, ast.AugAssign)
+                    and isinstance(n.op, ast.Add)
+                    and isinstance(n.target, ast.Name)
+                    and n.target.id in accumulators
+                ):
+                    yield Finding(
+                        self.id, ctx.relpath, n.lineno,
+                        f"{n.target.id!r} += concatenation re-copies the "
+                        "accumulated payload -- collect views and scatter-"
+                        "write, or stream through the pooled pipeline",
+                    )
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            yield from self._check_calls(ctx)
+            yield from self._check_augments(ctx)
+
+
 ALL_RULES: list[Rule] = [
     SwallowedExceptRule(),
     RawTransportRule(),
@@ -1338,6 +1459,7 @@ ALL_RULES: list[Rule] = [
     UnjoinedThreadRule(),
     CondWaitLoopRule(),
     SharedPublishRule(),
+    HotPathCopyRule(),
 ]
 
 # deadline_lint.py's historical surface: the two rules that together are the

@@ -89,12 +89,14 @@ def compare_slo(
 ) -> list[dict]:
     """SLO findings between two loadgen reports (tolerates partial shapes).
 
-    Three finding kinds:
+    Four finding kinds:
       * p99-regression: an op's p99 grew past old * (1 + p99_tol) AND by
         more than min_ms (both sides must report the op);
       * burn-violation: the new report burned more than its whole error
         budget (burn > 1.0) -- absolute, old report not required;
-      * p99-violation: the new report misses its own declared p99 target.
+      * p99-violation: the new report misses its own declared p99 target;
+      * compare-violation: a compare block in the new report (dict, or one
+        entry of a sweep list like put_scaling's) missed its min_ratio.
     """
     findings: list[dict] = []
     old_ops = old.get("ops") if isinstance(old.get("ops"), dict) else {}
@@ -131,6 +133,17 @@ def compare_slo(
                 {"kind": "p99-violation", "op": op,
                  "p99_ms": row.get("p99_ms"),
                  "target_p99_ms": row.get("target_p99_ms")}
+            )
+    cmp = new.get("compare")
+    blocks = cmp if isinstance(cmp, list) else [cmp] if isinstance(cmp, dict) else []
+    for entry in blocks:
+        if isinstance(entry, dict) and entry.get("reproduced") is False:
+            findings.append(
+                {"kind": "compare-violation",
+                 "a": entry.get("a"), "b": entry.get("b"),
+                 "op": entry.get("op"), "metric": entry.get("metric"),
+                 "ratio": entry.get("ratio"),
+                 "min_ratio": entry.get("min_ratio")}
             )
     return findings
 
@@ -185,6 +198,9 @@ def main(argv: list[str]) -> int:
                       f"{f['old_p99_ms']:.1f} ms -> {f['new_p99_ms']:.1f} ms")
             elif f["kind"] == "burn-violation":
                 print(f"SLO BURN {f['op']}: {f['budget_burn']:.2f}x the error budget")
+            elif f["kind"] == "compare-violation":
+                print(f"COMPARE MISS {f['a']}/{f['b']} {f['op']} {f['metric']}: "
+                      f"ratio {f['ratio']} < {f['min_ratio']}")
             else:
                 print(f"SLO MISS {f['op']}: p99 {f['p99_ms']} ms "
                       f"over target {f['target_p99_ms']} ms")
