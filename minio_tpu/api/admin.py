@@ -464,22 +464,34 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
 
         return REGISTRY
 
+    def _crash_registry():
+        from ..chaos.crash import REGISTRY
+
+        return REGISTRY
+
     def h_chaos_arm(request, body):
+        from ..chaos import crash as crash_mod
         from ..chaos.faults import FaultSpec
 
         doc = json.loads(body) if body else {}
         cluster = bool(doc.pop("cluster", True))
         try:
-            spec = FaultSpec.from_dict(doc)
+            # kind "crash" routes to the crash-point registry (process-death
+            # schedules); every other kind is a FaultSpec (drive/net errors).
+            if doc.get("kind") == crash_mod.CRASH_KIND:
+                spec = crash_mod.CrashSpec.from_dict(doc)
+                fid = _crash_registry().arm(spec)
+            else:
+                spec = FaultSpec.from_dict(doc)
+                fid = _chaos_registry().arm(spec)
         except (ValueError, TypeError) as e:
             raise S3Error("InvalidArgument", str(e))
-        fid = _chaos_registry().arm(spec)
         if cluster and ctx.notification is not None:
             ctx.notification.chaos_all("arm", spec={**spec.to_dict(), "fault_id": fid})
         return {"fault_id": fid}
 
     def h_chaos_list(request, body):
-        out = {"local": _chaos_registry().list()}
+        out = {"local": _chaos_registry().list() + _crash_registry().list()}
         for peer in _peer_clients():
             try:
                 out[peer.url] = peer.chaos("list").get("faults", [])
@@ -490,7 +502,11 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     def h_chaos_disarm(request, body):
         fid = request.rel_url.query.get("fault-id", "")
         reg = _chaos_registry()
-        removed = int(reg.disarm(fid)) if fid else reg.disarm_all()
+        creg = _crash_registry()
+        if fid:
+            removed = int(reg.disarm(fid)) + int(creg.disarm(fid))
+        else:
+            removed = reg.disarm_all() + creg.disarm_all()
         if request.rel_url.query.get("cluster", "") != "false" and ctx.notification is not None:
             ctx.notification.chaos_all("disarm", fault_id=fid)
         return {"removed": removed}

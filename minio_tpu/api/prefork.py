@@ -23,10 +23,15 @@ Failure semantics (docs/RELIABILITY.md "Worker death"): each worker owns
 only sockets and in-flight request state. A crashed worker resets its open
 connections -- clients see ECONNRESET and retry per normal S3 client
 behavior -- but never loses committed data: PUTs stage to per-drive tmp
-files and commit by atomic rename, so a worker dying mid-PUT leaves only
-garbage tmp state that the next scanner pass sweeps. The master respawns
-crashed workers up to a budget (``MTPU_WORKER_RESPAWNS`` per worker slot,
-default 2) and exits once every worker has exited after a signal.
+files under pid-scoped names and commit by fsync-barriered atomic rename
+(storage/local.py, MTPU_FSYNC). A worker dying mid-PUT leaves only
+dead-pid stage files, and because every worker (including a master
+respawn) runs Node.build, the restart recovery scan
+(storage/recovery.py) sweeps the dead sibling's debris on the way up --
+live siblings' in-flight staging is pid-protected and untouched. The
+master respawns crashed workers up to a budget (``MTPU_WORKER_RESPAWNS``
+per worker slot, default 2) and exits once every worker has exited after
+a signal.
 """
 
 from __future__ import annotations

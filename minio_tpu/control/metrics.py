@@ -202,6 +202,7 @@ class MetricsSys:
         self._render_profiler(metric)
         self._render_heal_scanner(metric)
         self._render_chaos(metric)
+        self._render_crash(metric)
         self._render_degrade(metric)
         self._render_san(metric)
 
@@ -590,6 +591,33 @@ class MetricsSys:
             metric("minio_tpu_chaos_injected_total", n,
                    {"kind": kind, "target": target},
                    help_="Faults injected by the chaos plane.")
+
+    def _render_crash(self, metric) -> None:
+        """Crash-consistency plane: recovery-scan sweep counters
+        (storage/recovery.py) plus armed/fired crash points (chaos/crash.py).
+        A node that never swept debris and never armed a crash point emits
+        nothing."""
+        from ..chaos.crash import REGISTRY
+        from ..storage import recovery
+
+        counts = recovery.counters()
+        armed = REGISTRY.list()
+        fired = REGISTRY.fired_counts()
+        if not any(counts.values()) and not armed and not fired:
+            return
+        for key, n in sorted(counts.items()):
+            if key == "scans":
+                metric("minio_tpu_crash_recovery_scans_total", n,
+                       help_="Recovery-scan passes completed.")
+                continue
+            metric("minio_tpu_crash_recovery_swept_total", n, {"kind": key},
+                   help_="Crash debris swept by the recovery scan, by kind.")
+        metric("minio_tpu_crash_points_armed", len(armed),
+               help_="Crash specs currently armed in the crash registry.",
+               type_="gauge")
+        for point, n in sorted(fired.items()):
+            metric("minio_tpu_crash_fired_total", n, {"point": point},
+                   help_="Crash points fired, by point name.")
 
     def _render_san(self, metric) -> None:
         """Concurrency-sanitizer plane (control/sanitizer.py). Emitted only

@@ -92,6 +92,10 @@ STAGES: frozenset = frozenset({
     ("codec", "encode-batch"),
     ("codec", "reconstruct-batch"),
     ("codec", "verify-batch"),
+    # storage/local.py durability barriers (every fdatasync/fsync the
+    # MTPU_FSYNC discipline issues; the layer is otherwise dynamic, the
+    # entry documents the one literal key bench JSON reports).
+    ("storage", "drive-sync"),
 })
 
 # Layers whose stage names are computed at runtime (per-API root spans,

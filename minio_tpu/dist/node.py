@@ -383,6 +383,30 @@ class Node:
         for pool in self.pools.pools:
             for s in pool.sets:
                 s.on_partial = self.mrf.add
+        # Crash-consistency plane: arm any boot-time crash schedule
+        # (pre-fork workers and crashcheck victims arm via MTPU_CRASH since
+        # the admin API isn't up yet), then sweep crash debris off the local
+        # drives before serving. Every pre-fork worker re-runs build(), so a
+        # respawned worker re-runs this scan -- a dead sibling's pid-scoped
+        # stage files are GC'd here, and partially committed versions are
+        # fed to the MRF heal queue.
+        from ..chaos import crash as _crash
+        from ..storage import recovery as _recovery
+
+        _crash.arm_from_env()
+        if os.environ.get("MTPU_RECOVERY", "1") != "0":
+            for path in self.local_drives:
+                try:
+                    _recovery.recover_drive(LocalDrive(path))
+                except Exception as e:  # noqa: BLE001 - boot must not die on a sweep
+                    GLOBAL_LOGGER.error(f"recovery scan failed on {path}: {e}", exc=e)
+            for pool in self.pools.pools:
+                for s in pool.sets:
+                    if all(d is None or d.is_local() for d in s.disks):
+                        try:
+                            _recovery.recover_set(s, heal=self.mrf.add)
+                        except Exception as e:  # noqa: BLE001
+                            GLOBAL_LOGGER.error(f"set recovery scan failed: {e}", exc=e)
         from ..control.healmgr import DiskHealMonitor
 
         self.disk_heal = DiskHealMonitor(self.pools)

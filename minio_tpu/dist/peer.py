@@ -187,19 +187,29 @@ def make_peer_app(node, token: str) -> web.Application:
 
     def h_chaos(a):
         """Peer side of the admin chaos fanout: arm/disarm/list faults in
-        THIS node's process-global registry (chaos/faults.py). The arming
-        admin node passes the fault_id through so a later cluster-wide
-        disarm removes the same fault everywhere."""
+        THIS node's process-global registries (chaos/faults.py for error
+        injection, chaos/crash.py for kind="crash" process-death points).
+        The arming admin node passes the fault_id through so a later
+        cluster-wide disarm removes the same fault everywhere."""
+        from ..chaos import crash as crash_mod
         from ..chaos.faults import REGISTRY, FaultSpec
 
         op = a.get("op", "list")
         if op == "arm":
-            return {"fault_id": REGISTRY.arm(FaultSpec.from_dict(a.get("spec", {})))}
+            spec = a.get("spec", {})
+            if spec.get("kind") == crash_mod.CRASH_KIND:
+                fid = crash_mod.REGISTRY.arm(crash_mod.CrashSpec.from_dict(spec))
+            else:
+                fid = REGISTRY.arm(FaultSpec.from_dict(spec))
+            return {"fault_id": fid}
         if op == "disarm":
             fid = a.get("fault_id", "")
-            removed = REGISTRY.disarm(fid) if fid else REGISTRY.disarm_all()
+            if fid:
+                removed = int(REGISTRY.disarm(fid)) + int(crash_mod.REGISTRY.disarm(fid))
+            else:
+                removed = REGISTRY.disarm_all() + crash_mod.REGISTRY.disarm_all()
             return {"removed": int(removed)}
-        return {"faults": REGISTRY.list()}
+        return {"faults": REGISTRY.list() + crash_mod.REGISTRY.list()}
 
     # Streaming endpoints: this node's live event / trace records as NDJSON
     # (peer-rest-server.go:985 role) -- the serving node merges these into

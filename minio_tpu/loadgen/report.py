@@ -80,7 +80,10 @@ def evaluate_slo(scenario: Scenario, merged_ops: dict) -> dict:
 
     Budget burn counts only server-attributable failures (transport + 5xx):
     a 4xx is the workload's shape (racing deletes yield NoSuchKey), not a
-    broken promise by the store."""
+    broken promise by the store. The exception is `client_errors_burn: true`
+    on the target: a scenario that never deletes and GETs only prepopulated
+    keys declares that a NoSuchKey IS a broken promise (an acked object was
+    lost), so 4xx burn too."""
     out: dict = {}
     for op, target in sorted(scenario.slo.items()):
         row = merged_ops.get(op)
@@ -89,7 +92,7 @@ def evaluate_slo(scenario: Scenario, merged_ops: dict) -> dict:
             continue
         server_errors = sum(
             n for cls, n in row.get("errors", {}).items()
-            if not cls.startswith("4xx")
+            if target.client_errors_burn or not cls.startswith("4xx")
         )
         total = row.get("ok", 0) + sum(row.get("errors", {}).values())
         err_rate = server_errors / total if total else 0.0
@@ -196,6 +199,17 @@ def build_report(
     cmp = _evaluate_compare(scenario, phases)
     if cmp is not None:
         report["compare"] = cmp
+    if scenario.get_miss_is_loss:
+        # The crash-consistency verdict: the spec promised every GET-able
+        # key was prepopulated and nothing deletes, so a NoSuchKey means an
+        # acked object vanished -- the one thing a crash plane must never
+        # allow, however clean the tails look.
+        misses = sum(
+            n
+            for cls, n in merged.get("GET", {}).get("errors", {}).items()
+            if cls == "4xx:NoSuchKey"
+        )
+        report["acked_object_loss"] = {"get_miss_count": misses, "ok": misses == 0}
     return report
 
 

@@ -53,6 +53,8 @@ def _number(doc: dict, path: str, key: str, default=None, required=False, minimu
 class SloTarget:
     p99_ms: float = 0.0       # 0 = no latency target declared
     error_budget: float = 1.0  # allowed error fraction; 1.0 = anything goes
+    client_errors_burn: bool = False  # 4xx burn budget too (no-delete
+    #                                   scenarios: a NoSuchKey = data loss)
 
 
 @dataclass
@@ -94,6 +96,9 @@ class Scenario:
     phases: list[Phase] = field(default_factory=list)
     compare: dict | None = None    # {"a": phase, "b": phase, "op": kind,
     #                                 "metric": ..., "min_ratio": r}
+    get_miss_is_loss: bool = False  # scenario never deletes + GETs only
+    #                                 prepopulated keys: a GET NoSuchKey is
+    #                                 an acked object lost, a hard verdict
     profile: bool = False          # embed the continuous-profiling summary
     #                                (gil_load, role stacks, copy ledger)
 
@@ -197,6 +202,9 @@ def _parse_slo(doc, path: str) -> dict[str, SloTarget]:
         out[opu] = SloTarget(
             p99_ms=float(_number(t, f"{path}.{op}", "p99_ms", default=0.0, minimum=0)),
             error_budget=float(budget),
+            client_errors_burn=bool(
+                _require(t, f"{path}.{op}", "client_errors_burn", bool, default=False)
+            ),
         )
     return out
 
@@ -225,7 +233,26 @@ def parse_scenario(doc: dict) -> Scenario:
         slo=_parse_slo(doc.get("slo"), "$.slo"),
         compare=_require(doc, "$", "compare", (dict, list), default=None),
         profile=bool(_require(doc, "$", "profile", bool, default=False)),
+        get_miss_is_loss=bool(
+            _require(doc, "$", "get_miss_is_loss", bool, default=False)
+        ),
     )
+    if sc.get_miss_is_loss:
+        if sc.prepopulate < sc.keys:
+            raise SpecError(
+                "$.keyspace.prepopulate",
+                "get_miss_is_loss needs every GET-able key prepopulated "
+                f"(prepopulate {sc.prepopulate} < keys {sc.keys})",
+            )
+        for i, p in enumerate(doc.get("phases") or []):
+            if isinstance(p, dict) and "DELETE" in {
+                str(k).upper() for k in (p.get("mix") or {})
+            }:
+                raise SpecError(
+                    f"$.phases[{i}].mix",
+                    "get_miss_is_loss scenarios must not DELETE: a racing "
+                    "delete makes every GET miss ambiguous",
+                )
     mp = _require(doc, "$", "multipart", dict, default={})
     sc.multipart_parts = int(_number(mp, "$.multipart", "parts", default=3, minimum=1))
     sc.multipart_part_size = int(

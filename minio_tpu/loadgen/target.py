@@ -240,14 +240,19 @@ class InProcessAdmin:
         GLOBAL_PERF.slow.reset()
 
     def arm_fault(self, fault: dict) -> str:
+        from ..chaos import crash as crash_mod
         from ..chaos.faults import REGISTRY, FaultSpec
 
+        if fault.get("kind") == crash_mod.CRASH_KIND:
+            return crash_mod.REGISTRY.arm(crash_mod.CrashSpec.from_dict(fault))
         return REGISTRY.arm(FaultSpec.from_dict(fault))
 
     def disarm_fault(self, fault_id: str) -> None:
+        from ..chaos import crash as crash_mod
         from ..chaos.faults import REGISTRY
 
-        REGISTRY.disarm(fault_id)
+        if not REGISTRY.disarm(fault_id):
+            crash_mod.REGISTRY.disarm(fault_id)
 
     def start_profile(self) -> bool:
         from ..control.profiler import GLOBAL_PROFILER

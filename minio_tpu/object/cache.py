@@ -119,6 +119,9 @@ class _CacheDrive:
         try:
             with open(tmp, "w") as f:
                 json.dump(meta, f)
+            # mtpulint: disable=unsynced-commit -- cache entries are
+            # best-effort and rebuilt from the backend on miss; a torn meta
+            # file just reads as a miss, so an fsync here buys nothing.
             os.replace(tmp, os.path.join(d, CACHE_META))
         except OSError:
             pass

@@ -28,6 +28,7 @@ import uuid
 from collections import deque
 from typing import Iterator
 
+from ..chaos import crash
 from ..control import tracing
 from ..control.degrade import GLOBAL_DEGRADE
 from ..control.profiler import COPIED, GLOBAL_PROFILER, MOVED
@@ -1059,7 +1060,11 @@ class ErasureObjects:
         yields _Window views (released here as each group's encode lands)."""
         n = k + m
         data_dir = str(uuid.uuid4())
-        upload_id = str(uuid.uuid4())
+        # pid-scoped staging: the recovery scan (storage/recovery.py) GCs a
+        # tmp entry only when its owner pid is dead, so a respawned pre-fork
+        # worker can sweep its dead sibling's stage files without touching
+        # live siblings' in-flight uploads on the same drives.
+        upload_id = f"{os.getpid()}.{uuid.uuid4()}"
         write_quorum = k + 1 if k == m else k
         disks = self._online()
         size = 0
@@ -1121,6 +1126,10 @@ class ErasureObjects:
                     # The group's writes hold encoder-owned views, never the
                     # window -- recycle it before the next read lands.
                     win.release()
+                    # One window's groups appended (pre-sync, pre-drain):
+                    # dying here leaves partial stage files + a checked-out
+                    # readahead window for the recovery scan to account for.
+                    crash.crash_point("put.after-stage")
                     if writer.alive() < write_quorum:
                         raise errors.ErasureWriteQuorum(
                             bucket, object_name, f"write quorum {write_quorum} lost mid-stream"
@@ -1147,10 +1156,17 @@ class ErasureObjects:
         etag = opts.etag or (etag_h.hexdigest() if etag_h is not None else md5h.hexdigest())
         base_meta = {"etag": etag, "content-type": opts.content_type, **opts.user_defined}
         row_sums = writer.whole_checksums()
+        # All shards staged + drained, no xl.meta exists anywhere yet: the
+        # un-acked object must be invisible after restart.
+        crash.crash_point("put.before-commit")
 
         def commit(i) -> None:
             if not ok[i]:
                 raise errors.DiskNotFound()
+            # Fires on the (skip+1)-th drive entering commit: skip=j models
+            # dying with exactly j drives' rename_data already durable
+            # (partial-quorum commit). `raise` mode degrades just that drive.
+            crash.crash_point("put.mid-commit", disks[i].endpoint() if disks[i] else "")
             shard_row = distribution[i] - 1
             checksums = None
             if row_sums is not None:
@@ -1202,6 +1218,10 @@ class ErasureObjects:
             )
         if n_ok < len(errs) and self.on_partial is not None:
             self.on_partial(bucket, object_name, version_id)
+        # Quorum reached but the client never saw the 200: after restart the
+        # object may exist (it reached quorum) -- if it does, it must be
+        # complete and bit-identical, never partially visible.
+        crash.crash_point("put.after-commit")
         fi = self._make_put_fi(
             bucket,
             object_name,
@@ -2148,7 +2168,9 @@ class ErasureObjects:
 
         # Write rebuilt shards to the drives that should hold them.
         healed = 0
-        upload_id = str(uuid.uuid4())
+        # pid-scoped like the PUT staging: a heal interrupted by worker death
+        # leaves tmp dirs the recovery scan can attribute to the dead pid.
+        upload_id = f"{os.getpid()}.{uuid.uuid4()}"
         for j in bad_rows:
             # Find the drive index whose distribution slot is shard j.
             drive_index = fi.erasure.distribution.index(j + 1)

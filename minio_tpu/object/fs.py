@@ -23,6 +23,7 @@ import shutil
 import time
 import uuid
 
+from ..storage.local import FSYNC_NEVER, fsync_mode
 from ..storage.types import ObjectPartInfo
 from ..utils import errors
 from .types import (
@@ -146,6 +147,9 @@ class FSObjectLayer:
                         f.write(chunk)
                         md5h.update(chunk)
                         size += len(chunk)
+                if fsync_mode() != FSYNC_NEVER:
+                    f.flush()
+                    os.fsync(f.fileno())
         except BaseException:
             with contextlib.suppress(OSError):
                 os.remove(tmp)
@@ -164,6 +168,9 @@ class FSObjectLayer:
         mtmp = mp + ".tmp"
         with open(mtmp, "w") as f:
             json.dump(meta, f)
+            if fsync_mode() != FSYNC_NEVER:
+                f.flush()
+                os.fsync(f.fileno())
         os.replace(mtmp, mp)
         return self._info(bucket, object_name, meta)
 

@@ -17,8 +17,10 @@ from __future__ import annotations
 
 import hashlib
 import json
+import os
 import uuid
 
+from ..chaos import crash
 from ..control import tracing
 from ..control.degrade import GLOBAL_DEGRADE
 from ..storage.types import ErasureInfo, FileInfo, ObjectPartInfo, now
@@ -134,7 +136,9 @@ class MultipartManager:
         distribution = hash_order(f"{bucket}/{object_name}", n)
         windows = data_windows(data)
         udir = _upload_dir(bucket, object_name, upload_id)
-        stage = f"part.{part_number}.tmp.{uuid.uuid4().hex[:8]}"
+        # pid-scoped stage name: the recovery scan GCs `.tmp.<pid>.` stage
+        # files only when their owner pid is dead (see storage/recovery.py).
+        stage = f"part.{part_number}.tmp.{os.getpid()}.{uuid.uuid4().hex[:8]}"
         disks = self.eo._online()
         writer = ShardStageWriter(
             self.eo.codec, disks, distribution, k, m, lambda i: f"{udir}/{stage}"
@@ -197,12 +201,18 @@ class MultipartManager:
             {"number": part_number, "size": size, "etag": etag, "mod_time": mod_time}
         ).encode()
 
+        # Shards staged on every drive under the tmp name; nothing published.
+        crash.crash_point("multipart.part.staged")
+
         def publish(i):
             if not ok[i]:
                 raise errors.DiskNotFound()
             disks[i].rename_file(
                 META_BUCKET, f"{udir}/{stage}", META_BUCKET, f"{udir}/part.{part_number}"
             )
+            # Part renamed into place but its .meta (which list_parts /
+            # complete use to see the part) not yet written on this drive.
+            crash.crash_point("multipart.part.published", disks[i].endpoint())
             disks[i].write_all(META_BUCKET, f"{udir}/part.{part_number}.meta", part_doc)
 
         # The rename-publish is the part's commit point (encode and
@@ -289,7 +299,8 @@ class MultipartManager:
         data_dir = str(uuid.uuid4())
         mod_time = now()
         udir = _upload_dir(bucket, object_name, upload_id)
-        commit_id = str(uuid.uuid4())
+        # pid-scoped commit staging, same GC contract as the PUT path.
+        commit_id = f"{os.getpid()}.{uuid.uuid4()}"
 
         base_meta = {
             "etag": etag,
@@ -307,11 +318,18 @@ class MultipartManager:
             i, disk = args
             if disk is None:
                 raise errors.DiskNotFound()
+            # Fires with j drives already fully committed (skip=j): the
+            # partial-quorum completion the restart scan must resolve.
+            crash.crash_point("multipart.complete.partial", disk.endpoint())
             row = distribution[i] - 1
             tmp = f"tmp/{commit_id}/{i}"
             # Renumber parts consecutively (S3 semantics: completed part list
             # order defines part numbers 1..N for reads).
             for new_num, p in enumerate(part_infos, start=1):
+                if new_num > 1:
+                    # Some parts moved out of the upload dir into the commit
+                    # staging dir, the rest still in place, no xl.meta yet.
+                    crash.crash_point("multipart.complete.mid-rename", disk.endpoint())
                 disk.rename_file(
                     META_BUCKET, f"{udir}/part.{p.number}", META_BUCKET, f"{tmp}/part.{new_num}"
                 )

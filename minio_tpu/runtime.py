@@ -96,6 +96,55 @@ def _tail(text: str, limit: int = 4000) -> str:
 
 _PROBE_FAIL_TTL_CAP_S = 900.0
 
+# Verdict *transitions* are first-class: a device falling over (ok -> fail,
+# "fallback") and coming back (fail -> ok, "recovery") are the two events an
+# operator actually pages on, and a cache that silently flips between them
+# hides both. Every stored verdict is diffed against the previous one (file
+# or in-memory); the last _TRANSITIONS_KEPT flips ride along in the cache
+# file and the latest is exposed via probe_transition() for bench JSON.
+_TRANSITIONS_KEPT = 8
+_last_transition: dict | None = None
+
+
+def _transition_between(prev_platform, result: "ProbeResult") -> dict | None:
+    """A fallback/recovery record when the ok-ness flipped, else None."""
+    prev_ok = prev_platform not in (None, "cpu")
+    if prev_ok == result.ok:
+        return None
+    return {
+        "time": time.time(),
+        "kind": "recovery" if result.ok else "fallback",
+        "from": prev_platform,
+        "to": result.platform,
+    }
+
+
+def _note_transition(t: dict | None) -> None:
+    global _last_transition
+    if t is not None:
+        with _probe_lock:
+            _last_transition = t
+
+
+def probe_transition() -> dict | None:
+    """The most recent ok<->fail probe transition, or None if the verdict
+    has never flipped. In-process memory first, then the cross-process
+    cache file -- so a fresh bench process still reports the fallback (or
+    recovery) that the verdict it inherited went through."""
+    with _probe_lock:
+        if _last_transition is not None:
+            return dict(_last_transition)
+    path = _probe_cache_file()
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    t = doc.get("transition")
+    return t if isinstance(t, dict) else None
+
 
 def _probe_cache_file() -> str:
     return os.environ.get("MTPU_PROBE_CACHE", "")
@@ -146,12 +195,31 @@ def _store_probe_file(result: ProbeResult) -> None:
     path = _probe_cache_file()
     if not path:
         return
+    # Diff against whatever verdict the file held -- even a stale one: a
+    # flip across a TTL expiry is still a flip worth surfacing.
+    transitions: list = []
+    try:
+        with open(path) as f:
+            old = json.load(f)
+        if isinstance(old, dict):
+            prior = old.get("transitions")
+            if isinstance(prior, list):
+                transitions = [t for t in prior if isinstance(t, dict)]
+            t = _transition_between(old.get("platform") or None, result)
+            if t is not None:
+                transitions.append(t)
+                _note_transition(t)
+    except (OSError, ValueError):
+        pass
+    transitions = transitions[-_TRANSITIONS_KEPT:]
     doc = {
         "time": time.time(),
         "platform": result.platform,
         "device_kind": result.device_kind,
         "error": result.error,
         "detail": _tail(result.detail, 2000),
+        "transitions": transitions,
+        "transition": transitions[-1] if transitions else None,
     }
     tmp = f"{path}.tmp.{os.getpid()}"
     try:
@@ -269,7 +337,10 @@ def _probe_uncached(timeout_s: float) -> ProbeResult:
                 None, error=f"probe exit={proc.returncode}", detail=detail
             )
     with _probe_lock:
+        prev = _probe_cache
         _probe_cache = result
+    if prev is not None:
+        _note_transition(_transition_between(prev.platform, result))
     _store_probe_file(result)
     return result
 

@@ -69,9 +69,19 @@ class DriveFormat:
         p = self.path(drive_root)
         os.makedirs(os.path.dirname(p), exist_ok=True)
         tmp = p + ".tmp"
+        # format.json is the drive's identity: lose it to a torn write and
+        # the drive is unformatted on restart. Written once at init, so the
+        # barrier is unconditional (not gated on MTPU_FSYNC).
         with open(tmp, "w") as f:
             f.write(self.to_json())
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, p)
+        dfd = os.open(os.path.dirname(p), os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
 
     @classmethod
     def load(cls, drive_root: str) -> "DriveFormat | None":

@@ -16,9 +16,25 @@ cmd/erasure-object.go:990): shard files are staged under tmp/ and the whole
 data dir is os.rename()d into the object dir, then xl.meta is replaced via a
 tmp-file + os.replace -- readers never observe a half-written object.
 
-Durability: fsync on commit is configurable (o_sync); O_DIRECT-aligned IO
-lives in the native C++ layer (native/) once built, this module is the
-portable fallback.
+Durability is a knob, `MTPU_FSYNC={always,commit,never}` (default `commit`),
+mirroring the reference's drive-sync discipline:
+
+  * ``commit``  -- fdatasync staged shard data BEFORE the xl.meta that names
+                   it exists (rename_data), fdatasync the staged xl.meta image
+                   before os.replace publishes it, and fsync the parent dirs
+                   so the rename itself is durable. Acked writes survive a
+                   crash at any boundary; the staging appends stay unsynced.
+  * ``always``  -- additionally fdatasync every shard append as it lands
+                   (the O_DSYNC-style mode; what `LocalDrive(fsync=True)`
+                   always did, now metered).
+  * ``never``   -- no barriers anywhere: the PR-9 throughput profile, for
+                   benchmarking the sync cost and for tests on tmpfs.
+
+Every barrier is metered as the ("storage", "drive-sync") perf-ledger stage
+so bench JSON shows what durability costs. Crash points
+(chaos/crash.py) sit on the two storage-internal boundaries -- after the
+data-dir rename / before xl.meta, and after the staged xl.meta / before
+os.replace -- plus the mid-writev torn-write hook in append_iov.
 """
 
 from __future__ import annotations
@@ -26,8 +42,11 @@ from __future__ import annotations
 import os
 import shutil
 import threading
+import time
 from dataclasses import dataclass
 
+from ..chaos import crash
+from ..control.perf import GLOBAL_PERF
 from ..utils import errors
 from .format import SYS_DIR, DriveFormat
 from .interface import StorageAPI
@@ -38,6 +57,51 @@ from ..control.sanitizer import san_lock, san_rlock
 TMP_DIR = os.path.join(SYS_DIR, "tmp")
 BUCKETS_META_DIR = os.path.join(SYS_DIR, "buckets")
 XL_META_FILE = "xl.meta"
+
+FSYNC_ALWAYS = "always"
+FSYNC_COMMIT = "commit"
+FSYNC_NEVER = "never"
+
+
+def fsync_mode() -> str:
+    """The process-wide durability mode from MTPU_FSYNC (default: commit)."""
+    mode = os.environ.get("MTPU_FSYNC", FSYNC_COMMIT).strip().lower()
+    return mode if mode in (FSYNC_ALWAYS, FSYNC_COMMIT, FSYNC_NEVER) else FSYNC_COMMIT
+
+
+def _sync_fd(fd: int, *, datasync: bool = True) -> None:
+    """Metered sync barrier: every fdatasync/fsync the durability discipline
+    issues lands in the ("storage", "drive-sync") ledger stage."""
+    t0 = time.perf_counter()
+    (os.fdatasync if datasync else os.fsync)(fd)
+    GLOBAL_PERF.ledger.record("storage", "drive-sync", time.perf_counter() - t0)
+
+
+def _sync_path(p: str, *, datasync: bool = True) -> None:
+    try:
+        fd = os.open(p, os.O_RDONLY)
+    except OSError:
+        return  # vanished or unsyncable: the rename/commit will surface it
+    try:
+        _sync_fd(fd, datasync=datasync)
+    finally:
+        os.close(fd)
+
+
+def _sync_dir(p: str) -> None:
+    """fsync a directory so renames/creates inside it are durable (dir
+    entries are metadata: full fsync, not fdatasync)."""
+    _sync_path(p, datasync=False)
+
+
+def _sync_tree(root: str) -> None:
+    """fdatasync every file under root, then fsync the dirs bottom-up: the
+    pre-commit barrier that makes a staged data dir durable before the
+    xl.meta naming it can exist."""
+    for dirpath, _dirnames, filenames in os.walk(root, topdown=False):
+        for n in filenames:
+            _sync_path(os.path.join(dirpath, n))
+        _sync_dir(dirpath)
 
 # Volumes (buckets) must not collide with the system dir or look like paths.
 _RESERVED_VOLS = {SYS_DIR, "", ".", ".."}
@@ -69,6 +133,11 @@ class LocalDrive(StorageAPI):
         # Native O_DIRECT path for large shard files (xl-storage.go:1708
         # CopyAligned; probed per drive like internal/disk's O_DIRECT check).
         self._odirect: bool | None = None
+
+    def _mode(self) -> str:
+        """Effective durability mode: LocalDrive(fsync=True) pins `always`
+        (the pre-knob behaviour); otherwise MTPU_FSYNC decides."""
+        return FSYNC_ALWAYS if self.fsync else fsync_mode()
 
     def _use_native_io(self, size: int) -> bool:
         if size < ODIRECT_THRESHOLD:
@@ -175,7 +244,20 @@ class LocalDrive(StorageAPI):
     # -- small whole files (config, format, system state) --------------------
 
     def write_all(self, volume: str, path: str, data: bytes) -> None:
-        p = self._file_path(volume, path)
+        # Plain small-file writes (config, bookkeeping) only barrier in
+        # `always` mode; xl.meta commits go through _write_xl below.
+        self._write_all(
+            self._file_path(volume, path), data,
+            barrier=self._mode() == FSYNC_ALWAYS,
+        )
+
+    def _write_all(
+        self, p: str, data: bytes, barrier: bool, commit_point: str | None = None
+    ) -> None:
+        """Atomic whole-file write: stage `<p>.tmp<rand>`, optionally
+        fdatasync it, os.replace into place, optionally fsync the parent so
+        the replace is durable. `commit_point` names the crash point fired
+        between the durable staged image and the publishing replace."""
         tmp = p + ".tmp" + os.urandom(4).hex()
         try:
             f = open(tmp, "wb")
@@ -184,10 +266,14 @@ class LocalDrive(StorageAPI):
             f = open(tmp, "wb")
         with f:
             f.write(data)
-            if self.fsync:
+            if barrier:
                 f.flush()
-                os.fsync(f.fileno())
+                _sync_fd(f.fileno())
+        if commit_point is not None:
+            crash.crash_point(commit_point, self.root)
         os.replace(tmp, p)
+        if barrier:
+            _sync_dir(os.path.dirname(p))
 
     def read_all(self, volume: str, path: str) -> bytes:
         p = self._file_path(volume, path)
@@ -240,16 +326,17 @@ class LocalDrive(StorageAPI):
 
             try:
                 native.write_file(
-                    p, data, use_odirect=bool(self._odirect), fsync=self.fsync
+                    p, data, use_odirect=bool(self._odirect),
+                    fsync=self._mode() == FSYNC_ALWAYS,
                 )
                 return
             except OSError:
                 pass  # native path failed; buffered fallback below
         with open(p, "wb") as f:
             f.write(data)
-            if self.fsync:
+            if self._mode() == FSYNC_ALWAYS:
                 f.flush()
-                os.fsync(f.fileno())
+                _sync_fd(f.fileno())
 
     # (append_file below opens first and only mkdirs on ENOENT; create_file
     # keeps the eager makedirs because its native O_DIRECT branch reports a
@@ -267,10 +354,19 @@ class LocalDrive(StorageAPI):
             f = open(p, "ab")
         with f:
             f.write(data)
+            if self._mode() == FSYNC_ALWAYS:
+                f.flush()
+                _sync_fd(f.fileno())
 
     def append_iov(self, volume: str, path: str, iovecs: list) -> None:
         """Gathered append: the whole group's digest/chunk views go down in
-        one os.writev (releases the GIL) instead of per-block appends."""
+        one os.writev (releases the GIL) instead of per-block appends.
+
+        The torn-write crash point lives here: an armed spec truncates the
+        LAST iovec at a seeded offset before the writev -- the at-rest state
+        a power-cut / SIGKILL mid-writev leaves -- then either dies
+        (torn-kill) or returns normally (torn: silent corruption the bitrot
+        digests must catch on read)."""
         p = self._file_path(volume, path)
         try:
             fd = os.open(p, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
@@ -279,6 +375,15 @@ class LocalDrive(StorageAPI):
             fd = os.open(p, os.O_WRONLY | os.O_APPEND | os.O_CREAT, 0o644)
         try:
             vecs = [memoryview(v) for v in iovecs if len(v)]
+            torn_kill = False
+            if vecs:
+                hint = crash.torn_hint(
+                    "storage.append-iov.torn", self.root, len(vecs[-1])
+                )
+                if hint is not None:
+                    cut, torn_kill = hint
+                    vecs[-1] = vecs[-1][:cut]
+                    vecs = [v for v in vecs if len(v)]
             while vecs:
                 written = os.writev(fd, vecs)
                 # Short writev: drop fully-written vecs, trim the partial one.
@@ -287,6 +392,10 @@ class LocalDrive(StorageAPI):
                     vecs.pop(0)
                 if written:
                     vecs[0] = vecs[0][written:]
+            if torn_kill:
+                crash.die()
+            if self._mode() == FSYNC_ALWAYS:
+                _sync_fd(fd)
         finally:
             os.close(fd)
 
@@ -329,6 +438,17 @@ class LocalDrive(StorageAPI):
     def _meta_path(self, volume: str, path: str) -> str:
         return self._file_path(volume, os.path.join(path, XL_META_FILE))
 
+    def _write_xl(self, volume: str, path: str, data: bytes) -> None:
+        """Publish a new xl.meta image: the commit point of every version
+        change. Barriered in `commit` and `always` modes, with the
+        storage.xlmeta.pre-replace crash point between the durable staged
+        image and the os.replace that makes it visible."""
+        self._write_all(
+            self._meta_path(volume, path), data,
+            barrier=self._mode() != FSYNC_NEVER,
+            commit_point="storage.xlmeta.pre-replace",
+        )
+
     def read_xl(self, volume: str, path: str) -> XLMeta:
         try:
             raw = self.read_all(volume, os.path.join(path, XL_META_FILE))
@@ -353,7 +473,7 @@ class LocalDrive(StorageAPI):
             # mtpulint: disable=lock-blocking-io -- the read-modify-write of
             # xl.meta IS the critical section; dropping the lock before the
             # write would let a concurrent writer interleave a stale image.
-            self.write_all(volume, os.path.join(path, XL_META_FILE), meta.to_bytes())
+            self._write_xl(volume, path, meta.to_bytes())
 
     def update_metadata(self, volume: str, path: str, fi: FileInfo) -> None:
         with self._meta_lock:
@@ -361,7 +481,7 @@ class LocalDrive(StorageAPI):
             meta.find_version(fi.version_id)  # must exist
             meta.add_version(fi)
             # mtpulint: disable=lock-blocking-io -- see write_metadata
-            self.write_all(volume, os.path.join(path, XL_META_FILE), meta.to_bytes())
+            self._write_xl(volume, path, meta.to_bytes())
 
     def delete_version(self, volume: str, path: str, fi: FileInfo) -> None:
         """Remove a version; drop data dir; remove object dir when empty.
@@ -382,7 +502,7 @@ class LocalDrive(StorageAPI):
                     pass
             if meta.versions:
                 # mtpulint: disable=lock-blocking-io -- see write_metadata
-                self.write_all(volume, os.path.join(path, XL_META_FILE), meta.to_bytes())
+                self._write_xl(volume, path, meta.to_bytes())
             else:
                 try:
                     self.delete(volume, os.path.join(path, XL_META_FILE))
@@ -396,18 +516,40 @@ class LocalDrive(StorageAPI):
     ) -> None:
         """Commit a staged object: move tmp data dir into the object dir and
         publish the new version in xl.meta (reference RenameData,
-        cmd/xl-storage.go; called from erasure putObject :990)."""
+        cmd/xl-storage.go; called from erasure putObject :990).
+
+        Barrier order (commit/always modes): fdatasync the staged shards +
+        dirs FIRST, then rename, then fsync the object dir, and only then
+        write xl.meta -- so no xl.meta can ever name shard bytes the kernel
+        hasn't been told to keep."""
         dst_obj_dir = self._file_path(dst_volume, dst_path)
         os.makedirs(dst_obj_dir, exist_ok=True)
+        barrier = self._mode() != FSYNC_NEVER
+        src_parent = None
         if fi.data_dir:
             src = self._file_path(src_volume, src_path)
             if not os.path.isdir(src):
                 raise errors.FileNotFound()
+            if barrier:
+                _sync_tree(src)
             dst = os.path.join(dst_obj_dir, fi.data_dir)
             if os.path.isdir(dst):
                 shutil.rmtree(dst)
             os.rename(src, dst)
+            if barrier:
+                _sync_dir(dst_obj_dir)
+            src_parent = os.path.dirname(src)
+        crash.crash_point("storage.rename-data.pre-meta", self.root)
         self.write_metadata(dst_volume, dst_path, fi)
+        # The rename consumed tmp/<stage-id>/<i>; drop the now-empty
+        # <stage-id> parent so committed PUTs leave tmp/ clean (it used to
+        # leak one empty dir per upload per drive -- the recovery scan would
+        # count each as an orphan).
+        if src_parent is not None:
+            try:
+                os.rmdir(src_parent)
+            except OSError:
+                pass  # other shards still staging, or already gone
 
     def rename_file(self, src_volume: str, src_path: str, dst_volume: str, dst_path: str) -> None:
         src = self._file_path(src_volume, src_path)
@@ -415,7 +557,13 @@ class LocalDrive(StorageAPI):
         if not os.path.exists(src):
             raise errors.FileNotFound()
         os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if self._mode() != FSYNC_NEVER and os.path.isfile(src):
+            # Publish-by-rename (multipart part promote): the named bytes
+            # must be durable before the durable name exists.
+            _sync_path(src)
         os.replace(src, dst)
+        if self._mode() != FSYNC_NEVER:
+            _sync_dir(os.path.dirname(dst))
 
     # -- listing / walking ---------------------------------------------------
 

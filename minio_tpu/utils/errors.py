@@ -97,6 +97,14 @@ class DriveBusy(DiskError):
     an overloaded drive sheds to its peers instead of queueing unboundedly."""
 
 
+class CrashInjected(StorageError):
+    """An armed crash point fired in ``raise`` mode (chaos/crash.py): the
+    in-process stand-in for process death used by tests and loadgen
+    scenarios that must outlive the "crash". NOT a DiskError at the object
+    layer -- but the commit fan-out catches it per drive, so a mid-commit
+    raise degrades exactly like that drive dying at the point."""
+
+
 class DeadlineExceeded(StorageError):
     """The request's propagated time budget (X-Mtpu-Deadline) is spent.
     NOT a DiskError: an expired budget says nothing about drive health and

@@ -19,6 +19,13 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Durability barriers off by default in tests: /tmp is a real filesystem
+# here, and ~900 tests x fsync-per-commit would dominate the suite's wall
+# clock without covering anything the crash tests (tests/test_crash.py,
+# tools/crashcheck.py) don't already pin under MTPU_FSYNC=commit. Tests
+# that exercise the barriers set the mode explicitly.
+os.environ.setdefault("MTPU_FSYNC", "never")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

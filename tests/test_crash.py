@@ -1,0 +1,437 @@
+"""Crash-consistency plane tests: the registry, the recovery scan, torn
+writes, worker death, and the crashcheck smoke slice.
+
+tools/crashcheck.py proves the full kill-at-every-point matrix in real
+subprocesses (gated by `chaos_check --invariants`); this file pins the
+pieces in-process where they are cheap and debuggable: CrashSpec/Registry
+semantics (determinism, skip schedules, target filters, raise mode), the
+admin-plane routing of ``kind: "crash"`` specs, the recovery sweeps over
+hand-crafted crash debris, torn-shard writes flowing into bitrot-detect ->
+heal, and a forked "prefork worker" dying mid-PUT whose staging the next
+scan collects.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from minio_tpu.chaos import crash
+from minio_tpu.storage import recovery
+from minio_tpu.utils import errors
+from tests.harness import ErasureHarness
+
+SYS = ".minio_tpu.sys"
+
+
+def _payload(tag: str, size: int) -> bytes:
+    import random
+
+    return random.Random(tag).randbytes(size)
+
+
+def _dead_pid() -> int:
+    """A real-but-dead pid: spawn a no-op child and reap it."""
+    proc = subprocess.Popen([sys.executable, "-c", "pass"])
+    proc.wait()
+    return proc.pid if not recovery._pid_alive(proc.pid) else 999999999
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    crash.REGISTRY.disarm_all()
+    recovery.reset_counters()
+    yield
+    crash.REGISTRY.disarm_all()
+
+
+# ---------------------------------------------------------------------------
+# Registry semantics
+# ---------------------------------------------------------------------------
+
+
+class TestCrashRegistry:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            crash.CrashSpec(point="not.a.point")
+        with pytest.raises(ValueError):
+            crash.CrashSpec(point="put.after-stage", mode="melt")
+        with pytest.raises(ValueError):
+            # only TORN_POINTS accept torn modes
+            crash.CrashSpec(point="put.after-stage", mode=crash.TORN)
+        with pytest.raises(ValueError):
+            crash.CrashSpec(point="put.after-stage", skip=-1)
+        with pytest.raises(ValueError):
+            crash.CrashSpec.from_dict({"mode": "kill"})  # no point
+
+    def test_roundtrip_carries_kind(self):
+        spec = crash.CrashSpec(point="put.mid-commit", mode=crash.RAISE, skip=3)
+        doc = spec.to_dict()
+        assert doc["kind"] == crash.CRASH_KIND
+        again = crash.CrashSpec.from_dict(doc)
+        assert again.point == spec.point and again.skip == 3
+
+    def test_disarmed_is_free_and_inert(self):
+        assert crash.REGISTRY.points is None
+        crash.crash_point("put.after-stage")  # must not raise
+        assert crash.torn_hint("storage.append-iov.torn", "x", 100) is None
+
+    def test_skip_schedule_fires_on_nth_hit(self):
+        reg = crash.CrashRegistry()
+        reg.arm(crash.CrashSpec(point="put.mid-commit", mode=crash.RAISE, skip=2))
+        reg.hit("put.mid-commit")  # skipped
+        reg.hit("put.mid-commit")  # skipped
+        with pytest.raises(errors.CrashInjected):
+            reg.hit("put.mid-commit")
+        assert reg.fired_counts() == {"put.mid-commit": 1}
+
+    def test_target_substring_filter(self):
+        reg = crash.CrashRegistry()
+        reg.arm(crash.CrashSpec(point="put.mid-commit", mode=crash.RAISE, target="disk3"))
+        reg.hit("put.mid-commit", "http://n0/disk1")  # no match: passes
+        with pytest.raises(errors.CrashInjected):
+            reg.hit("put.mid-commit", "/drives/disk3")
+
+    def test_point_filter_and_disarm(self):
+        reg = crash.CrashRegistry()
+        fid = reg.arm(crash.CrashSpec(point="put.before-commit", mode=crash.RAISE))
+        reg.hit("put.after-commit")  # different point: passes
+        assert reg.disarm(fid)
+        reg.hit("put.before-commit")  # disarmed: passes
+        assert reg.points is None
+
+    def test_torn_hint_is_seeded_and_deterministic(self):
+        def draws(seed):
+            reg = crash.CrashRegistry()
+            reg.arm(crash.CrashSpec(
+                point="storage.append-iov.torn", mode=crash.TORN, seed=seed))
+            return [reg.torn_hint("storage.append-iov.torn", "d", 4096)
+                    for _ in range(3)]
+
+        a, b = draws(7), draws(7)
+        assert a == b  # same seed, same cut schedule
+        assert all(h is not None and 0 <= h[0] < 4096 and h[1] is False for h in a)
+        # torn-kill reports kill_after=True
+        reg = crash.CrashRegistry()
+        reg.arm(crash.CrashSpec(
+            point="storage.append-iov.torn", mode=crash.TORN_KILL, seed=7))
+        cut, kill = reg.torn_hint("storage.append-iov.torn", "d", 4096)
+        assert kill is True
+
+    def test_arm_from_env(self):
+        fids = crash.arm_from_env({"MTPU_CRASH": "put.after-stage:raise:2:9"})
+        try:
+            assert len(fids) == 1
+            (armed,) = [s for s in crash.REGISTRY.list() if s["fault_id"] == fids[0]]
+            assert armed["point"] == "put.after-stage"
+            assert armed["mode"] == crash.RAISE
+            assert armed["skip"] == 2 and armed["seed"] == 9
+        finally:
+            crash.REGISTRY.disarm_all()
+        assert crash.arm_from_env({"MTPU_CRASH": ""}) == []
+        with pytest.raises(ValueError):
+            crash.arm_from_env({"MTPU_CRASH": "no-such-point"})
+
+    def test_admin_plane_routes_crash_kind(self):
+        from minio_tpu.loadgen.target import InProcessAdmin
+
+        admin = InProcessAdmin()
+        fid = admin.arm_fault({"kind": "crash", "point": "put.mid-commit",
+                               "mode": "raise"})
+        try:
+            assert any(s["fault_id"] == fid for s in crash.REGISTRY.list())
+        finally:
+            admin.disarm_fault(fid)
+        assert not crash.REGISTRY.list()
+
+    def test_raise_mode_aborts_put_without_killing(self, tmp_path):
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("cb")
+        crash.REGISTRY.arm(crash.CrashSpec(point="put.before-commit", mode=crash.RAISE))
+        data = _payload("raise-mode", (1 << 20) + 17)
+        with pytest.raises(errors.CrashInjected):
+            hz.layer.put_object("cb", "doomed", data)
+        crash.REGISTRY.disarm_all()
+        # The aborted PUT never committed; the name does not exist.
+        with pytest.raises(errors.ObjectNotFound):
+            hz.layer.get_object("cb", "doomed")
+        # The plane still works after the abort.
+        hz.layer.put_object("cb", "ok", data)
+        assert hz.layer.get_object("cb", "ok")[1] == data
+
+
+# ---------------------------------------------------------------------------
+# Recovery sweeps over crafted debris
+# ---------------------------------------------------------------------------
+
+
+class TestRecoveryScan:
+    def test_tmp_dirs_dead_owner_swept_live_owner_kept(self, tmp_path):
+        hz = ErasureHarness(tmp_path, n_disks=4, parity=1)
+        root = hz.dirs[0]
+        dead = _dead_pid()
+        dead_dir = os.path.join(root, SYS, "tmp", f"{dead}.aaaa")
+        live_dir = os.path.join(root, SYS, "tmp", f"{os.getpid()}.bbbb")
+        legacy_dir = os.path.join(root, SYS, "tmp", "no-pid-prefix")
+        for d in (dead_dir, live_dir, legacy_dir):
+            os.makedirs(d)
+            with open(os.path.join(d, "0"), "wb") as f:
+                f.write(b"shard")
+        delta = recovery.recover_drive(hz.drives[0])
+        assert delta["tmp_dirs"] == 2  # dead + legacy (unscoped = collectable)
+        assert not os.path.exists(dead_dir)
+        assert not os.path.exists(legacy_dir)
+        assert os.path.exists(live_dir)  # a live sibling's staging survives
+
+    def test_multipart_stage_files_swept_upload_kept(self, tmp_path):
+        hz = ErasureHarness(tmp_path, n_disks=4, parity=1)
+        root = hz.dirs[0]
+        udir = os.path.join(root, SYS, "multipart", "b", "o", "uid1")
+        os.makedirs(udir)
+        dead = _dead_pid()
+        stale = os.path.join(udir, f"part.1.tmp.{dead}.deadbeef")
+        live = os.path.join(udir, f"part.2.tmp.{os.getpid()}.cafecafe")
+        published = os.path.join(udir, "part.1")
+        for p in (stale, live, published):
+            with open(p, "wb") as f:
+                f.write(b"x")
+        delta = recovery.recover_drive(hz.drives[0])
+        assert delta["stage_files"] == 1
+        assert not os.path.exists(stale)
+        assert os.path.exists(live) and os.path.exists(published)
+
+    def test_volume_sweep_tmp_files_and_orphan_data_dirs(self, tmp_path):
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("rb")
+        data = _payload("sweep", (1 << 20) + 3)
+        hz.layer.put_object("rb", "obj", data)
+        obj_dir = os.path.join(hz.dirs[0], "rb", "obj")
+        # atomic-write staging that never reached os.replace
+        stray = os.path.join(obj_dir, "xl.meta.tmp0badc0de")
+        with open(stray, "wb") as f:
+            f.write(b"half")
+        # a data dir no version references (rename_data died pre-meta)
+        orphan = os.path.join(os.path.dirname(obj_dir), "ghost", "some-uuid")
+        os.makedirs(orphan)
+        with open(os.path.join(orphan, "part.1"), "wb") as f:
+            f.write(b"shard")
+        delta = recovery.recover_drive(hz.drives[0])
+        assert delta["tmp_files"] == 1 and not os.path.exists(stray)
+        assert delta["orphan_data_dirs"] == 1
+        assert not os.path.exists(os.path.dirname(orphan))  # empty parent walked
+        # the committed object is untouched
+        assert hz.layer.get_object("rb", "obj")[1] == data
+
+    def test_second_pass_is_idempotent(self, tmp_path):
+        hz = ErasureHarness(tmp_path, n_disks=4, parity=1)
+        root = hz.dirs[0]
+        os.makedirs(os.path.join(root, SYS, "tmp", f"{_dead_pid()}.cccc"))
+        first = recovery.recover_drive(hz.drives[0])
+        assert first["tmp_dirs"] == 1
+        second = recovery.recover_drive(hz.drives[0])
+        assert all(second[k] == 0 for k in second if k != "scans")
+
+    def test_partial_version_above_quorum_feeds_heal(self, tmp_path):
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("pb")
+        data = _payload("heal-me", (1 << 20) + 11)
+        hz.layer.put_object("pb", "partial", data)
+        hz.delete_object_dir(0, "pb", "partial")  # 7/8 holders >= k=6
+        healed = []
+        delta = recovery.recover_set(hz.layer, heal=lambda b, o, v: healed.append((b, o, v)))
+        assert delta["partial_healed"] == 1 and delta["partial_gc"] == 0
+        assert healed and healed[0][0] == "pb" and healed[0][1] == "partial"
+        # drive the heal and confirm convergence back to full width
+        hz.layer.heal_object("pb", "partial", version_id=healed[0][2])
+        assert os.path.exists(hz.xl_meta_file(0, "pb", "partial"))
+        assert hz.layer.get_object("pb", "partial")[1] == data
+
+    def test_partial_version_below_quorum_rolled_back(self, tmp_path):
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("pb")
+        hz.layer.put_object("pb", "torn-ack", _payload("rollback", (1 << 20) + 7))
+        for i in range(1, 8):
+            hz.delete_object_dir(i, "pb", "torn-ack")  # 1/8 < k=6: un-ackable
+        delta = recovery.recover_set(hz.layer, heal=lambda *a: None)
+        assert delta["partial_gc"] == 1 and delta["partial_healed"] == 0
+        with pytest.raises(errors.ObjectNotFound):
+            hz.layer.get_object("pb", "torn-ack")
+
+    def test_below_quorum_left_alone_when_a_drive_is_dark(self, tmp_path):
+        """Rolling-restart guard: rollback needs EVERY drive visible."""
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("pb")
+        hz.layer.put_object("pb", "maybe", _payload("dark", (1 << 20) + 5))
+        for i in range(1, 8):
+            hz.delete_object_dir(i, "pb", "maybe")
+        hz.take_offline(7)
+        delta = recovery.recover_set(hz.layer, heal=lambda *a: None)
+        assert delta["partial_gc"] == 0  # can't prove it never reached quorum
+        assert os.path.exists(hz.xl_meta_file(0, "pb", "maybe"))
+
+
+# ---------------------------------------------------------------------------
+# Torn shard writes -> bitrot detect -> heal (satellite: torn-write coverage)
+# ---------------------------------------------------------------------------
+
+
+TORN = "storage.append-iov.torn"
+
+
+@pytest.mark.parametrize("fsync_env", ["never", "commit", "always"])
+class TestTornWrites:
+    def _arm(self, hz, drive_index: int):
+        crash.REGISTRY.arm(crash.CrashSpec(
+            point=TORN, mode=crash.TORN,
+            target=os.path.basename(hz.dirs[drive_index]), seed=13))
+
+    def _assert_heals_bit_identical(self, hz, bucket, obj, data, torn_disk):
+        # Detection: the torn shard fails its bitrot digest on read and the
+        # decode falls back to parity -- the client still sees exact bytes.
+        assert hz.layer.get_object(bucket, obj)[1] == data
+        hz.layer.heal_object(bucket, obj)
+        # The healed shard must carry real data again: force a read that
+        # NEEDS the formerly-torn drive by downing `parity` other drives.
+        offline = [i for i in range(len(hz.dirs)) if i != torn_disk][:2]
+        hz.take_offline(*offline)
+        try:
+            assert hz.layer.get_object(bucket, obj)[1] == data
+        finally:
+            hz.bring_online(*offline)
+
+    def test_streaming_put(self, tmp_path, monkeypatch, fsync_env):
+        monkeypatch.setenv("MTPU_FSYNC", fsync_env)
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("tb")
+        data = _payload(f"torn-{fsync_env}", (2 << 20) + 4097)
+        self._arm(hz, drive_index=3)
+        hz.layer.put_object("tb", "torn", data)  # torn shard is silent
+        assert crash.REGISTRY.fired_counts().get(TORN, 0) >= 1
+        crash.REGISTRY.disarm_all()
+        self._assert_heals_bit_identical(hz, "tb", "torn", data, torn_disk=3)
+
+    def test_multipart_part(self, tmp_path, monkeypatch, fsync_env):
+        from minio_tpu.object.multipart import MultipartManager
+
+        monkeypatch.setenv("MTPU_FSYNC", fsync_env)
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("tb")
+        mp = MultipartManager(hz.layer)
+        p1 = _payload(f"mp1-{fsync_env}", 5 << 20)
+        p2 = _payload(f"mp2-{fsync_env}", (1 << 20) + 9)
+        uid = mp.new_multipart_upload("tb", "mobj")
+        self._arm(hz, drive_index=5)
+        e1 = mp.put_object_part("tb", "mobj", uid, 1, p1).etag
+        assert crash.REGISTRY.fired_counts().get(TORN, 0) >= 1
+        crash.REGISTRY.disarm_all()
+        e2 = mp.put_object_part("tb", "mobj", uid, 2, p2).etag
+        mp.complete_multipart_upload("tb", "mobj", uid, [(1, e1), (2, e2)])
+        self._assert_heals_bit_identical(hz, "tb", "mobj", p1 + p2, torn_disk=5)
+
+
+# ---------------------------------------------------------------------------
+# Worker death mid-PUT (satellite: prefork stage-file/pool-buffer leak)
+# ---------------------------------------------------------------------------
+
+
+class TestWorkerDeathMidPut:
+    def test_dead_workers_staging_is_swept_not_live(self, tmp_path):
+        """Fork a 'worker', kill it at put.after-stage, and prove the parent
+        (the respawn path runs the same scan via Node.build) collects its
+        staging while the data plane stays intact."""
+        from minio_tpu.utils import bufpool
+
+        hz = ErasureHarness(tmp_path, n_disks=8, parity=2)
+        hz.layer.make_bucket("wb")
+        data = _payload("worker-death", (1 << 20) + 257)
+        hz.layer.put_object("wb", "acked", data)  # committed before the crash
+
+        child = os.fork()
+        if child == 0:
+            # The forked "worker": own layer over the same drives, armed to
+            # die with shards staged but nothing committed. The parent's
+            # drive-IO fan-out pool already has worker threads, and threads
+            # do not survive fork -- submitting to the inherited executor
+            # would hang forever, so the child installs a fresh one (the
+            # real prefork plane forks before any pool spins up).
+            try:
+                from concurrent.futures import ThreadPoolExecutor
+
+                from minio_tpu.object import metadata as meta_mod
+                from minio_tpu.object.erasure import ErasureObjects
+                from minio_tpu.storage.local import LocalDrive
+                from minio_tpu.utils import iopool
+
+                meta_mod._POOL = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="drive-io")
+                iopool._SHARED = None  # rebuild the lane pool with live threads
+                victim_layer = ErasureObjects(
+                    [LocalDrive(d) for d in hz.dirs], parity=2)
+                crash.REGISTRY.arm(crash.CrashSpec(point="put.after-stage"))
+                victim_layer.put_object("wb", "doomed", data)
+            except BaseException:
+                pass
+            os._exit(3)  # only reached if the crash point never fired
+
+        # Bounded reap: a wedged child must fail the test, not hang pytest.
+        status = None
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            pid, st = os.waitpid(child, os.WNOHANG)
+            if pid == child:
+                status = st
+                break
+            time.sleep(0.05)
+        if status is None:
+            os.kill(child, signal.SIGKILL)
+            os.waitpid(child, 0)
+            pytest.fail("forked worker wedged instead of dying at the crash point")
+        assert os.waitstatus_to_exitcode(status) == 137, "worker did not die at the point"
+
+        # Its pid-scoped staging is on the drives...
+        stage_dirs = [
+            os.path.join(d, SYS, "tmp", name)
+            for d in hz.dirs
+            if os.path.isdir(os.path.join(d, SYS, "tmp"))
+            for name in os.listdir(os.path.join(d, SYS, "tmp"))
+            if name.startswith(f"{child}.")
+        ]
+        assert stage_dirs, "worker death left no staged shards to recover"
+        # ...and the restart scan sweeps every one (owner pid is dead now).
+        swept = sum(recovery.recover_drive(d)["tmp_dirs"] for d in hz.drives)
+        assert swept >= len(stage_dirs)
+        assert not any(os.path.exists(p) for p in stage_dirs)
+
+        # Invariants after recovery: acked object intact, name never
+        # half-appears, fresh writes work, no pooled windows leaked here.
+        assert hz.layer.get_object("wb", "acked")[1] == data
+        with pytest.raises(errors.ObjectNotFound):
+            hz.layer.get_object("wb", "doomed")
+        hz.layer.put_object("wb", "after", data)
+        assert hz.layer.get_object("wb", "after")[1] == data
+        assert bufpool.window_pool().outstanding() == 0
+
+
+# ---------------------------------------------------------------------------
+# crashcheck smoke slice (tier-1 face of tools/crashcheck.py)
+# ---------------------------------------------------------------------------
+
+
+class TestCrashcheckSmoke:
+    def test_smoke_points_pass(self, tmp_path):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ, JAX_PLATFORMS="cpu", MTPU_FSYNC="commit")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "crashcheck.py"),
+             "--smoke", "--json"],
+            cwd=root, env=env, capture_output=True, text=True, timeout=600,
+        )
+        assert proc.returncode == 0, f"crashcheck --smoke failed:\n{proc.stdout}\n{proc.stderr}"
+        report = json.loads(proc.stdout[proc.stdout.index("{"):])
+        assert report["failed"] == 0 and len(report["points"]) >= 3

@@ -31,6 +31,7 @@ from tools.mtpulint.rules import (
     TypedErrorsRule,
     UnjoinedThreadRule,
     UnlockedGlobalRule,
+    UnsyncedCommitRule,
 )
 
 
@@ -882,4 +883,94 @@ def test_hot_path_copy_scoped_to_data_plane_files(tmp_path):
                 return bytes(view)
         """,
     }, HotPathCopyRule())
+    assert findings == []
+
+
+# -- unsynced-commit ----------------------------------------------------------
+
+
+def test_unsynced_commit_fires_on_bare_replace(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/x.py": """
+            import os
+
+            def save(p, data):
+                tmp = p + ".tmp"
+                with open(tmp, "w") as f:
+                    f.write(data)
+                os.replace(tmp, p)
+        """,
+    }, UnsyncedCommitRule())
+    assert [f.rule for f in findings] == ["unsynced-commit"]
+    assert findings[0].line == 7
+
+
+def test_unsynced_commit_quiet_with_barrier_in_function(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/x.py": """
+            import os
+
+            def save(p, data):
+                with open(p + ".tmp", "w") as f:
+                    f.write(data)
+                    os.fsync(f.fileno())
+                os.replace(p + ".tmp", p)
+
+            def rename(self, src, dst):
+                self._sync_path(src)
+                os.rename(src, dst)
+                _sync_dir(dst)
+        """,
+    }, UnsyncedCommitRule())
+    assert findings == []
+
+
+def test_unsynced_commit_fsync_mode_call_is_not_a_barrier(tmp_path):
+    # fsync_mode() only *reads* the knob; it must not satisfy the rule.
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            import os
+
+            def save(p):
+                mode = fsync_mode()
+                os.replace(p + ".tmp", p)
+        """,
+    }, UnsyncedCommitRule())
+    assert len(findings) == 1
+
+
+def test_unsynced_commit_nested_def_scopes_are_independent(tmp_path):
+    # The outer function's barrier does not cover a nested commit closure:
+    # the closure runs later, possibly after the barrier's effect is moot.
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/x.py": """
+            import os
+
+            def outer(p, fd):
+                os.fsync(fd)
+
+                def commit():
+                    os.replace(p + ".tmp", p)
+                return commit
+        """,
+    }, UnsyncedCommitRule())
+    assert len(findings) == 1
+
+
+def test_unsynced_commit_scoped_and_suppressible(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/control/x.py": """
+            import os
+
+            def save(p):
+                os.replace(p + ".tmp", p)
+        """,
+        "minio_tpu/object/y.py": """
+            import os
+
+            def save(p):
+                # mtpulint: disable=unsynced-commit -- best-effort file
+                os.replace(p + ".tmp", p)
+        """,
+    }, UnsyncedCommitRule())
     assert findings == []

@@ -316,6 +316,33 @@ class TestReportAndSlo:
         assert row["budget_burn"] == pytest.approx(0.04 / 0.02, rel=1e-3)
         assert row["burn_ok"] is False and row["ok"] is False
 
+    def test_client_errors_burn_counts_4xx(self):
+        sc = parse_scenario(
+            _doc(slo={"GET": {"p99_ms": 100.0, "error_budget": 0.02,
+                              "client_errors_burn": True}})
+        )
+        merged = {
+            "GET": {"ok": 96, "errors": {"4xx:NoSuchKey": 4}, "p99_ms": 50.0}
+        }
+        row = evaluate_slo(sc, merged)["GET"]
+        assert row["budget_burn"] == pytest.approx(0.04 / 0.02, rel=1e-3)
+        assert row["ok"] is False
+
+    def test_get_miss_is_loss_spec_guards(self):
+        # A deleting phase makes every miss ambiguous; an under-prepopulated
+        # keyspace makes misses expected. Both must be typed spec errors.
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(
+                get_miss_is_loss=True,
+                keyspace={"keys": 32, "prepopulate": 32},
+                phases=[{"name": "p", "mix": {"GET": 0.5, "DELETE": 0.5},
+                         "ops": 10}],
+            ))
+        assert "DELETE" in str(ei.value)
+        with pytest.raises(SpecError) as ei:
+            parse_scenario(_doc(get_miss_is_loss=True))
+        assert ei.value.path == "$.keyspace.prepopulate"
+
     def test_zero_budget_uses_cap_sentinel(self):
         sc = parse_scenario(
             _doc(slo={"GET": {"p99_ms": 0, "error_budget": 0.0}})
@@ -362,6 +389,28 @@ class TestReportAndSlo:
         cmp = rep["compare"]
         assert cmp["ratio"] == pytest.approx(4000 / 1600, rel=1e-3)
         assert cmp["reproduced"] is True  # 2.5x >= 2.0
+
+    def test_build_report_acked_object_loss_verdict(self):
+        sc = parse_scenario(_doc(
+            get_miss_is_loss=True,
+            keyspace={"keys": 32, "prepopulate": 32},
+            phases=[{"name": "p", "mix": {"GET": 1.0}, "ops": 10}],
+        ))
+        clean = _phase_result(
+            "p", {"GET": {"ok": 10, "bytes": 100, "errors": {}}},
+            {"GET": [0.01] * 10}, wall_s=1.0,
+        )
+        rep = build_report(sc, [clean], stage_breakdown={}, degrade={})
+        assert rep["acked_object_loss"] == {"get_miss_count": 0, "ok": True}
+        lossy = _phase_result(
+            "p",
+            {"GET": {"ok": 9, "bytes": 90,
+                     "errors": {"4xx:NoSuchKey": 1, "5xx:SlowDownRead": 2}}},
+            {"GET": [0.01] * 12}, wall_s=1.0,
+        )
+        rep = build_report(sc, [lossy], stage_breakdown={}, degrade={})
+        # Only the miss is loss; the sheds are availability, not durability.
+        assert rep["acked_object_loss"] == {"get_miss_count": 1, "ok": False}
 
     def test_build_report_compare_sweep_emits_one_verdict_per_rung(self):
         sc = parse_scenario(

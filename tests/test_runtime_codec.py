@@ -137,3 +137,56 @@ def test_node_build_installs_codec(tmp_path, monkeypatch):
         assert got == body
     finally:
         runtime.shutdown_data_plane(node.codec)
+
+
+# -- probe verdict transitions (fallback / recovery) --------------------------
+
+
+@pytest.fixture
+def _probe_cache_file(tmp_path, monkeypatch):
+    path = str(tmp_path / "probe.json")
+    monkeypatch.setenv("MTPU_PROBE_CACHE", path)
+    monkeypatch.setattr(runtime, "_last_transition", None)
+    return path
+
+
+def test_probe_store_records_fallback_and_recovery(_probe_cache_file):
+    import json
+
+    runtime._store_probe_file(runtime.ProbeResult("tpu", "v5e"))
+    with open(_probe_cache_file) as f:
+        assert json.load(f)["transition"] is None  # first verdict: no flip
+
+    runtime._store_probe_file(runtime.ProbeResult(None, error="wedged"))
+    with open(_probe_cache_file) as f:
+        doc = json.load(f)
+    assert doc["transition"]["kind"] == "fallback"
+    assert doc["transition"]["from"] == "tpu" and doc["transition"]["to"] is None
+
+    runtime._store_probe_file(runtime.ProbeResult("tpu", "v5e"))
+    with open(_probe_cache_file) as f:
+        doc = json.load(f)
+    assert doc["transition"]["kind"] == "recovery"
+    assert [t["kind"] for t in doc["transitions"]] == ["fallback", "recovery"]
+    # the accessor surfaces the latest flip (bench JSON reads this)
+    assert runtime.probe_transition()["kind"] == "recovery"
+
+
+def test_probe_transition_read_from_file_by_fresh_process(_probe_cache_file, monkeypatch):
+    runtime._store_probe_file(runtime.ProbeResult("tpu"))
+    runtime._store_probe_file(runtime.ProbeResult(None, error="x"))
+    # Simulate a fresh process: no in-memory transition, only the file.
+    monkeypatch.setattr(runtime, "_last_transition", None)
+    t = runtime.probe_transition()
+    assert t is not None and t["kind"] == "fallback"
+
+
+def test_probe_same_verdict_is_not_a_transition(_probe_cache_file):
+    import json
+
+    runtime._store_probe_file(runtime.ProbeResult(None, error="a"))
+    runtime._store_probe_file(runtime.ProbeResult("cpu"))  # fail -> cpu: still not ok
+    runtime._store_probe_file(runtime.ProbeResult(None, error="b"))
+    with open(_probe_cache_file) as f:
+        doc = json.load(f)
+    assert doc["transitions"] == [] and doc["transition"] is None

@@ -55,6 +55,20 @@ def main() -> int:
         )
         if proc.returncode != 0:
             return proc.returncode
+        # Crash-consistency gate: enumerate every registered crash point,
+        # kill at each, restart, verify the durability invariants
+        # (tools/crashcheck.py). The full enumeration lives here; tier-1
+        # runs the --smoke slice via tests/test_crash.py.
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.join("tools", "crashcheck.py")],
+                cwd=root, timeout=TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            print(f"chaos_check: crashcheck timed out after {TIMEOUT_S}s", file=sys.stderr)
+            return 124
+        if proc.returncode != 0:
+            return proc.returncode
 
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     cmd = [

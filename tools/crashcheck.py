@@ -1,0 +1,410 @@
+"""crashcheck: enumerate every crash point, die at each, restart, verify.
+
+The CrashMonkey-style harness over the crash-consistency plane: for every
+point in chaos/crash.py's KNOWN_POINTS registry it
+
+  1. builds a fresh 8-drive erasure set and commits ACKED objects (one
+     streaming PUT, one multipart) recording their digests,
+  2. runs a VICTIM subprocess that arms the point (kill mode) and drives the
+     matching workload until the process dies mid-operation (exit 137),
+  3. runs a VERIFY subprocess -- a cold restart: fresh process builds over
+     the same drives, runs the recovery scan, executes queued heals -- and
+     asserts the durability invariants:
+
+       * acked-durability:       every acked object reads back bit-identical
+       * no-partial-visibility:  the un-acked victim object is either absent
+                                 or complete and bit-identical -- never a
+                                 readable prefix, never a quorum error
+       * no-orphans:             a second recovery pass sweeps nothing, and
+                                 no stage/tmp debris survives anywhere on
+                                 the drives
+       * no-leaked-buffers:      a fresh PUT+GET leaves window_pool with
+                                 zero outstanding buffers
+       * quorum-after-heal:      versions the scan queued for heal end up on
+                                 every drive
+
+Crash model: the victim dies by os._exit -- kernel state (page cache,
+completed writes) survives, process state (buffers, locks, threads) is
+lost. That is exactly worker/process death and kill -9; it validates
+commit-protocol ORDERING and ATOMICITY, not power-loss (which would also
+need the fsync barriers MTPU_FSYNC=commit adds -- those are exercised here
+too, but a page-cache-dropping power cut cannot be simulated in-process).
+
+    python tools/crashcheck.py             # full enumeration (chaos_check --invariants)
+    python tools/crashcheck.py --smoke     # 3-point tier-1 slice
+    python tools/crashcheck.py --json      # machine-readable summary
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("MINIO_TPU_CODEC", "host")
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
+
+N_DISKS = 8
+PARITY = 2  # k=6, write quorum 6
+CRASH_EXIT = 137
+VICTIM_TIMEOUT_S = 120
+VERIFY_TIMEOUT_S = 180
+
+# Points whose firing site repeats per drive: skip a couple of fan-out hits
+# so the death leaves genuinely partial cross-drive state.
+_SKIP = {
+    "put.mid-commit": 2,
+    "multipart.part.published": 2,
+    "multipart.complete.partial": 2,
+    "storage.rename-data.pre-meta": 2,
+    "storage.xlmeta.pre-replace": 2,
+    "storage.append-iov.torn": 2,
+}
+
+_MODE = {"storage.append-iov.torn": "torn-kill"}
+
+SMOKE_POINTS = ("put.after-stage", "put.mid-commit", "storage.append-iov.torn")
+
+ACKED_PUT = ("b", "acked/put")
+ACKED_MPU = ("b", "acked/mpu")
+VICTIM_PUT = ("b", "crash/victim")
+
+
+def _payload(tag: str, size: int) -> bytes:
+    return random.Random(tag).randbytes(size)
+
+
+def _build_layer(dirs):
+    from minio_tpu.object.erasure import ErasureObjects
+    from minio_tpu.storage.local import LocalDrive
+
+    return ErasureObjects([LocalDrive(d) for d in dirs], parity=PARITY)
+
+
+def _make_drives(work: str) -> list[str]:
+    from minio_tpu.storage import format as fmt
+
+    dirs = [os.path.join(work, f"disk{i}") for i in range(N_DISKS)]
+    for d, f in zip(dirs, fmt.init_format(1, N_DISKS)):
+        os.makedirs(d, exist_ok=True)
+        f.save(d)
+    return dirs
+
+
+def _setup(work: str) -> dict:
+    """Commit the acked objects and record their ground truth."""
+    dirs = _make_drives(work)
+    eo = _build_layer(dirs)
+    eo.make_bucket("b")
+    put_data = _payload("acked-put", 3 * (1 << 20) + 4097)
+    eo.put_object(ACKED_PUT[0], ACKED_PUT[1], put_data)
+
+    from minio_tpu.object.multipart import MultipartManager
+
+    mp = MultipartManager(eo)
+    p1 = _payload("acked-mpu-1", 5 * (1 << 20))
+    p2 = _payload("acked-mpu-2", 1 << 20)
+    uid = mp.new_multipart_upload(ACKED_MPU[0], ACKED_MPU[1])
+    e1 = mp.put_object_part(ACKED_MPU[0], ACKED_MPU[1], uid, 1, p1).etag
+    e2 = mp.put_object_part(ACKED_MPU[0], ACKED_MPU[1], uid, 2, p2).etag
+    mp.complete_multipart_upload(ACKED_MPU[0], ACKED_MPU[1], uid, [(1, e1), (2, e2)])
+
+    state = {
+        "dirs": dirs,
+        "acked": {
+            "/".join(ACKED_PUT): hashlib.sha256(put_data).hexdigest(),
+            "/".join(ACKED_MPU): hashlib.sha256(p1 + p2).hexdigest(),
+        },
+    }
+    with open(os.path.join(work, "state.json"), "w") as f:
+        json.dump(state, f)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# child: victim
+# ---------------------------------------------------------------------------
+
+
+def _victim_main(work: str, point: str, mode: str, skip: int) -> int:
+    """Arm the point, drive the matching workload, die mid-flight. Returning
+    at all means the point never fired -- the parent treats exit 3 as that
+    failure."""
+    from minio_tpu.chaos.crash import REGISTRY, CrashSpec
+
+    with open(os.path.join(work, "state.json")) as f:
+        dirs = json.load(f)["dirs"]
+    eo = _build_layer(dirs)
+
+    REGISTRY.arm(CrashSpec(point=point, mode=mode, skip=skip, seed=7))
+
+    if point.startswith(("put.", "storage.")):
+        eo.put_object(VICTIM_PUT[0], VICTIM_PUT[1], _payload("victim", 3 * (1 << 20) + 11))
+        return 3
+    from minio_tpu.object.multipart import MultipartManager
+
+    mp = MultipartManager(eo)
+    b, o = VICTIM_PUT
+    if point.startswith("multipart.part."):
+        uid = mp.new_multipart_upload(b, o)
+        with open(os.path.join(work, "victim_upload.json"), "w") as f:
+            json.dump({"upload_id": uid}, f)
+        mp.put_object_part(b, o, uid, 1, _payload("victim-part", 5 * (1 << 20)))
+        return 3
+    # multipart.complete.*: full upload, crash inside complete's fan-out.
+    uid = mp.new_multipart_upload(b, o)
+    e1 = mp.put_object_part(b, o, uid, 1, _payload("victim-1", 5 * (1 << 20))).etag
+    e2 = mp.put_object_part(b, o, uid, 2, _payload("victim-2", 1 << 20)).etag
+    mp.complete_multipart_upload(b, o, uid, [(1, e1), (2, e2)])
+    return 3
+
+
+# ---------------------------------------------------------------------------
+# child: verify (the cold restart)
+# ---------------------------------------------------------------------------
+
+
+def _scan_debris(dirs) -> list[str]:
+    """Paths of anything recovery should have removed: stage/tmp files and
+    non-empty tmp/ trees."""
+    out = []
+    for d in dirs:
+        tmp_root = os.path.join(d, ".minio_tpu.sys", "tmp")
+        for dirpath, _dn, files in os.walk(tmp_root):
+            for n in files:
+                out.append(os.path.join(dirpath, n))
+        for dirpath, _dn, files in os.walk(d):
+            if dirpath.startswith(tmp_root):
+                continue
+            for n in files:
+                if ".tmp" in n:
+                    out.append(os.path.join(dirpath, n))
+    return out
+
+
+def _verify_main(work: str, point: str) -> int:
+    from minio_tpu.storage import recovery
+    from minio_tpu.storage.local import LocalDrive
+    from minio_tpu.utils import errors
+    from minio_tpu.utils.bufpool import window_pool
+
+    with open(os.path.join(work, "state.json")) as f:
+        state = json.load(f)
+    dirs = state["dirs"]
+    failures: list[str] = []
+
+    # -- restart recovery: per-drive sweep, then cross-drive reconcile ------
+    for d in dirs:
+        recovery.recover_drive(LocalDrive(d))
+    eo = _build_layer(dirs)
+    heal_q: list[tuple] = []
+    recovery.recover_set(eo, heal=lambda b, o, v: heal_q.append((b, o, v)))
+    for b, o, v in heal_q:
+        try:
+            eo.heal_object(b, o, version_id=v)
+        except errors.StorageError as e:
+            failures.append(f"quorum-after-heal: heal({b}/{o}) raised {type(e).__name__}: {e}")
+
+    # -- acked-durability ---------------------------------------------------
+    for key, digest in state["acked"].items():
+        bucket, obj = key.split("/", 1)
+        try:
+            _oi, body = eo.get_object(bucket, obj)
+        except errors.StorageError as e:
+            failures.append(f"acked-durability: GET {key} raised {type(e).__name__}: {e}")
+            continue
+        if hashlib.sha256(body).hexdigest() != digest:
+            failures.append(f"acked-durability: {key} read back different bytes")
+
+    # -- no-partial-visibility (the victim object) --------------------------
+    if point.startswith(("put.", "storage.")):
+        want = hashlib.sha256(_payload("victim", 3 * (1 << 20) + 11)).hexdigest()
+    else:
+        want = hashlib.sha256(
+            _payload("victim-1", 5 * (1 << 20)) + _payload("victim-2", 1 << 20)
+        ).hexdigest()
+    if not point.startswith("multipart.part."):
+        try:
+            _oi, body = eo.get_object(VICTIM_PUT[0], VICTIM_PUT[1])
+            if hashlib.sha256(body).hexdigest() != want:
+                failures.append("no-partial-visibility: victim readable but NOT bit-identical")
+        except errors.ObjectNotFound:
+            pass  # absent is the other legal outcome
+        except errors.StorageError as e:
+            failures.append(
+                f"no-partial-visibility: victim GET must succeed or be absent, "
+                f"got {type(e).__name__}: {e}"
+            )
+    else:
+        # Part-level crash: the upload must still be listable and hold no
+        # partially published part (a part with shards but no .meta is
+        # invisible to list_parts by design; the stage files must be gone --
+        # the no-orphan check below proves that).
+        from minio_tpu.object.multipart import MultipartManager
+
+        try:
+            with open(os.path.join(work, "victim_upload.json")) as f:
+                uid = json.load(f)["upload_id"]
+            MultipartManager(eo).list_parts(VICTIM_PUT[0], VICTIM_PUT[1], uid)
+        except errors.StorageError as e:
+            failures.append(f"no-partial-visibility: list_parts raised {type(e).__name__}: {e}")
+
+    # -- quorum-after-heal: every healed/acked version on every drive -------
+    for key in state["acked"]:
+        bucket, obj = key.split("/", 1)
+        holders = sum(
+            1 for d in dirs
+            if os.path.isfile(os.path.join(d, bucket, obj, "xl.meta"))
+        )
+        if holders != len(dirs):
+            failures.append(f"quorum-after-heal: {key} xl.meta on {holders}/{len(dirs)} drives")
+
+    # -- no-orphans: a second pass must find nothing ------------------------
+    recovery.reset_counters()
+    for d in dirs:
+        recovery.recover_drive(LocalDrive(d))
+    second = recovery.counters()
+    swept = {k: v for k, v in second.items() if v and k not in ("scans",)}
+    if swept:
+        failures.append(f"no-orphans: second recovery pass still swept {swept}")
+    debris = _scan_debris(dirs)
+    if debris:
+        failures.append(f"no-orphans: debris survived recovery: {debris[:5]}")
+
+    # -- no-leaked-buffers: data plane healthy, pool drained ----------------
+    probe = _payload("probe", 2 * (1 << 20))
+    eo.put_object("b", "post/probe", probe)
+    _oi, body = eo.get_object("b", "post/probe")
+    if hashlib.sha256(body).hexdigest() != hashlib.sha256(probe).hexdigest():
+        failures.append("post-restart PUT/GET roundtrip corrupt")
+    n_out = window_pool().outstanding()
+    if n_out:
+        failures.append(f"no-leaked-buffers: window_pool outstanding={n_out}")
+
+    print(json.dumps({"point": point, "failures": failures}))
+    return 1 if failures else 0
+
+
+# ---------------------------------------------------------------------------
+# parent
+# ---------------------------------------------------------------------------
+
+
+def _child_env() -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.setdefault("MINIO_TPU_CODEC", "host")
+    env.pop("MTPU_CRASH", None)
+    return env
+
+
+def _run_point(point: str, base: str) -> dict:
+    work = os.path.join(base, point.replace(".", "_"))
+    os.makedirs(work, exist_ok=True)
+    result = {"point": point, "ok": False, "victim_exit": None, "failures": []}
+    try:
+        _setup(work)
+    except Exception as e:  # noqa: BLE001 - setup failure is a result, not a crash
+        result["failures"] = [f"setup failed: {type(e).__name__}: {e}"]
+        return result
+
+    mode = _MODE.get(point, "kill")
+    skip = _SKIP.get(point, 0)
+    victim = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", "victim",
+         "--work", work, "--point", point, "--mode", mode, "--skip", str(skip)],
+        cwd=_ROOT, env=_child_env(), timeout=VICTIM_TIMEOUT_S,
+        capture_output=True, text=True,
+    )
+    result["victim_exit"] = victim.returncode
+    if victim.returncode != CRASH_EXIT:
+        why = "point never fired" if victim.returncode == 3 else "unexpected exit"
+        result["failures"] = [
+            f"victim: {why} (exit {victim.returncode}); stderr tail: "
+            f"{victim.stderr.strip()[-400:]}"
+        ]
+        return result
+
+    verify = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child", "verify",
+         "--work", work, "--point", point],
+        cwd=_ROOT, env=_child_env(), timeout=VERIFY_TIMEOUT_S,
+        capture_output=True, text=True,
+    )
+    try:
+        doc = json.loads(verify.stdout.strip().splitlines()[-1])
+        result["failures"] = doc["failures"]
+    except (ValueError, IndexError, KeyError):
+        result["failures"] = [
+            f"verify crashed (exit {verify.returncode}); stderr tail: "
+            f"{verify.stderr.strip()[-400:]}"
+        ]
+    result["ok"] = verify.returncode == 0 and not result["failures"]
+    if result["ok"]:
+        shutil.rmtree(work, ignore_errors=True)
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true", help="tier-1 slice (3 points)")
+    ap.add_argument("--json", action="store_true", help="JSON summary to stdout")
+    ap.add_argument("--point", default="", help="run a single named point")
+    ap.add_argument("--keep", action="store_true", help="keep workdirs of passing points")
+    ap.add_argument("--child", choices=("victim", "verify"), help=argparse.SUPPRESS)
+    ap.add_argument("--work", default="", help=argparse.SUPPRESS)
+    ap.add_argument("--mode", default="kill", help=argparse.SUPPRESS)
+    ap.add_argument("--skip", type=int, default=0, help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child == "victim":
+        return _victim_main(args.work, args.point, args.mode, args.skip)
+    if args.child == "verify":
+        return _verify_main(args.work, args.point)
+
+    from minio_tpu.chaos.crash import KNOWN_POINTS
+
+    points = list(KNOWN_POINTS)
+    if args.smoke:
+        points = list(SMOKE_POINTS)
+    if args.point:
+        if args.point not in KNOWN_POINTS:
+            print(f"unknown point {args.point!r}", file=sys.stderr)
+            return 2
+        points = [args.point]
+
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="crashcheck-")
+    results = []
+    for point in points:
+        r = _run_point(point, base)
+        results.append(r)
+        if not args.json:
+            mark = "PASS" if r["ok"] else "FAIL"
+            print(f"[{mark}] {point} (victim exit {r['victim_exit']})")
+            for f in r["failures"]:
+                print(f"    - {f}")
+    n_fail = sum(1 for r in results if not r["ok"])
+    if args.json:
+        print(json.dumps({"points": results, "failed": n_fail}, indent=2))
+    else:
+        print(f"crashcheck: {len(results) - n_fail}/{len(results)} points pass")
+    if n_fail == 0 and not args.keep:
+        shutil.rmtree(base, ignore_errors=True)
+    elif n_fail:
+        print(f"crashcheck: failing workdirs kept under {base}", file=sys.stderr)
+    return 1 if n_fail else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

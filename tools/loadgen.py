@@ -141,11 +141,18 @@ def main(argv: list[str]) -> int:
     cmp = report.get("compare")
     cmp_blocks = cmp if isinstance(cmp, list) else [cmp] if isinstance(cmp, dict) else []
     cmp_ok = all(b.get("reproduced", True) for b in cmp_blocks)
+    loss = report.get("acked_object_loss")
+    loss_ok = loss.get("ok", True) if isinstance(loss, dict) else True
     if not slo_ok:
         _log("SLO VIOLATED (see report.slo)")
     if not cmp_ok:
         _log("compare block did not reproduce (see report.compare)")
-    return 0 if slo_ok and cmp_ok else 1
+    if not loss_ok:
+        _log(
+            f"ACKED OBJECT LOSS: {loss.get('get_miss_count')} GET(s) hit "
+            "NoSuchKey on a prepopulated, never-deleted key"
+        )
+    return 0 if slo_ok and cmp_ok and loss_ok else 1
 
 
 if __name__ == "__main__":

@@ -3,8 +3,9 @@
 Every rule encodes a structural property PRs 1-4 established and a refactor
 could silently drop: error transport (swallowed-except, typed-errors),
 deadline plumbing (raw-transport, deadline-rebind), lock hygiene
-(lock-blocking-io, unlocked-global), resource lifetime (resource-leak), and
-the observability seams (stage-key, metrics-rendered). Rules are AST-based
+(lock-blocking-io, unlocked-global), resource lifetime (resource-leak),
+durability barriers (unsynced-commit), and the observability seams
+(stage-key, metrics-rendered). Rules are AST-based
 -- they see structure, not text -- so renames and reformatting can't dodge
 them, and suppressions (`# mtpulint: disable=<rule>`) are visible decisions
 in the diff rather than regex blind spots.
@@ -1325,6 +1326,79 @@ class SharedPublishRule(Rule):
 
 
 # ---------------------------------------------------------------------------
+# unsynced-commit
+# ---------------------------------------------------------------------------
+
+
+class UnsyncedCommitRule(Rule):
+    """Atomic rename-commit without a durability barrier in the same function.
+
+    The crash-consistency plane (storage/local.py, MTPU_FSYNC) publishes
+    every durable artifact the same way: write a staged tmp file, sync it,
+    `os.replace`/`os.rename` into place, sync the parent directory. An
+    `os.replace` in storage/ or object/ whose enclosing function never calls
+    any sync primitive (os.fsync, os.fdatasync, the `_sync_*` helpers) is a
+    commit that a crash can tear: the rename may hit disk before the data
+    it publishes. Add the barrier (gated on the fsync mode where the path
+    is hot), or suppress with the justification for a best-effort file
+    (e.g. a rebuildable cache entry)."""
+
+    id = "unsynced-commit"
+    title = "rename/replace commit without a sync barrier in the same function"
+    scope = ("minio_tpu/storage/", "minio_tpu/object/")
+
+    _COMMIT_CALLS = {"os.replace", "os.rename", "os.renames"}
+    # Names that merely *mention* sync without performing one.
+    _NON_BARRIER = {"fsync_mode"}
+
+    @classmethod
+    def _is_barrier(cls, name: str) -> bool:
+        last = name.rsplit(".", 1)[-1]
+        if last in cls._NON_BARRIER:
+            return False
+        return "sync" in last.lower()
+
+    @classmethod
+    def _shallow(cls, node: ast.AST):
+        """Pre-order walk that stays inside one function scope: nested defs
+        get their own pass, so each commit is judged against the barriers
+        of its innermost function only."""
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return
+        for child in ast.iter_child_nodes(node):
+            yield from cls._shallow(child)
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            for fn in ast.walk(ctx.tree):
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                commits: list[ast.Call] = []
+                barriered = False
+                for stmt in fn.body:
+                    for node in self._shallow(stmt):
+                        if not isinstance(node, ast.Call):
+                            continue
+                        name = _call_name(node)
+                        if name in self._COMMIT_CALLS:
+                            commits.append(node)
+                        elif self._is_barrier(name):
+                            barriered = True
+                if barriered:
+                    continue
+                for call in commits:
+                    yield Finding(
+                        self.id, ctx.relpath, call.lineno,
+                        f"{_call_name(call)}(...) publishes a file but "
+                        f"{fn.name!r} never calls a sync barrier -- a crash "
+                        "can commit the rename before the data; sync the "
+                        "staged file (and parent dir), or suppress with the "
+                        "best-effort justification",
+                    )
+
+
+# ---------------------------------------------------------------------------
 # hot-path-copy
 # ---------------------------------------------------------------------------
 
@@ -1459,6 +1533,7 @@ ALL_RULES: list[Rule] = [
     UnjoinedThreadRule(),
     CondWaitLoopRule(),
     SharedPublishRule(),
+    UnsyncedCommitRule(),
     HotPathCopyRule(),
 ]
 
