@@ -515,6 +515,74 @@ def device_metrics(progress: dict | None = None) -> dict:
         pallas_error = f"{type(e).__name__}: {e}"[:500]
     progress["pallas_encode_gibs"] = pallas_gibs
     progress["pallas_error"] = pallas_error
+
+    # Fused XOR-bitmatrix encode + on-device hash in ONE jitted program
+    # (ops/fused.py): what a PUT window actually pays when the Pallas
+    # codec serves.
+    pallas_fused_gibs = 0.0
+    pallas_fused_error = ""
+    if pallas_gibs > 0:
+        try:
+            from minio_tpu.ops import fused as fused_ops
+
+            fdev2 = jax.device_put(jnp.asarray(data[:FUSED_BATCH]))
+            jax.block_until_ready(
+                fused_ops.fused_encode_hash(fdev2, K, M, "pallas", best_hash)
+            )
+            fiters2 = max(4, ITERS // 2)
+            t0 = time.perf_counter()
+            for _ in range(fiters2):
+                r2 = fused_ops.fused_encode_hash(fdev2, K, M, "pallas", best_hash)
+            jax.block_until_ready(r2)
+            pallas_fused_gibs = (
+                FUSED_BATCH * BLOCK * fiters2 / (time.perf_counter() - t0) / (1 << 30)
+            )
+        except Exception as e:  # noqa: BLE001
+            pallas_fused_error = f"{type(e).__name__}: {e}"[:500]
+    progress["pallas_fused_gibs"] = pallas_fused_gibs
+    progress["pallas_fused_error"] = pallas_fused_error
+
+    # Multi-chip fan-out: data-parallel encode over every local device via
+    # shard_map ((n,1,1) mesh — the BatchingDeviceCodec layout). Scaling
+    # efficiency is vs n * the single-chip Pallas number.
+    multichip_gibs = 0.0
+    multichip_eff = 0.0
+    n_dev = len(jax.devices())
+    multichip_error = ""
+    if pallas_gibs > 0 and n_dev > 1:
+        try:
+            from jax.sharding import PartitionSpec as P
+
+            from minio_tpu.parallel import mesh as mesh_lib
+
+            mesh = mesh_lib.make_mesh(n_dev, (n_dev, 1, 1))
+            menc = jax.jit(
+                mesh_lib.shard_map_compat(
+                    pcodec.encode,
+                    mesh=mesh,
+                    in_specs=P("dp", None, None),
+                    out_specs=P("dp", None, None),
+                )
+            )
+            mb = -(-BATCH // n_dev) * n_dev
+            mdata = jax.device_put(
+                jnp.asarray(rng.integers(0, 256, (mb, K, SHARD), dtype=np.uint8)),
+                mesh_lib.data_sharding(mesh),
+            )
+            menc(mdata).block_until_ready()
+            t0 = time.perf_counter()
+            for _ in range(ITERS):
+                mout = menc(mdata)
+            mout.block_until_ready()
+            multichip_gibs = (
+                mb * BLOCK * ITERS / (time.perf_counter() - t0) / (1 << 30)
+            )
+            multichip_eff = multichip_gibs / (pallas_gibs * n_dev)
+        except Exception as e:  # noqa: BLE001
+            multichip_error = f"{type(e).__name__}: {e}"[:500]
+    progress["multichip_encode_gibs"] = multichip_gibs
+    progress["multichip_devices"] = n_dev
+    progress["multichip_scaling_eff"] = round(multichip_eff, 3)
     return {
         "platform": platform,
         "encode_gibs": enc_gibs,
@@ -526,6 +594,12 @@ def device_metrics(progress: dict | None = None) -> dict:
         "hash_errors": hash_errors,
         "pallas_encode_gibs": pallas_gibs,
         "pallas_error": pallas_error,
+        "pallas_fused_gibs": pallas_fused_gibs,
+        "pallas_fused_error": pallas_fused_error,
+        "multichip_encode_gibs": multichip_gibs,
+        "multichip_devices": n_dev,
+        "multichip_scaling_eff": round(multichip_eff, 3),
+        "multichip_error": multichip_error,
     }
 
 
@@ -546,6 +620,27 @@ def emit(payload: dict) -> None:
     print(json.dumps(payload))
 
 
+def xor_schedule_stats() -> dict:
+    """CSE'd XOR-schedule shape for the production geometry (pure host
+    computation -- rides every bench line, device or fallback)."""
+    try:
+        from minio_tpu.ops import bitmatrix
+
+        return bitmatrix.schedule_stats(K, M)
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def kernel_status_line() -> dict:
+    """Honest per-kernel selection report (models/pipeline.kernel_status)."""
+    try:
+        from minio_tpu.models.pipeline import kernel_status
+
+        return kernel_status(K, M)
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def fallback_line(cpu_enc: float, cpu_dec: float, reason: str, probe=None) -> dict:
     line = {
         "metric": f"erasure-encode GiB/s (12+4 @ 1MiB, CPU fallback: {reason})",
@@ -555,6 +650,7 @@ def fallback_line(cpu_enc: float, cpu_dec: float, reason: str, probe=None) -> di
         "device": False,
         "cpu_avx2_gibs": round(cpu_enc, 3),
         "cpu_decode_recon4_gibs": round(cpu_dec, 3),
+        "xor_schedule": xor_schedule_stats(),
     }
     if probe is not None:
         # The probe evidence (relay-reachability lines + faulthandler dump)
@@ -689,6 +785,14 @@ def device_line(dm: dict, cpu_enc: float, cpu_dec: float, obj: dict) -> dict:
         "hash_errors": dm.get("hash_errors", {}),
         "pallas_encode_gibs": round(dm.get("pallas_encode_gibs", 0.0), 3),
         "pallas_error": dm.get("pallas_error", ""),
+        "pallas_fused_gibs": round(dm.get("pallas_fused_gibs", 0.0), 3),
+        "pallas_fused_error": dm.get("pallas_fused_error", ""),
+        "multichip_encode_gibs": round(dm.get("multichip_encode_gibs", 0.0), 3),
+        "multichip_devices": dm.get("multichip_devices", 1),
+        "multichip_scaling_eff": dm.get("multichip_scaling_eff", 0.0),
+        "multichip_error": dm.get("multichip_error", ""),
+        "xor_schedule": xor_schedule_stats(),
+        "kernel_status": kernel_status_line(),
         "decode_recon4_gibs": round(dm["decode_recon4_gibs"], 3),
         "cpu_decode_recon4_gibs": round(cpu_dec, 3),
         "decode_vs_baseline": (

@@ -551,6 +551,8 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
         reset = q.get("reset", "") in ("1", "true")
         cluster = q.get("cluster", "") in ("1", "true")
 
+        from .. import runtime
+
         snap = GLOBAL_PERF.ledger.snapshot()
         out: dict = {
             "node": {"stages": summarize(snap)},
@@ -558,6 +560,11 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             # Degradation-ladder counters (hedges fired/won, breaker trips,
             # sheds): an SLO report needs these next to the latency tails.
             "degrade": GLOBAL_DEGRADE.snapshot(),
+            # Device-probe posture: verdict, fallback/recovery flips, and
+            # whether the recovery re-probe daemon is armed -- a perf report
+            # that says "PUT is slow" must also say "this node is on the CPU
+            # codec and will retry the device in N seconds".
+            "probe": runtime.probe_summary(),
         }
 
         drives = {}

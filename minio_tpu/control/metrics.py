@@ -347,6 +347,17 @@ class MetricsSys:
                 help_="1 when the probe found a usable accelerator.",
                 type_="gauge",
             )
+        # Verdict flips (ok->fail "fallback", fail->ok "recovery"): the two
+        # probe events an operator pages on, counted per process.
+        for kind, n in sorted(runtime.probe_transition_counts().items()):
+            metric("minio_tpu_device_probe_transitions_total", n, {"kind": kind},
+                   help_="Probe verdict flips seen by this process.")
+        metric(
+            "minio_tpu_device_probe_recovery_interval_seconds",
+            runtime._recovery_interval_s(),
+            help_="Recovery re-probe cadence (MTPU_PROBE_RECOVERY_S; <=0 = off).",
+            type_="gauge",
+        )
         # Native host-kernel availability WITHOUT triggering a load: a
         # scrape must never kick off the g++ build path. Rendered before
         # the device-codec section so it exists on host-codec nodes too.
@@ -407,6 +418,27 @@ class MetricsSys:
                       "device verify compile cache (capped at 8).",
                 type_="gauge",
             )
+        # Multi-chip fan-out: mesh width and per-chip share of encoded
+        # blocks (the ISSUE's per-chip occupancy -- exposes dp imbalance
+        # when batch sizes don't tile the mesh).
+        if "mesh_devices" in st:
+            metric("minio_tpu_codec_mesh_devices", st["mesh_devices"],
+                   help_="Devices the encode mesh fans batches over (1 = single-device).",
+                   type_="gauge")
+            for chip, blocks in enumerate(st.get("chip_blocks", [])):
+                metric("minio_tpu_codec_chip_blocks_total", blocks,
+                       {"chip": str(chip)},
+                       help_="Real blocks encoded per data-parallel mesh group.")
+        if "small_blocks_encoded" in st:
+            metric("minio_tpu_codec_small_blocks_encoded_total",
+                   st["small_blocks_encoded"],
+                   help_="Sub-block objects encoded via the coalesced small-object path.")
+            metric("minio_tpu_codec_small_batches_total", st["small_batches_run"],
+                   help_="Coalesced small-object device batches launched.")
+            metric("minio_tpu_codec_double_buffered_batches_total",
+                   st["double_buffered_batches"],
+                   help_="Encode batches whose dispatch overlapped the previous "
+                         "batch's device->host readback.")
         depths_fn = getattr(codec, "queue_depths", None)
         if depths_fn is not None:
             for geom, depth in sorted(depths_fn().items()):

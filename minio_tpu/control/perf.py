@@ -90,6 +90,7 @@ STAGES: frozenset = frozenset({
     ("erasure", "erasure.reconstruct"),
     # parallel/batching.py worker-side direct ledger records
     ("codec", "encode-batch"),
+    ("codec", "encode-batch-small"),
     ("codec", "reconstruct-batch"),
     ("codec", "verify-batch"),
     # storage/local.py durability barriers (every fdatasync/fsync the

@@ -69,6 +69,8 @@ LOCK_ORDER: tuple[str, ...] = (
     "IAMSys._lock",            # ... wraps the IAM state lock
     "BatchingDeviceCodec._lock",       # worker/pipeline management ...
     "BatchingDeviceCodec._stats_lock", # ... may publish stats inside
+    "runtime._probe_once_lock",  # probe single-flight ...
+    "runtime._probe_lock",       # ... wraps the verdict/transition state
     # Data-plane pool locks are LEAVES: they guard queue/free-list
     # bookkeeping only (never I/O, never another lock). Any lock may wrap
     # them; they wrap nothing.
@@ -98,6 +100,10 @@ SUPPRESSIONS: tuple[tuple[str, str, str], ...] = (
      "bounded one-shot device warmup (runtime.py); exits on its own"),
     ("leaked-thread", "codec-probe",
      "bounded one-shot background probe (runtime.py); exits on its own"),
+    ("leaked-thread", "codec-reprobe",
+     "periodic recovery re-probe daemon (runtime.py): stopped by the "
+     "_reprobe_stop event in shutdown_data_plane; exits on first good "
+     "verdict"),
     ("leaked-thread", "http-server",
      "uvicorn serving thread lives for the process (cli.py serve)"),
     ("leaked-thread", "pytest_timeout",

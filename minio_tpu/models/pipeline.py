@@ -26,8 +26,10 @@ import numpy as np
 
 import os
 
+from ..ops import bitmatrix
+from ..ops import fused as fused_ops
 from ..ops import highwayhash_jax as hhj
-from ..ops import rs, rs_matrix
+from ..ops import rs, rs_matrix, rs_pallas
 from ..parallel import mesh as mesh_lib
 from ..control.sanitizer import san_lock, san_rlock
 
@@ -40,6 +42,11 @@ _HASH_SELECT: dict[str, dict] = {}
 # first call would otherwise both run the (expensive, jit-compiling) probe
 # and clobber each other's verdict.
 _HASH_SELECT_LOCK = san_lock("pipeline._HASH_SELECT_LOCK")
+
+# Same shape, for the RS encode kernel (XOR-bitmatrix Pallas vs XLA bit-
+# matmul). Separate lock: a hash probe and an rs probe may run concurrently.
+_RS_SELECT: dict[str, dict] = {}
+_RS_SELECT_LOCK = san_lock("pipeline._RS_SELECT_LOCK")
 
 # Production chunk length: the per-shard slice a 1 MiB block / 12 data
 # shards produces (cmd/erasure-utils.go shard math) — the length every
@@ -122,6 +129,102 @@ def hash_selection() -> dict:
         return _HASH_SELECT[backend]
 
 
+def _probe_and_time_rs(backend: str) -> dict:
+    """Correctness-probe the XOR-bitmatrix Pallas encode at production shape,
+    then time it against the XLA GF(2) bit-matmul and select by measurement.
+
+    Mirrors _probe_and_time_hash: the kernel must lower on this backend AND
+    match the XLA path bit-for-bit (which is itself pinned to the golden
+    vectors) at the real (12, 4) x ~87 KiB serving shape before it may
+    serve. Any failure degrades to the XLA matmul with the cause recorded --
+    never a silent 0.0.
+    """
+    sel = {"choice": "xla", "pallas_ok": False, "pallas_gibs": 0.0,
+           "xla_gibs": 0.0, "detail": ""}
+    if backend not in ("tpu", "axon"):
+        sel["detail"] = f"backend={backend}: pallas=interpret-only, xla serves"
+        return sel
+    import time as _time
+
+    rng = np.random.default_rng(11)
+    pc = rs_pallas.RSPallasCodec(12, 4)
+    xc = rs.RSCodec(12, 4)
+    probe = rng.integers(0, 256, (2, 12, _PROBE_CHUNK), dtype=np.uint8)
+    try:
+        got = np.asarray(pc.encode(probe))
+        want = np.asarray(xc.encode(probe))
+        sel["pallas_ok"] = np.array_equal(got, want)
+        if not sel["pallas_ok"]:
+            sel["detail"] = f"pallas encode mismatch at S={_PROBE_CHUNK}"
+            return sel
+    except Exception as e:  # noqa: BLE001 - any lowering/runtime failure
+        sel["detail"] = f"pallas probe failed: {type(e).__name__}: {e}"[:300]
+        return sel
+
+    timing = rng.integers(0, 256, (16, 12, _PROBE_CHUNK), dtype=np.uint8)
+    dev = jax.device_put(jnp.asarray(timing))
+    nbytes = timing.size
+
+    def _gibs(fn):
+        jax.block_until_ready(fn(dev))  # compile
+        t0 = _time.perf_counter()
+        iters = 4
+        for _ in range(iters):
+            out = fn(dev)
+        jax.block_until_ready(out)
+        return nbytes * iters / (_time.perf_counter() - t0) / (1 << 30)
+
+    try:
+        sel["pallas_gibs"] = _gibs(jax.jit(pc.encode))
+        sel["xla_gibs"] = _gibs(jax.jit(xc.encode))
+    except Exception as e:  # noqa: BLE001
+        sel["detail"] = f"timing failed: {type(e).__name__}: {e}"[:300]
+        return sel
+    sel["choice"] = "pallas" if sel["pallas_gibs"] >= sel["xla_gibs"] else "xla"
+    sel["detail"] = (
+        f"measured @S={_PROBE_CHUNK}: pallas={sel['pallas_gibs']:.2f} "
+        f"xla={sel['xla_gibs']:.2f} GiB/s -> {sel['choice']}"
+    )
+    return sel
+
+
+def codec_selection() -> dict:
+    """The cached per-backend RS-kernel probe+timing verdict."""
+    backend = jax.default_backend()
+    with _RS_SELECT_LOCK:
+        if backend not in _RS_SELECT:
+            _RS_SELECT[backend] = _probe_and_time_rs(backend)
+        return _RS_SELECT[backend]
+
+
+def rs_encode_mode() -> str:
+    """Which RS encode kernel serves: "pallas" or "xla".
+
+    MINIO_TPU_RS = xla | pallas | auto (default). Auto probes the
+    XOR-bitmatrix kernel at production shape and serves with whichever
+    measured faster -- cached per backend. XLA serves on CPU and whenever
+    the probe or timing fails.
+    """
+    mode = os.environ.get("MINIO_TPU_RS", "auto").lower()
+    if mode in ("xla", "pallas"):
+        return mode
+    return codec_selection()["choice"]
+
+
+def kernel_status(k: int = 12, m: int = 4) -> dict:
+    """Honest per-kernel status for bench/diagnostics: which kernel serves
+    each stage, why, and what the XOR schedule costs. Never a silent 0.0 --
+    a kernel that can't serve carries its cause in `detail`."""
+    return {
+        "backend": jax.default_backend(),
+        "hash": dict(hash_selection()),
+        "rs": dict(codec_selection()),
+        "hash_mode": os.environ.get("MINIO_TPU_HASH", "auto").lower(),
+        "rs_mode": rs_encode_mode(),
+        "xor_schedule": bitmatrix.schedule_stats(k, m),
+    }
+
+
 def hash_batch_fn():
     """The device hash implementation the pipeline serves with.
 
@@ -170,6 +273,7 @@ class ErasurePipeline:
         self.geom = geometry
         self.mesh = mesh
         self.codec = rs.RSCodec(geometry.data, geometry.parity)
+        self.rs_impl = "xla"  # resolved for real in _build_encode
         self._encode_fn = self._build_encode()
 
     # -- encode ------------------------------------------------------------
@@ -177,19 +281,22 @@ class ErasurePipeline:
     def _build_encode(self):
         geom = self.geom
         mesh = self.mesh
-        # Resolved at build time so the probe+timing selection pass runs
+        # Resolved at build time so the probe+timing selection passes run
         # here, as plain device work — never inside a jit trace.
         hash_fn = hash_batch_fn()
-
-        def encode_step(data_shards: jax.Array):
-            """[B, K, S] -> ([B, K+M, S] shards, [B, K+M, 32] digests)."""
-            all_shards = self.codec.encode_all(data_shards)
-            b, t, s = all_shards.shape
-            digests = hash_fn(all_shards.reshape(b * t, s)).reshape(b, t, 32)
-            return all_shards, digests
+        self.rs_impl = rs_encode_mode()
+        dev_codec = (
+            rs_pallas.RSPallasCodec(geom.data, geom.parity)
+            if self.rs_impl == "pallas"
+            else self.codec
+        )
+        # Parity-only step for the small-object coalescing path: those
+        # batches are padded on the shard-byte axis, so their digests are
+        # host-computed at true lengths and the device only owes parity.
+        self._parity_fn = jax.jit(dev_codec.encode)
 
         if mesh is None:
-            return jax.jit(encode_step)
+            return jax.jit(fused_ops.make_step(dev_codec.encode_all, hash_fn))
 
         # Mesh path: explicit SPMD. The erasure matmul is pointwise in the
         # byte axis so it runs sp-sharded with no communication; the
@@ -213,8 +320,14 @@ class ErasurePipeline:
         # dropping the Pallas kernel on the scaling path.
 
         def encode_local(data_local: jax.Array):
-            # data_local: [B/dp, K, S/sp], replicated over tp.
-            parity = rs.gf_matmul(data_local, jnp.asarray(w_parity))
+            # data_local: [B/dp, K, S/sp], replicated over tp. The RS kernel
+            # choice rides into the shard_map body: the XOR-bitmatrix Pallas
+            # kernel is pointwise in the byte axis exactly like the matmul,
+            # so it runs sp-sharded with no extra communication.
+            if self.rs_impl == "pallas":
+                parity = dev_codec.encode(data_local)
+            else:
+                parity = rs.gf_matmul(data_local, jnp.asarray(w_parity))
             all_local = jnp.concatenate([data_local, parity], axis=1)
             # Barrier: without it XLA keeps the parameter-aliasing data rows
             # and the freshly computed parity rows in different layouts, and
@@ -231,17 +344,26 @@ class ErasurePipeline:
             )
             return all_local, digests
 
-        mapped = jax.shard_map(
+        mapped = mesh_lib.shard_map_compat(
             encode_local,
             mesh=mesh,
             in_specs=mesh_lib.data_spec(),
             out_specs=(mesh_lib.shard_output_spec(), mesh_lib.digest_spec()),
-            check_vma=False,
         )
         return jax.jit(mapped)
 
     def encode(self, data_shards) -> tuple[jax.Array, jax.Array]:
         return self._encode_fn(data_shards)
+
+    def encode_parity(self, data_shards) -> jax.Array:
+        """[B, K, S] -> [B, M, S] parity only, no digests.
+
+        The small-object coalescing path pads the shard-BYTE axis to a
+        bucketed length; GF(2^8) math is per byte position, so the parity
+        prefix at the true length is bit-exact, but digests of padded rows
+        would be wrong -- the caller hashes host-side at true lengths.
+        """
+        return self._parity_fn(data_shards)
 
     # -- decode / heal -----------------------------------------------------
 
@@ -266,11 +388,19 @@ class ErasurePipeline:
         Degraded GETs don't need digests of the rebuilt rows -- skipping the
         hash halves the device work on that path; heal keeps it fused.
         """
-        w = jnp.asarray(self._recon_weights(present, want))
         # hash_fn resolved here (probe runs outside the trace) and passed as
         # a static arg: both candidates are stable module-level functions, so
         # the jit cache keys cleanly on the selection.
         hash_fn = hash_batch_fn() if with_digests else None
+        if self.rs_impl == "pallas":
+            # Reconstruct variant of the XOR-bitmatrix kernel: same kernel,
+            # reconstruction coefficients compiled to their own cached
+            # schedule (a static jit arg, like the hash selection).
+            sched = bitmatrix.schedule_for_coeffs(
+                rs_matrix.reconstruct_rows(self.geom.data, self.geom.parity, present, want)
+            )
+            return _reconstruct_sched_step(survivors, sched, hash_fn)
+        w = jnp.asarray(self._recon_weights(present, want))
         return _reconstruct_step(survivors, w, hash_fn)
 
     def verify_digests(self, shards) -> jax.Array:
@@ -282,6 +412,16 @@ class ErasurePipeline:
 @functools.partial(jax.jit, static_argnums=(2,))
 def _reconstruct_step(survivors: jax.Array, w_bits: jax.Array, hash_fn):
     rebuilt = rs.gf_matmul(survivors, w_bits)
+    if hash_fn is None:
+        return rebuilt, None
+    b, r, s = rebuilt.shape
+    digests = hash_fn(rebuilt.reshape(b * r, s)).reshape(b, r, 32)
+    return rebuilt, digests
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _reconstruct_sched_step(survivors: jax.Array, sched, hash_fn):
+    rebuilt = rs_pallas._apply_sched(jnp.asarray(survivors), sched)
     if hash_fn is None:
         return rebuilt, None
     b, r, s = rebuilt.shape

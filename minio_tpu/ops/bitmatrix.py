@@ -1,0 +1,224 @@
+"""GF(2^8) -> GF(2) bitmatrix lift + XOR-schedule compiler.
+
+Reed-Solomon over GF(2^8) multiplies shard bytes by constants from the
+generator matrix. Each constant multiply is linear over GF(2), so the whole
+[R, K] byte matrix expands to an [K*8, R*8] binary matrix (rs_matrix.
+bit_expand) and encode becomes pure XOR of input *bit-planes* -- the op
+family Mosaic actually supports (the old kernel needed unsigned reductions,
+which it does not; see ops/rs_pallas.py).
+
+This module compiles that bitmatrix into an explicit XOR schedule:
+
+  * inputs   0 .. n_inputs-1   = bit-plane b of data shard k (id = k*8 + b)
+  * temps    n_inputs ..        = ops[i] := node[a] ^ node[b]
+  * roots    one node id per output bit-row (r*8 + b_out), -1 for a zero row
+
+Common subexpressions are eliminated across rows with Paar's greedy
+algorithm (the cross-row CSE of arXiv:2108.02692 "Accelerating XOR-based
+Erasure Coding using Program Optimization Techniques"): repeatedly fold the
+pair of nodes that co-occurs in the most rows into a shared temp, then
+balanced-tree the remainders for log depth. Schedules are cached per
+coefficient matrix, so each (k, m) geometry pays compilation once per
+process.
+
+The schedule is a frozen (hashable) dataclass so jitted kernels can take it
+as a static argument.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import threading
+
+import numpy as np
+
+from . import rs_matrix
+
+
+@dataclasses.dataclass(frozen=True)
+class XorSchedule:
+    """A straight-line XOR program over input bit-planes."""
+
+    n_inputs: int  # K*8 input bit-planes
+    n_rows: int  # R*8 output bit-rows
+    ops: tuple[tuple[int, int], ...]  # temp n_inputs+i := node[a] ^ node[b]
+    roots: tuple[int, ...]  # node id per output bit-row; -1 => zero row
+    naive_xors: int  # XOR count without any sharing
+    depth: int  # longest dependency chain (inputs are depth 0)
+
+    @property
+    def scheduled_xors(self) -> int:
+        return len(self.ops)
+
+    @property
+    def cse_saved(self) -> int:
+        return self.naive_xors - len(self.ops)
+
+    def stats(self) -> dict:
+        return {
+            "inputs": self.n_inputs,
+            "rows": self.n_rows,
+            "naive_xors": self.naive_xors,
+            "scheduled_xors": self.scheduled_xors,
+            "cse_saved": self.cse_saved,
+            "depth": self.depth,
+        }
+
+
+def _pair(a: int, b: int) -> tuple[int, int]:
+    return (a, b) if a < b else (b, a)
+
+
+def _compile(rows: list[set[int]], n_inputs: int) -> XorSchedule:
+    """Paar greedy CSE, then balanced-tree reduction of what remains."""
+    rows = [set(r) for r in rows]
+    naive = sum(max(0, len(r) - 1) for r in rows)
+
+    counts: dict[tuple[int, int], int] = {}
+
+    def bump(p: tuple[int, int], d: int) -> None:
+        c = counts.get(p, 0) + d
+        if c:
+            counts[p] = c
+        else:
+            counts.pop(p, None)
+
+    for row in rows:
+        srt = sorted(row)
+        for i in range(len(srt)):
+            for j in range(i + 1, len(srt)):
+                bump((srt[i], srt[j]), 1)
+
+    ops: list[tuple[int, int]] = []
+    depth: list[int] = [0] * n_inputs
+    nid = n_inputs
+
+    # Phase 1: fold the most-shared pair into a temp while any pair is
+    # shared by >= 2 rows. Identical rows converge to the same root for free.
+    while True:
+        best, bc = None, 1
+        for p, c in counts.items():
+            if c > bc or (c == bc and best is not None and p < best):
+                best, bc = p, c
+        if best is None or bc < 2:
+            break
+        a, b = best
+        t = nid
+        for row in rows:
+            if a in row and b in row:
+                row.discard(a)
+                row.discard(b)
+                for x in row:
+                    bump(_pair(x, a), -1)
+                    bump(_pair(x, b), -1)
+                bump((a, b), -1)
+                for x in row:
+                    bump(_pair(x, t), 1)
+                row.add(t)
+        ops.append((a, b))
+        depth.append(max(depth[a], depth[b]) + 1)
+        nid += 1
+
+    # Phase 2: no sharing left -- reduce each row as a balanced tree.
+    roots: list[int] = []
+    for row in rows:
+        nodes = sorted(row)
+        while len(nodes) > 1:
+            nxt = []
+            for i in range(0, len(nodes) - 1, 2):
+                a, b = nodes[i], nodes[i + 1]
+                ops.append((a, b))
+                depth.append(max(depth[a], depth[b]) + 1)
+                nxt.append(nid)
+                nid += 1
+            if len(nodes) % 2:
+                nxt.append(nodes[-1])
+            nodes = nxt
+        roots.append(nodes[0] if nodes else -1)
+
+    max_depth = max((depth[r] for r in roots if r >= 0), default=0)
+    return XorSchedule(
+        n_inputs=n_inputs,
+        n_rows=len(rows),
+        ops=tuple(ops),
+        roots=tuple(roots),
+        naive_xors=naive,
+        depth=max_depth,
+    )
+
+
+def bit_rows(w_bits: np.ndarray) -> list[set[int]]:
+    """[K*8, R*8] {0,1} bitmatrix (bit_expand orientation) -> per-output-row
+    input support sets."""
+    w = np.asarray(w_bits)
+    if w.ndim != 2:
+        raise ValueError(f"bitmatrix must be 2-D, got {w.shape}")
+    w = (w != 0)
+    return [set(np.nonzero(w[:, c])[0].tolist()) for c in range(w.shape[1])]
+
+
+_CACHE_LOCK = threading.Lock()
+
+
+@functools.lru_cache(maxsize=256)
+def _schedule_cached(n_in: int, n_out: int, buf: bytes) -> XorSchedule:
+    w = np.frombuffer(buf, dtype=np.uint8).reshape(n_in, n_out)
+    return _compile(bit_rows(w), n_in)
+
+
+def schedule_for_bits(w_bits: np.ndarray) -> XorSchedule:
+    """Compile (cached) an XOR schedule from a bit_expand-oriented
+    [K*8, R*8] binary matrix."""
+    w = (np.ascontiguousarray(w_bits) != 0).astype(np.uint8)
+    with _CACHE_LOCK:
+        return _schedule_cached(w.shape[0], w.shape[1], w.tobytes())
+
+
+def schedule_for_coeffs(coeffs: np.ndarray) -> XorSchedule:
+    """Compile (cached) an XOR schedule from an [R, K] GF(2^8) coefficient
+    matrix (e.g. rs_matrix.parity_matrix or reconstruct_rows output)."""
+    return schedule_for_bits(rs_matrix.bit_expand(np.asarray(coeffs, dtype=np.uint8)))
+
+
+def encode_schedule(k: int, m: int) -> XorSchedule:
+    """The parity-encode schedule for a (k, m) geometry."""
+    return schedule_for_coeffs(rs_matrix.parity_matrix(k, m))
+
+
+def schedule_stats(k: int, m: int) -> dict:
+    """Depth/op-count stats for the cached (k, m) encode schedule --
+    surfaced by bench.py so the xor-schedule cost is never a silent 0."""
+    return encode_schedule(k, m).stats()
+
+
+def eval_schedule(sched: XorSchedule, planes: list[np.ndarray]) -> list[np.ndarray]:
+    """Run the schedule over arbitrary XOR-able plane values (oracle path)."""
+    if len(planes) != sched.n_inputs:
+        raise ValueError(f"need {sched.n_inputs} planes, got {len(planes)}")
+    vals = list(planes)
+    for a, b in sched.ops:
+        vals.append(vals[a] ^ vals[b])
+    zero = np.zeros_like(planes[0]) if planes else None
+    return [vals[r] if r >= 0 else zero for r in sched.roots]
+
+
+def eval_bytes(sched: XorSchedule, shards: np.ndarray) -> np.ndarray:
+    """Numpy reference evaluator: [K, S] u8 shards -> [R, S] u8 output rows.
+
+    Bit-identical to the Pallas kernel's semantics (and, transitively, to
+    ops/gf multiply): used by the property tests as a schedule-level oracle
+    that is independent of both JAX and the GF tables.
+    """
+    shards = np.asarray(shards, dtype=np.uint8)
+    k8 = sched.n_inputs
+    if shards.shape[0] * 8 != k8:
+        raise ValueError(f"schedule wants {k8 // 8} shards, got {shards.shape[0]}")
+    planes = [(shards[i >> 3] >> (i & 7)) & 1 for i in range(k8)]
+    outs = eval_schedule(sched, planes)
+    r = sched.n_rows // 8
+    result = np.zeros((r, shards.shape[1]), dtype=np.uint8)
+    for rr in range(r):
+        for bo in range(8):
+            result[rr] |= outs[rr * 8 + bo] << bo
+    return result

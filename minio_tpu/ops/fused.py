@@ -1,0 +1,70 @@
+"""Fused erasure-encode + bitrot-hash device program.
+
+One host dispatch turns [B, K, S] data shards into all [B, K+M, S] shards
+plus per-shard HighwayHash-256 digests. "Fused" here means one *jitted XLA
+program* containing two Pallas kernels back to back -- the XOR-bitmatrix
+encode (ops/rs_pallas) and the VMEM-resident HighwayHash chain
+(ops/highwayhash_pallas) -- with the packet-layout transform between them
+staying device-resident. It is deliberately NOT a single pallas_call:
+encode combines *across* shard rows while the hash wants independent
+streams on lanes, so a single kernel would need an in-kernel lane<->sublane
+transpose that cannot be validated off-hardware; the XLA boundary costs one
+HBM round-trip of the shard bytes and keeps both kernels independently
+oracle-checked.
+
+What PUT pays per 16 MiB window: one host->device transfer of the data
+shards, one program launch, one device->host transfer of parity + digests.
+The hash finalization (remainder packets, tail permutes, modular reduction)
+runs as XLA epilogue exactly as ops/highwayhash_pallas already does.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from . import highwayhash_jax as hhj
+from . import rs, rs_pallas
+
+
+def make_step(encode_all_fn, hash_fn):
+    """Compose an encode-all fn and a digest fn into one fused step.
+
+    Returns the *unjitted* step so callers (models/pipeline) control the jit
+    boundary; jit it once per (geometry, batch shape).
+    """
+
+    def step(data_shards: jax.Array):
+        """[B, K, S] -> ([B, K+M, S] shards, [B, K+M, 32] digests)."""
+        all_shards = encode_all_fn(data_shards)
+        b, t, s = all_shards.shape
+        digests = hash_fn(all_shards.reshape(b * t, s)).reshape(b, t, 32)
+        return all_shards, digests
+
+    return step
+
+
+@functools.lru_cache(maxsize=32)
+def _fused_cached(k: int, m: int, rs_impl: str, hash_impl: str):
+    if rs_impl == "pallas":
+        codec = rs_pallas.RSPallasCodec(k, m)
+    else:
+        codec = rs.RSCodec(k, m)
+    if hash_impl == "pallas":
+        from . import highwayhash_pallas as hhp
+
+        hash_fn = hhp.hash256_batch
+    else:
+        hash_fn = hhj.hash256_batch
+    return jax.jit(make_step(codec.encode_all, hash_fn))
+
+
+def fused_encode_hash(data_shards, k: int, m: int,
+                      rs_impl: str = "pallas", hash_impl: str = "pallas"):
+    """One-launch fused encode+hash with explicit kernel choices.
+
+    bench.py times this directly (`pallas_fused_gibs`); serving goes through
+    models/pipeline.ErasurePipeline, which picks impls by measured probe.
+    """
+    return _fused_cached(k, m, rs_impl, hash_impl)(data_shards)

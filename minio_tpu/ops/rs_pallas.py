@@ -1,23 +1,34 @@
-"""Fused Pallas TPU kernel for the GF(2) bit-matmul Reed-Solomon codec.
+"""XOR-bitmatrix Pallas TPU kernel for the Reed-Solomon codec.
 
-The XLA path (ops/rs.py) materializes the 8x bit expansion of every shard
-byte as an int8 tensor between HBM round-trips unless XLA happens to fuse
-it. This kernel pins the whole unpack -> MXU matmul -> mod-2 -> repack
-chain in VMEM per tile: the only HBM traffic is the u8 shard bytes in and
-the u8 parity bytes out (the op is HBM-bandwidth-bound; the matmul itself
-is a skinny [R*8, K*8] x [K*8, TILE_S] int8 contraction).
+The previous kernel here re-expressed RS as an MXU int8 bit-matmul; Mosaic
+rejected it on hardware because the repack needed sub-32-bit iota and
+unsigned reductions, so `pallas_encode_gibs` sat at 0.0 while the XLA path
+carried all device traffic. This rewrite drops the matmul formulation
+entirely and uses the op family Mosaic demonstrably supports on the VPU
+(the HighwayHash kernel next door runs on it): 32-bit AND / logical shift /
+XOR, nothing else.
 
-Formulation (identical math to ops/rs.py, transposed to keep the shard
-byte axis in lanes):
-    bits[k*8+b, s] = (data[k, s] >> b) & 1          # VMEM sublane expand
-    acc            = W_bits @ bits                   # MXU int8 -> int32
-    parity[r, s]   = sum_b ((acc[r*8+b, s] & 1) << b)  # VPU repack
+Formulation (arXiv:2108.02692 XOR-scheduled bitmatrix coding over the
+Cauchy/Vandermonde construction of arXiv:1611.09968):
+
+  * Host side, shard bytes are bitcast to little-endian u32 lanes -- byte j
+    of a shard lands in bits [8j, 8j+8) of word j//4 (the same packing the
+    HighwayHash kernel relies on).
+  * The [R, K] GF(2^8) coefficient matrix lifts to a binary bitmatrix
+    (ops/bitmatrix), compiled once per geometry into an XOR schedule with
+    cross-row CSE.
+  * In-kernel, input bit-plane (k, b) is the lane-aligned mask
+    `(x[k] >> b) & 0x01010101`: bit b of all four bytes in a word, moved to
+    bit 0 of each byte. Logical (unsigned) shift never smears sign bits and
+    the masked bits never cross byte lanes (b, b_out in 0..7 keeps every
+    bit inside its source byte). The schedule XORs planes; output bit-row
+    (r, b_out) shifts its root left by b_out and XOR-accumulates into the
+    parity word.
 
 Bit-exactness is pinned by tests against ops/rs_ref (and transitively the
 reference's golden self-test vectors, /root/reference/cmd/erasure-coding.go:
-158-216). Encode and reconstruct are the same kernel with different
-coefficient matrices (reference: Encode/ReconstructData at
-cmd/erasure-coding.go:77-109, heal at cmd/erasure-lowlevel-heal.go:31).
+158-216) plus the schedule-level numpy oracle in ops/bitmatrix. Encode and
+reconstruct are the same kernel with different coefficient matrices.
 
 Off-TPU the kernel runs in interpret mode (tests); on a real chip
 `encode_all` / `apply` are drop-in peers of ops/rs.RSCodec and bench.py
@@ -33,12 +44,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
-from . import rs, rs_matrix
+from . import bitmatrix, rs_matrix
 
-# Lane tile along the shard-byte axis. Swept on a live v5e (round 4):
-# 2048 -> 29.7 GiB/s, 8192 -> 35.8, 16384 -> 35.4, 65536 -> 30.4; 8192 wins
-# (per-tile VMEM for K=16: (K*8) x 8192 int8 bits = 1 MiB, double-buffered).
-TILE_S = 8192
+# VPU-native tile: 8 sublanes x TILE_LANE u32 lanes per shard per grid step.
+# Small shards take the 128-lane tile (4 KiB/shard/step -- bounds padding on
+# the coalesced small-object path); big shards take 512 lanes to amortize
+# grid overhead, same lane width the HighwayHash kernel runs.
+_TILE_SUB = 8
+_SMALL_LANES = 128
+_BIG_LANES = 512
+_BIG_CUTOFF = 1 << 15  # shard bytes at/above which the 512-lane tile wins
+
+_PLANE_MASK = 0x01010101  # bit 0 of each byte in a u32 word
 
 
 def _interpret() -> bool:
@@ -48,86 +65,92 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def _kernel(w_ref, x_ref, o_ref, *, k: int, r: int, ts: int):
-    # Mosaic supports neither sub-32-bit iota nor unsigned reductions, so
-    # the bit expansion and repack are unrolled over the 8 bit positions.
-    # Both weight axes are permuted to BIT-major order (row b*K+k, col
-    # b*R+r; see _bitmajor_weights) so the expansion is a contiguous
-    # concatenation of whole bit-planes and the repack reads contiguous
-    # row slices -- no cross-sublane interleave anywhere in the kernel.
-    # Mosaic has no sub-32-bit shifts, so bit b is tested with a masked
-    # compare (u8 and + cmp, full lane density) instead of a shift.
-    x = x_ref[0]  # [K, TS] u8
-    zero = jnp.uint8(0)
-    planes = [
-        ((x & jnp.uint8(1 << bit)) != zero).astype(jnp.int8) for bit in range(8)
-    ]
-    bits = jnp.concatenate(planes, axis=0)  # [8K, TS]
-    acc = jax.lax.dot_general(
-        w_ref[:],
-        bits,
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32,
-    )  # [8R, TS], row b*R+r
-    out = acc[0:r] & 1
-    for bit in range(1, 8):
-        out = out | ((acc[bit * r : (bit + 1) * r] & 1) << bit)
-    o_ref[0] = out.astype(jnp.uint8)
+def _pick_lanes(s: int) -> int:
+    return _BIG_LANES if s >= _BIG_CUTOFF else _SMALL_LANES
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _apply_padded(data: jax.Array, w_bits: jax.Array, k: int, r: int) -> jax.Array:
-    """[B, K, S_pad] u8 x [R*8, K*8] int8 -> [B, R, S_pad] u8 (S_pad % TILE_S == 0)."""
-    b, _, s_pad = data.shape
-    grid = (b, s_pad // TILE_S)
-    return pl.pallas_call(
-        functools.partial(_kernel, k=k, r=r, ts=TILE_S),
-        grid=grid,
+def _kernel(x_ref, o_ref, *, sched: bitmatrix.XorSchedule, r: int):
+    # Pure u32 elementwise: AND + logical shifts + XOR. No iota, no
+    # reductions, no sub-32-bit types past the host-side bitcast.
+    x = x_ref[0]  # [K, 8, L] u32
+    mask = jnp.uint32(_PLANE_MASK)
+    vals: dict[int, jax.Array] = {}
+
+    def node(i: int) -> jax.Array:
+        v = vals.get(i)
+        if v is None:  # an input plane, materialized lazily
+            k, b = divmod(i, 8)
+            xi = x[k]
+            if b:
+                xi = jax.lax.shift_right_logical(xi, jnp.uint32(b))
+            v = jnp.bitwise_and(xi, mask)
+            vals[i] = v
+        return v
+
+    for t, (a, b) in enumerate(sched.ops, start=sched.n_inputs):
+        vals[t] = jnp.bitwise_xor(node(a), node(b))
+
+    for rr in range(r):
+        acc = None
+        for bo in range(8):
+            root = sched.roots[rr * 8 + bo]
+            if root < 0:
+                continue
+            v = node(root)
+            if bo:
+                v = jax.lax.shift_left(v, jnp.uint32(bo))
+            acc = v if acc is None else jnp.bitwise_xor(acc, v)
+        if acc is None:
+            acc = jnp.zeros_like(x[0])
+        o_ref[0, rr] = acc
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _apply_sched(data: jax.Array, sched: bitmatrix.XorSchedule) -> jax.Array:
+    """[B, K, S] u8 shards -> [B, R, S] u8 via the compiled XOR schedule."""
+    b, k, s = data.shape
+    if k * 8 != sched.n_inputs:
+        raise ValueError(f"schedule wants {sched.n_inputs // 8} shards, got {k}")
+    r = sched.n_rows // 8
+    lanes = _pick_lanes(s)
+    tile_bytes = _TILE_SUB * lanes * 4
+    s_pad = -(-max(s, 1) // tile_bytes) * tile_bytes
+    if s_pad != s:
+        data = jnp.pad(data, [(0, 0), (0, 0), (0, s_pad - s)])
+    # Little-endian u32 packing: byte j -> bits [8j, 8j+8) of word j//4.
+    xu = jax.lax.bitcast_convert_type(
+        data.reshape(b, k, s_pad // (_TILE_SUB * lanes * 4), _TILE_SUB, lanes, 4),
+        jnp.uint32,
+    )  # [B, K, nT, 8, L]
+    nt = xu.shape[2]
+    out = pl.pallas_call(
+        functools.partial(_kernel, sched=sched, r=r),
+        grid=(b, nt),
         in_specs=[
-            pl.BlockSpec((r * 8, k * 8), lambda i, j: (0, 0)),
-            pl.BlockSpec((1, k, TILE_S), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, k, 1, _TILE_SUB, lanes), lambda i, j: (i, 0, j, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, r, TILE_S), lambda i, j: (i, 0, j)),
-        out_shape=jax.ShapeDtypeStruct((b, r, s_pad), jnp.uint8),
+        out_specs=pl.BlockSpec((1, r, 1, _TILE_SUB, lanes), lambda i, j: (i, 0, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, r, nt, _TILE_SUB, lanes), jnp.uint32),
         interpret=_interpret(),
-    )(w_bits, data)
+    )(xu)
+    ob = jax.lax.bitcast_convert_type(out, jnp.uint8).reshape(b, r, s_pad)
+    return ob[:, :, :s]
 
 
-def _pad_s(x: jax.Array) -> jax.Array:
-    s = x.shape[-1]
-    pad = (-s) % TILE_S
-    if pad:
-        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
-    return x
-
-
-def _bitmajor_weights(w_bits: np.ndarray) -> np.ndarray:
-    """[K*8, R*8] byte-major (k*8+b) bit weights -> [R*8, K*8] bit-major.
-
-    Output row index is b_out*R + r, column index b_in*K + k, matching the
-    kernel's plane-concatenated operand layout.
-    """
-    k8, r8 = w_bits.shape
-    k, r = k8 // 8, r8 // 8
-    perm_in = np.arange(k8).reshape(k, 8).T.reshape(-1)
-    perm_out = np.arange(r8).reshape(r, 8).T.reshape(-1)
-    return np.ascontiguousarray(np.asarray(w_bits)[perm_in][:, perm_out].T.astype(np.int8))
-
-
-def apply(data: jax.Array, w_bits: jax.Array) -> jax.Array:
+def apply(data: jax.Array, w_bits) -> jax.Array:
     """[B, K, S] u8 shards x bit-expanded [K*8, R*8] weights -> [B, R, S] u8.
 
-    Weight orientation matches ops/rs.gf_matmul (bit_expand output); the
-    kernel wants a bit-major [R*8, K*8] layout, permuted once host-side.
+    Weight orientation matches ops/rs.gf_matmul (rs_matrix.bit_expand
+    output). The bitmatrix is compiled to a cached XOR schedule on first
+    use; subsequent calls with the same weights hit the schedule cache and
+    the jit cache.
     """
-    k8, r8 = w_bits.shape
-    s = data.shape[-1]
-    out = _apply_padded(_pad_s(data), jnp.asarray(_bitmajor_weights(np.asarray(w_bits))), k8 // 8, r8 // 8)
-    return out[..., :s]
+    sched = bitmatrix.schedule_for_bits(np.asarray(w_bits))
+    return _apply_sched(jnp.asarray(data), sched)
 
 
 class RSPallasCodec:
-    """Drop-in peer of ops/rs.RSCodec backed by the fused Pallas kernel."""
+    """Drop-in peer of ops/rs.RSCodec backed by the XOR-bitmatrix kernel."""
 
     def __init__(self, k: int, m: int):
         if k <= 0 or m <= 0:
@@ -136,11 +159,11 @@ class RSPallasCodec:
             raise ValueError(f"at most {rs_matrix.MAX_SHARDS} shards")
         self.k = k
         self.m = m
-        self._w_parity = rs.parity_weights(k, m)
+        self._sched = bitmatrix.encode_schedule(k, m)
 
     def encode(self, data_shards: jax.Array) -> jax.Array:
         """[B, K, S] u8 -> [B, M, S] parity."""
-        return apply(data_shards, self._w_parity)
+        return _apply_sched(jnp.asarray(data_shards), self._sched)
 
     def encode_all(self, data_shards: jax.Array) -> jax.Array:
         parity = self.encode(data_shards)
@@ -152,3 +175,6 @@ class RSPallasCodec:
 
     def apply(self, survivors: jax.Array, w_bits) -> jax.Array:
         return apply(survivors, w_bits)
+
+    def schedule_stats(self) -> dict:
+        return self._sched.stats()

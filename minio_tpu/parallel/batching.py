@@ -8,19 +8,30 @@ fan-in point is erasure-sets.go routing concurrent uploads).
 
 Design:
   * Full 1 MiB blocks take the batched device path -- uniform [B, K, S]
-    shapes, one fused encode+hash program (models/pipeline.py).
-  * Tail/partial blocks and low-QPS traffic fall back to the host C++ codec
-    (object/codec.py HostCodec) -- a device round-trip isn't worth it for a
-    cold single block (the latency-SLO-vs-occupancy tradeoff from SURVEY.md
-    section 7 step 2).
+    shapes, one fused encode+hash program (models/pipeline.py). With more
+    than one local chip the pipeline is shard_map'd over the codec mesh
+    (parallel/mesh.py codec_mesh, MTPU_MESH_SHAPE): batches pad to a
+    multiple of dp and fan data-parallel over blocks.
+  * Sub-window blocks >= 4 KiB coalesce on a second queue behind a bounded
+    latency budget (MTPU_BATCH_WAIT_US): concurrent small-object PUTs share
+    one parity-only device batch, padded on the shard-BYTE axis (GF math is
+    per byte position, so the true-length parity prefix is bit-exact);
+    digests are host-computed at true lengths. Tiny blocks and low-QPS
+    traffic still fall back to the host C++ codec (object/codec.py
+    HostCodec) -- a device round-trip isn't worth it for a cold single
+    block (the latency-SLO-vs-occupancy tradeoff from SURVEY.md section 7
+    step 2).
   * The batcher thread collects requests until `max_batch` or
     `batch_timeout_s` after the first arrival, pads the batch to a bucketed
     size (1/2/4/8/16/32...) to bound XLA compilations, runs the program, and
-    resolves futures.
+    resolves futures. Under sustained load it double-buffers: batch i+1 is
+    dispatched (JAX async) before batch i's bytes are pulled off the
+    device, so host transfer overlaps device compute.
 """
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time as _time
@@ -34,9 +45,18 @@ from ..control.perf import GLOBAL_PERF
 from ..models.pipeline import ErasurePipeline, Geometry
 from ..object.codec import BlockCodec, HostCodec
 from ..ops import rs_matrix
+from ..parallel import mesh as mesh_lib
 from ..control.sanitizer import san_lock, san_rlock
 
 _BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+
+# Small-object coalescing floor: below this a device trip can't win even
+# fully batched, and the host codec's latency is already microseconds.
+_SMALL_MIN = 4 << 10
+# Shard-byte-axis padding buckets start here (powers of two above) so the
+# small path compiles O(log(block_size)) programs per (k, m), not one per
+# object size.
+_SMALL_LEN_MIN = 1 << 10
 
 
 def _bucket(n: int) -> int:
@@ -46,9 +66,39 @@ def _bucket(n: int) -> int:
     return _BUCKETS[-1]
 
 
+def _len_bucket(s: int) -> int:
+    b = _SMALL_LEN_MIN
+    while b < s:
+        b <<= 1
+    return b
+
+
+def _small_wait_s() -> float | None:
+    """MTPU_BATCH_WAIT_US: microseconds to hold a small-object batch open
+    after the first arrival. Negative or "off" disables the small device
+    path entirely (everything sub-window falls back to the host codec);
+    0 batches only what is already queued."""
+    raw = os.environ.get("MTPU_BATCH_WAIT_US", "").strip().lower()
+    if raw in ("off", "disable", "disabled"):
+        return None
+    try:
+        wait = float(raw) if raw else 500.0
+    except ValueError:
+        wait = 500.0
+    if wait < 0:
+        return None
+    return wait / 1e6
+
+
 @dataclass
 class _Request:
     shards: np.ndarray  # [K, S] split data block
+    future: Future
+
+
+@dataclass
+class _SmallRequest:
+    block: bytes  # raw sub-window block (bytes or memoryview)
     future: Future
 
 
@@ -60,16 +110,20 @@ class BatchingDeviceCodec(BlockCodec):
         block_size: int = 1 << 20,
         max_batch: int = 64,
         batch_timeout_s: float = 0.0005,
-        mesh=None,
+        mesh="auto",
     ):
         self.block_size = block_size
         self.max_batch = max_batch
         self.batch_timeout_s = batch_timeout_s
+        # "auto" resolves to parallel/mesh.codec_mesh() at first worker
+        # creation (device enumeration stays off the constructor); None on
+        # single-device hosts keeps the plain per-device pipeline.
         self.mesh = mesh
+        self.small_wait_s = _small_wait_s()
         self._host = HostCodec()
-        self._queues: dict[tuple[int, int], queue.Queue[_Request]] = {}
+        self._queues: dict[tuple, queue.Queue] = {}
         self._pipelines: dict[tuple[int, int], ErasurePipeline] = {}
-        self._threads: dict[tuple[int, int], threading.Thread] = {}
+        self._threads: dict[tuple, threading.Thread] = {}
         self._lock = san_lock("BatchingDeviceCodec._lock")
         # Counters are bumped by batch workers AND request threads; += is
         # load/add/store, so a dedicated leaf lock (LOCK_ORDER: taken inside
@@ -92,6 +146,18 @@ class BatchingDeviceCodec(BlockCodec):
         self.host_fallback_blocks = 0
         self.host_fallback_recon_blocks = 0
         self.host_fallback_digest_chunks = 0
+        # Small-object coalescing path (sub-window blocks, parity on device,
+        # digests host-side at true lengths).
+        self.small_blocks_encoded = 0
+        self.small_batches_run = 0
+        self.small_blocks_padded = 0
+        # Batches whose device->host transfer overlapped the next batch's
+        # compute (the worker's one-deep pending slot engaged).
+        self.double_buffered_batches = 0
+        # Multi-chip fan-out accounting: chip_blocks[g] counts real (non-pad)
+        # blocks the dp-group g carried; with no mesh both stay trivial.
+        self.mesh_devices = 1
+        self.chip_blocks: list[int] = []
         # Wall time inside device kernels, per kernel class (seconds).
         self.device_encode_seconds = 0.0
         self.device_recon_seconds = 0.0
@@ -103,15 +169,48 @@ class BatchingDeviceCodec(BlockCodec):
 
     # -- worker management ---------------------------------------------------
 
+    def _mesh_for(self, k: int, m: int):
+        """The codec mesh, or None when the geometry doesn't tile it.
+
+        Caller holds self._lock ("auto" resolution mutates self.mesh). The
+        pipeline's shard_map path needs (k+m) streams to divide the tp x sp
+        grid and the shard byte axis to divide sp; geometries that don't fit
+        run the plain single-device pipeline rather than refusing to serve.
+        """
+        if self.mesh == "auto":
+            self.mesh = mesh_lib.codec_mesh()
+        mesh = self.mesh
+        if mesh is None:
+            return None
+        tp, sp = mesh.shape["tp"], mesh.shape["sp"]
+        geom = Geometry(k, m, self.block_size)
+        if geom.total % (tp * sp) or geom.shard_size % sp:
+            return None
+        return mesh
+
+    def _pipeline_locked(self, k: int, m: int) -> ErasurePipeline:
+        key = (k, m)
+        pipe = self._pipelines.get(key)
+        if pipe is None:
+            mesh = self._mesh_for(k, m)
+            pipe = self._pipelines[key] = ErasurePipeline(
+                Geometry(k, m, self.block_size), mesh=mesh
+            )
+            if mesh is not None:
+                with self._stats_lock:
+                    self.mesh_devices = max(self.mesh_devices, mesh.size)
+                    dp = mesh.shape["dp"]
+                    if len(self.chip_blocks) < dp:
+                        self.chip_blocks.extend([0] * (dp - len(self.chip_blocks)))
+        return pipe
+
     def _ensure_worker(self, k: int, m: int) -> queue.Queue:
         key = (k, m)
         with self._lock:
             if key not in self._queues:
                 q: queue.Queue[_Request] = queue.Queue()
                 self._queues[key] = q
-                self._pipelines[key] = ErasurePipeline(
-                    Geometry(k, m, self.block_size), mesh=self.mesh
-                )
+                self._pipeline_locked(k, m)
                 t = threading.Thread(
                     target=self._worker, args=(key,), daemon=True, name=f"encode-batch-{k}-{m}"
                 )
@@ -119,42 +218,98 @@ class BatchingDeviceCodec(BlockCodec):
                 self._threads[key] = t
         return self._queues[key]
 
+    def _ensure_small_worker(self, k: int, m: int) -> queue.Queue:
+        key = (k, m, "small")
+        with self._lock:
+            if key not in self._queues:
+                q: queue.Queue[_SmallRequest] = queue.Queue()
+                self._queues[key] = q
+                self._pipeline_locked(k, m)
+                t = threading.Thread(
+                    target=self._small_worker,
+                    args=(key,),
+                    daemon=True,
+                    name=f"encode-batch-small-{k}-{m}",
+                )
+                t.start()
+                self._threads[key] = t
+        return self._queues[key]
+
+    def _collect(self, q: queue.Queue, first, window_s: float) -> list:
+        batch = [first]
+        start = _time.monotonic()
+        while len(batch) < self.max_batch:
+            remaining = window_s - (_time.monotonic() - start)
+            if remaining <= 0:
+                break
+            try:
+                batch.append(q.get(timeout=remaining))
+            except queue.Empty:
+                break
+        return batch
+
     def _worker(self, key: tuple[int, int]) -> None:
         k, m = key
         q = self._queues[key]
         pipe = self._pipelines[key]
+        # One-deep pending slot: under sustained load batch i+1 is
+        # dispatched (JAX queues transfer+compute asynchronously) before
+        # batch i's np.asarray blocks, so D2H of i overlaps compute of i+1.
+        pending = None
         while not self._stop.is_set():
             try:
                 first = q.get(timeout=0.1)
             except queue.Empty:
+                if pending is not None:
+                    self._resolve_batch(pending)
+                    pending = None
                 continue
-            batch = [first]
-            # Collect until the adaptive window closes or the batch is full.
-            t_end = self.batch_timeout_s
-            import time as _t
+            batch = self._collect(q, first, self.batch_timeout_s)
+            dispatched = self._dispatch_batch(pipe, k, m, batch)
+            if pending is not None:
+                self._resolve_batch(pending)
+                if dispatched is not None:
+                    with self._stats_lock:
+                        self.double_buffered_batches += 1
+            pending = dispatched
+            if pending is not None and q.empty():
+                # No follow-on work queued: resolve now, don't buy overlap
+                # with latency the SLO pays for.
+                self._resolve_batch(pending)
+                pending = None
+        if pending is not None:
+            self._resolve_batch(pending)
 
-            start = _t.monotonic()
-            while len(batch) < self.max_batch:
-                remaining = t_end - (_t.monotonic() - start)
-                if remaining <= 0:
-                    break
-                try:
-                    batch.append(q.get(timeout=remaining))
-                except queue.Empty:
-                    break
-            self._run_batch(pipe, k, m, batch)
-
-    def _run_batch(self, pipe: ErasurePipeline, k: int, m: int, batch: list[_Request]) -> None:
+    def _dispatch_batch(self, pipe: ErasurePipeline, k: int, m: int, batch: list[_Request]):
+        """Marshal + launch one encode batch; returns the pending record to
+        resolve later, or None if dispatch itself failed."""
         try:
             s = batch[0].shards.shape[1]
             b_real = len(batch)
             b_pad = _bucket(b_real)
+            if pipe.mesh is not None:
+                dp = pipe.mesh.shape["dp"]
+                b_pad = -(-b_pad // dp) * dp  # dp must divide the batch axis
             arr = np.zeros((b_pad, k, s), dtype=np.uint8)
             for i, req in enumerate(batch):
                 arr[i] = req.shards
             t0 = _time.perf_counter()
             c0 = _time.thread_time()
             shards, digests = pipe.encode(arr)
+            return (batch, shards, digests, k, m, b_real, b_pad, t0, c0, pipe)
+        except Exception as e:  # noqa: BLE001
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            return None
+
+    def _resolve_batch(self, rec) -> None:
+        batch, shards, digests, k, m, b_real, b_pad, t0, c0, pipe = rec
+        try:
+            # Blocks until the device batch materializes host-side. Under
+            # double-buffering the next batch is already in flight.
+            shards_np = np.asarray(shards)
+            digests_np = np.asarray(digests)
             dt = _time.perf_counter() - t0
             # Ledger record, not a span: worker threads run outside any
             # request context, so a span here would be a silent no-op. The
@@ -168,13 +323,74 @@ class BatchingDeviceCodec(BlockCodec):
                 self.batches_run += 1
                 self.blocks_encoded += b_real
                 self.blocks_padded += b_pad
-            shards_np = np.asarray(shards)
-            digests_np = np.asarray(digests)
+                if pipe.mesh is not None:
+                    dp = pipe.mesh.shape["dp"]
+                    per = b_pad // dp
+                    for g in range(min(dp, len(self.chip_blocks))):
+                        self.chip_blocks[g] += min(max(b_real - g * per, 0), per)
             for i, req in enumerate(batch):
                 req.future.set_result(
                     (
                         [shards_np[i, j].tobytes() for j in range(k + m)],
                         [digests_np[i, j].tobytes() for j in range(k + m)],
+                    )
+                )
+        except Exception as e:  # noqa: BLE001
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    def _small_worker(self, key: tuple) -> None:
+        k, m = key[0], key[1]
+        q = self._queues[key]
+        pipe = self._pipelines[(k, m)]
+        window = self.small_wait_s or 0.0
+        while not self._stop.is_set():
+            try:
+                first = q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            self._run_small_batch(pipe, k, m, self._collect(q, first, window))
+
+    def _run_small_batch(self, pipe: ErasurePipeline, k: int, m: int, batch: list[_SmallRequest]) -> None:
+        try:
+            datas = [np.frombuffer(req.block, dtype=np.uint8) for req in batch]
+            shard_lens = [rs_matrix.shard_size(d.size, k) for d in datas]
+            # Pad the shard BYTE axis, not the block: GF(2^8) is per byte
+            # position, so parity[:, :true_len] of the padded batch is
+            # bit-identical to encoding at true length. (Padding the block
+            # itself would change ceil(len/k) and thus the parity bytes.)
+            s_pad = _len_bucket(max(shard_lens))
+            b_real = len(batch)
+            b_pad = _bucket(b_real)
+            arr = np.zeros((b_pad, k, s_pad), dtype=np.uint8)
+            for i, d in enumerate(datas):
+                arr[i, :, : shard_lens[i]] = rs_matrix.split(d, k)
+            t0 = _time.perf_counter()
+            c0 = _time.thread_time()
+            parity = np.asarray(pipe.encode_parity(arr))  # [b_pad, M, s_pad]
+            dt = _time.perf_counter() - t0
+            GLOBAL_PERF.ledger.record(
+                "codec", "encode-batch-small", dt, _time.thread_time() - c0
+            )
+            with self._stats_lock:
+                self.device_encode_seconds += dt
+                self.small_batches_run += 1
+                self.small_blocks_encoded += b_real
+                self.small_blocks_padded += b_pad
+            for i, req in enumerate(batch):
+                s_i = shard_lens[i]
+                rows = np.ascontiguousarray(
+                    np.concatenate([arr[i, :, :s_i], parity[i, :, :s_i]], axis=0)
+                )  # [K+M, s_i]
+                # Digests at TRUE length, same host hash HostCodec uses --
+                # padded-row digests would be wrong, and this keeps the
+                # result bit-identical to the host fallback.
+                digs = self._host._digests(rows)
+                req.future.set_result(
+                    (
+                        [rows[j].tobytes() for j in range(k + m)],
+                        [digs[j].tobytes() for j in range(k + m)],
                     )
                 )
         except Exception as e:  # noqa: BLE001
@@ -195,12 +411,22 @@ class BatchingDeviceCodec(BlockCodec):
         futures: list[Future | None] = [None] * len(blocks)
         host_idx: list[int] = []
         q = None
+        sq = None
         for i, block in enumerate(blocks):
-            if len(block) == self.block_size:
+            n = len(block)
+            if n == self.block_size:
                 if q is None:
                     q = self._ensure_worker(k, m)
                 f: Future = Future()
                 q.put(_Request(rs_matrix.split(np.frombuffer(block, np.uint8), k), f))
+                futures[i] = f
+            elif self.small_wait_s is not None and _SMALL_MIN <= n < self.block_size:
+                # Sub-window block: coalesce with concurrent small PUTs into
+                # one parity-only device batch (MTPU_BATCH_WAIT_US window).
+                if sq is None:
+                    sq = self._ensure_small_worker(k, m)
+                f = Future()
+                sq.put(_SmallRequest(block, f))
                 futures[i] = f
             else:
                 host_idx.append(i)
@@ -321,9 +547,15 @@ class BatchingDeviceCodec(BlockCodec):
     # -- metrics surface ------------------------------------------------------
 
     def queue_depths(self) -> dict[str, int]:
-        """Pending encode requests per (k, m) worker queue."""
+        """Pending encode requests per worker queue (full + small paths)."""
         with self._lock:
-            return {f"{k}x{m}": q.qsize() for (k, m), q in self._queues.items()}
+            out = {}
+            for key, q in self._queues.items():
+                name = f"{key[0]}x{key[1]}"
+                if len(key) > 2:
+                    name += "-small"
+                out[name] = q.qsize()
+            return out
 
     def stats(self) -> dict:
         """Counter snapshot for the /metrics/node codec/device series."""
@@ -336,6 +568,12 @@ class BatchingDeviceCodec(BlockCodec):
                 "recon_batches_run": self.recon_batches_run,
                 "digests_verified": self.digests_verified,
                 "verify_batches_run": self.verify_batches_run,
+                "small_blocks_encoded": self.small_blocks_encoded,
+                "small_batches_run": self.small_batches_run,
+                "small_blocks_padded": self.small_blocks_padded,
+                "double_buffered_batches": self.double_buffered_batches,
+                "mesh_devices": self.mesh_devices,
+                "chip_blocks": list(self.chip_blocks),
                 "host_fallback_blocks": self.host_fallback_blocks,
                 "host_fallback_recon_blocks": self.host_fallback_recon_blocks,
                 "host_fallback_digest_chunks": self.host_fallback_digest_chunks,

@@ -20,12 +20,33 @@ sp/tp all-to-alls, DCN only carries control traffic (dist/ package).
 from __future__ import annotations
 
 import math
+import os
+import threading
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXES = ("dp", "tp", "sp")
+
+
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
+    """shard_map across jax versions.
+
+    Newer jax exposes jax.shard_map (check_vma kwarg); 0.4.x only has
+    jax.experimental.shard_map.shard_map (check_rep kwarg). Both flags off:
+    the encode->hash all-to-all mixes parameter-aliasing and computed rows,
+    which the replication checker rejects.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False
+    )
 
 
 def factor_mesh(n: int) -> tuple[int, int, int]:
@@ -55,6 +76,48 @@ def make_mesh(n_devices: int | None = None, shape: tuple[int, int, int] | None =
     assert shape[0] * shape[1] * shape[2] == n, (shape, n)
     dev_array = np.array(devices[:n]).reshape(shape)
     return Mesh(dev_array, AXES)
+
+
+def mesh_shape_from_env(n: int) -> tuple[int, int, int] | None:
+    """Parse MTPU_MESH_SHAPE for n devices.
+
+    Accepted: "dp,tp,sp" (must multiply to n), "auto"/"" (factor_mesh),
+    "off"/"0"/"1" (disable the codec mesh entirely -> None from
+    codec_mesh). A malformed or mismatched value falls back to auto rather
+    than refusing to serve.
+    """
+    raw = os.environ.get("MTPU_MESH_SHAPE", "").strip().lower()
+    if raw in ("off", "0", "1"):
+        return None
+    if raw in ("", "auto"):
+        return factor_mesh(n)
+    try:
+        parts = tuple(int(p) for p in raw.split(","))
+    except ValueError:
+        return factor_mesh(n)
+    if len(parts) != 3 or any(p < 1 for p in parts):
+        return factor_mesh(n)
+    if parts[0] * parts[1] * parts[2] != n:
+        return factor_mesh(n)
+    return parts
+
+
+_CODEC_MESH_LOCK = threading.Lock()
+_codec_mesh_cache: list = []  # [Mesh | None] once resolved
+
+
+def codec_mesh() -> Mesh | None:
+    """The mesh BatchingDeviceCodec fans encode batches over: all local
+    devices, shaped by MTPU_MESH_SHAPE (default factor_mesh). None on
+    single-device hosts or when MTPU_MESH_SHAPE=off -- callers then run the
+    plain single-device pipeline. Cached: device enumeration and mesh
+    construction happen once per process."""
+    with _CODEC_MESH_LOCK:
+        if not _codec_mesh_cache:
+            n = len(jax.devices())
+            shape = mesh_shape_from_env(n) if n > 1 else None
+            _codec_mesh_cache.append(make_mesh(n, shape) if shape else None)
+        return _codec_mesh_cache[0]
 
 
 def data_spec() -> P:

@@ -104,6 +104,9 @@ _PROBE_FAIL_TTL_CAP_S = 900.0
 # file and the latest is exposed via probe_transition() for bench JSON.
 _TRANSITIONS_KEPT = 8
 _last_transition: dict | None = None
+# In-process fallback/recovery tallies (metrics + perf endpoint): how many
+# times this process saw the verdict flip each way.
+_transition_counts = {"fallback": 0, "recovery": 0}
 
 
 def _transition_between(prev_platform, result: "ProbeResult") -> dict | None:
@@ -124,6 +127,14 @@ def _note_transition(t: dict | None) -> None:
     if t is not None:
         with _probe_lock:
             _last_transition = t
+            if t.get("kind") in _transition_counts:
+                _transition_counts[t["kind"]] += 1
+
+
+def probe_transition_counts() -> dict:
+    """{"fallback": n, "recovery": n} verdict flips seen by this process."""
+    with _probe_lock:
+        return dict(_transition_counts)
 
 
 def probe_transition() -> dict | None:
@@ -374,6 +385,106 @@ _state_lock = san_lock("runtime._state_lock")
 _closed = False
 
 
+# -- periodic recovery re-probe -----------------------------------------------
+#
+# BENCH r04-r05: a wedged tunnel at boot parked the node on the CPU codec for
+# its whole life even after the device recovered. When an auto-mode install
+# lands on the host codec, a single daemon re-probes on a cadence
+# (MTPU_PROBE_RECOVERY_S, default 300 s; <= 0 disables) and swaps in the
+# batching device codec on the first good verdict -- no restart. Each tick is
+# the same bounded supervised child as boot, and the cross-process file cache
+# still amortizes verdicts (failed verdicts honored <= 900 s), so the cadence
+# bounds *wait*, not child spawns.
+
+_reprobe_stop = threading.Event()
+_reprobe_thread: threading.Thread | None = None
+_recovery_probes = 0
+
+
+def _recovery_interval_s() -> float:
+    try:
+        return float(os.environ.get("MTPU_PROBE_RECOVERY_S", "") or 300.0)
+    except ValueError:
+        return 300.0
+
+
+def _recovery_loop(probe_timeout_s: float) -> None:
+    global _probe_cache, _recovery_probes
+    while True:
+        interval = _recovery_interval_s()  # re-read: can be flipped live
+        if interval <= 0:
+            return
+        if _reprobe_stop.wait(interval):
+            return
+        with _state_lock:
+            if _closed:
+                return
+        with _probe_lock:
+            # Drop only the in-memory verdict: probe_device would otherwise
+            # return the boot-time failure forever. The file cache (if
+            # configured) still answers within its failed-verdict TTL, so a
+            # fleet of nodes doesn't re-probe in lockstep.
+            prev = _probe_cache
+            _probe_cache = None
+            _recovery_probes += 1
+        result = probe_device(probe_timeout_s)
+        if prev is not None and (result.cached or not _probe_cache_file()):
+            # _probe_uncached saw prev=None (we cleared it) and the file-cache
+            # diff in _store_probe_file only runs on real probes with a cache
+            # file configured -- cover the remaining paths here.
+            _note_transition(_transition_between(prev.platform, result))
+        if not result.ok:
+            continue
+        with _state_lock:
+            if _closed:
+                return
+            dev = _make_batching()
+            codec_mod.set_default_codec(dev)
+        return
+
+
+def _start_recovery_reprobe(probe_timeout_s: float) -> None:
+    global _reprobe_thread
+    if _recovery_interval_s() <= 0:
+        return
+    with _state_lock:
+        if _closed:
+            return
+        if _reprobe_thread is not None and _reprobe_thread.is_alive():
+            return
+        _reprobe_stop.clear()
+        # mtpulint: disable=unjoined-thread -- lifecycle bounded by the
+        # _reprobe_stop event (shutdown_data_plane sets it) and the
+        # _state_lock/_closed fence; exits on first good verdict.
+        t = threading.Thread(
+            target=_recovery_loop, args=(probe_timeout_s,), daemon=True, name="codec-reprobe"
+        )
+        _reprobe_thread = t
+        t.start()
+
+
+def probe_summary() -> dict:
+    """Probe state for the admin perf endpoint and metrics: verdict,
+    transition history, and recovery-reprobe posture. Never probes."""
+    st = probe_status()
+    with _probe_lock:
+        reprobes = _recovery_probes
+    armed = _reprobe_thread is not None and _reprobe_thread.is_alive()
+    return {
+        "done": st is not None,
+        "ok": bool(st.ok) if st is not None else False,
+        "platform": st.platform if st is not None else None,
+        "cached": bool(st.cached) if st is not None else False,
+        "transition": probe_transition(),
+        "transition_counts": probe_transition_counts(),
+        "recovery": {
+            "interval_s": _recovery_interval_s(),
+            "armed": armed,
+            "reprobes": reprobes,
+        },
+    }
+
+
 def install_data_plane_codec(
     mode: str | None = None,
     probe_timeout_s: float | None = None,
@@ -402,6 +513,7 @@ def install_data_plane_codec(
 
         def _bg(timeout=probe_timeout_s):
             if not probe_device(timeout).ok:
+                _start_recovery_reprobe(timeout)
                 return
             with _state_lock:
                 if _closed:
@@ -415,7 +527,11 @@ def install_data_plane_codec(
         threading.Thread(target=_bg, daemon=True, name="codec-probe").start()
         return codec
     else:  # auto, synchronous: only pay device round trips for an accelerator
-        codec = _make_batching() if probe_device(probe_timeout_s).ok else codec_mod.HostCodec()
+        if probe_device(probe_timeout_s).ok:
+            codec = _make_batching()
+        else:
+            codec = codec_mod.HostCodec()
+            _start_recovery_reprobe(probe_timeout_s)
     with _state_lock:
         if _closed:
             # shutdown_data_plane raced us: don't install after shutdown.
@@ -430,6 +546,7 @@ def install_data_plane_codec(
 def shutdown_data_plane(codec: codec_mod.BlockCodec | None = None) -> None:
     """Close the batching codec (if installed); safe to call many times."""
     global _closed
+    _reprobe_stop.set()
     with _state_lock:
         _closed = True
         targets = {id(codec): codec, id(codec_mod._default): codec_mod._default}

@@ -26,6 +26,11 @@ if "xla_force_host_platform_device_count" not in flags:
 # that exercise the barriers set the mode explicitly.
 os.environ.setdefault("MTPU_FSYNC", "never")
 
+# Recovery re-probe daemons off by default: tests that install a host codec
+# in auto mode must not leave a timer thread re-probing (and re-installing a
+# device codec) behind later tests' backs. Recovery tests set this per-test.
+os.environ.setdefault("MTPU_PROBE_RECOVERY_S", "0")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

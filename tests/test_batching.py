@@ -141,6 +141,100 @@ class TestDeviceReconstructServing:
             batcher.close()
 
 
+class TestSmallObjectBatching:
+    """Cross-request coalescing of sub-block objects (MTPU_BATCH_WAIT_US):
+    many concurrent small PUTs ride ONE device dispatch, bit-identical to
+    the host codec."""
+
+    def test_small_objects_coalesce_into_one_batch(self, monkeypatch):
+        monkeypatch.setenv("MTPU_BATCH_WAIT_US", "20000")
+        b = BatchingDeviceCodec(block_size=BLOCK, max_batch=8, batch_timeout_s=0.002)
+        try:
+            rng = np.random.default_rng(30)
+            sizes = [5000, 9000, 40000, 123457]
+            blocks = [rng.integers(0, 256, n).astype(np.uint8).tobytes() for n in sizes]
+            host = HostCodec().encode(blocks, 4, 2)
+            results = [None] * len(blocks)
+
+            def work(i):
+                results[i] = b.encode([blocks[i]], 4, 2)[0]
+
+            threads = [threading.Thread(target=work, args=(i,)) for i in range(len(blocks))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(30)
+            for i in range(len(blocks)):
+                assert results[i] is not None, i
+                assert results[i][0] == host[i][0], i
+                assert results[i][1] == host[i][1], i
+            st = b.stats()
+            assert st["small_blocks_encoded"] == len(blocks)
+            # The 20 ms window must have coalesced 4 concurrent requests
+            # into fewer dispatches than requests.
+            assert 1 <= st["small_batches_run"] < len(blocks)
+        finally:
+            b.close()
+
+    def test_small_path_disabled_when_wait_unset(self, monkeypatch):
+        monkeypatch.delenv("MTPU_BATCH_WAIT_US", raising=False)
+        b = BatchingDeviceCodec(block_size=BLOCK, max_batch=8, batch_timeout_s=0.002)
+        try:
+            assert b.small_wait_s is None or b.small_wait_s >= 0  # default on (500us)
+        finally:
+            b.close()
+        monkeypatch.setenv("MTPU_BATCH_WAIT_US", "off")
+        b2 = BatchingDeviceCodec(block_size=BLOCK, max_batch=8, batch_timeout_s=0.002)
+        try:
+            assert b2.small_wait_s is None
+            rng = np.random.default_rng(31)
+            block = rng.integers(0, 256, 12345).astype(np.uint8).tobytes()
+            dev = b2.encode([block], 4, 2)
+            host = HostCodec().encode([block], 4, 2)
+            assert dev[0][0] == host[0][0]
+            assert b2.stats()["small_blocks_encoded"] == 0  # host path served
+        finally:
+            b2.close()
+
+    def test_tiny_objects_stay_on_host(self, monkeypatch):
+        # Below _SMALL_MIN a device round-trip costs more than it saves.
+        monkeypatch.setenv("MTPU_BATCH_WAIT_US", "1000")
+        b = BatchingDeviceCodec(block_size=BLOCK, max_batch=8, batch_timeout_s=0.002)
+        try:
+            block = b"\x42" * 512
+            dev = b.encode([block], 4, 2)
+            host = HostCodec().encode([block], 4, 2)
+            assert dev[0][0] == host[0][0]
+            assert b.stats()["small_blocks_encoded"] == 0
+        finally:
+            b.close()
+
+
+def test_mesh_and_double_buffer_counters():
+    """Full-block batches at the production geometry report mesh fan-out and
+    per-chip accounting (12+4 tiles the virtual 8-device mesh; 4+2 does not
+    and runs single-device)."""
+    b = BatchingDeviceCodec(block_size=BLOCK, max_batch=8, batch_timeout_s=0.002)
+    try:
+        rng = np.random.default_rng(40)
+        blocks = [rng.integers(0, 256, BLOCK).astype(np.uint8).tobytes() for _ in range(4)]
+        host = HostCodec().encode(blocks, 12, 4)
+        for _ in range(3):
+            dev = b.encode(blocks, 12, 4)
+        for i in range(4):
+            assert dev[i][0] == host[i][0], i
+            assert dev[i][1] == host[i][1], i
+        st = b.stats()
+        assert st["mesh_devices"] >= 1
+        if st["mesh_devices"] > 1:  # conftest forces 8 virtual devices
+            # chip_blocks has one entry per data-parallel group.
+            assert 1 <= len(st["chip_blocks"]) <= st["mesh_devices"]
+            assert sum(st["chip_blocks"]) == st["blocks_encoded"]
+        assert st["double_buffered_batches"] >= 0
+    finally:
+        b.close()
+
+
 def test_scanner_deep_scan_runs_device_verify(tmp_path):
     """The scanner's sampled deep-check verifies bitrot through the batched
     device pipeline (VERDICT r3 #9): verify counters must advance."""

@@ -62,6 +62,68 @@ def test_mesh_factoring():
         assert dp >= tp >= sp
 
 
+class TestMeshShapeEnv:
+    """MTPU_MESH_SHAPE parsing + the cached codec mesh BatchingDeviceCodec
+    fans batches over."""
+
+    def test_explicit_shape(self, monkeypatch):
+        monkeypatch.setenv("MTPU_MESH_SHAPE", "4,2,1")
+        assert mesh_lib.mesh_shape_from_env(8) == (4, 2, 1)
+
+    def test_off_disables(self, monkeypatch):
+        for raw in ("off", "0", "1"):
+            monkeypatch.setenv("MTPU_MESH_SHAPE", raw)
+            assert mesh_lib.mesh_shape_from_env(8) is None
+
+    def test_auto_and_malformed_fall_back_to_factoring(self, monkeypatch):
+        want = mesh_lib.factor_mesh(8)
+        for raw in ("", "auto", "banana", "2,2", "3,3,3", "-1,4,2"):
+            monkeypatch.setenv("MTPU_MESH_SHAPE", raw)
+            assert mesh_lib.mesh_shape_from_env(8) == want
+
+    def test_codec_mesh_cached(self, monkeypatch):
+        if jax.device_count() < 8:
+            pytest.skip("needs the 8-device virtual platform from conftest")
+        monkeypatch.setattr(mesh_lib, "_codec_mesh_cache", [])
+        monkeypatch.setenv("MTPU_MESH_SHAPE", "8,1,1")
+        m1 = mesh_lib.codec_mesh()
+        assert m1 is not None and m1.shape["dp"] == 8
+        # Cached: a later env change does not rebuild (one mesh per process).
+        monkeypatch.setenv("MTPU_MESH_SHAPE", "off")
+        assert mesh_lib.codec_mesh() is m1
+
+    def test_codec_mesh_off(self, monkeypatch):
+        monkeypatch.setattr(mesh_lib, "_codec_mesh_cache", [])
+        monkeypatch.setenv("MTPU_MESH_SHAPE", "off")
+        assert mesh_lib.codec_mesh() is None
+
+
+def test_pallas_rs_under_mesh_matches_host():
+    """The XOR-bitmatrix Pallas codec shard_mapped data-parallel over all 8
+    virtual devices stays bit-identical to the host oracle (the bench's
+    multichip_encode_gibs program)."""
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device virtual platform from conftest")
+    from jax.sharding import PartitionSpec as P
+
+    from minio_tpu.ops.rs_pallas import RSPallasCodec
+
+    n = 8
+    mesh = mesh_lib.make_mesh(n, (n, 1, 1))
+    codec = RSPallasCodec(K, M)
+    enc = jax.jit(
+        mesh_lib.shard_map_compat(
+            codec.encode, mesh=mesh,
+            in_specs=P("dp", None, None), out_specs=P("dp", None, None),
+        )
+    )
+    rng = np.random.default_rng(13)
+    data = rng.integers(0, 256, (n, K, 4096), dtype=np.uint8)
+    got = np.asarray(enc(jax.device_put(data, mesh_lib.data_sharding(mesh))))
+    for i in range(n):
+        np.testing.assert_array_equal(got[i], rs_ref.encode(data[i], M)[K:])
+
+
 def test_default_mesh_dryrun():
     """The exact program the driver's dryrun_multichip exercises."""
     if jax.device_count() < 8:

@@ -434,6 +434,43 @@ class TestPerfGate:
         assert perf_gate.compare({}, {}, threshold=0.1) == []
 
 
+class TestCodecFloor:
+    """Device-claiming BENCH lines must beat their own recorded CPU floor."""
+
+    def test_device_slower_than_cpu_floor_flags(self):
+        new = {"device": True, "value": 1.2, "cpu_avx2_gibs": 2.0}
+        findings = perf_gate.codec_floor_findings(new)
+        assert [f["metric"] for f in findings] == ["value"]
+
+    def test_device_beating_floor_passes(self):
+        new = {"device": True, "value": 18.0, "cpu_avx2_gibs": 2.0,
+               "pallas_fused_gibs": 9.0, "pallas_fused_error": ""}
+        assert perf_gate.codec_floor_findings(new) == []
+
+    def test_wedged_probe_round_never_gates(self):
+        # device: false = CPU fallback (wedged tunnel): a probe finding,
+        # not a codec regression -- even though value == cpu floor.
+        new = {"device": False, "value": 2.0, "cpu_avx2_gibs": 2.0}
+        assert perf_gate.codec_floor_findings(new) == []
+
+    def test_fused_below_floor_flags_when_measured(self):
+        new = {"device": True, "value": 18.0, "cpu_avx2_gibs": 2.0,
+               "pallas_fused_gibs": 1.5, "pallas_fused_error": ""}
+        findings = perf_gate.codec_floor_findings(new)
+        assert [f["metric"] for f in findings] == ["pallas_fused_gibs"]
+
+    def test_unmeasured_or_errored_fused_is_not_gated(self):
+        # 0.0 = not measured; a recorded error = known-skipped secondary.
+        for extra in ({"pallas_fused_gibs": 0.0},
+                      {"pallas_fused_gibs": 1.0, "pallas_fused_error": "boom"}):
+            new = {"device": True, "value": 18.0, "cpu_avx2_gibs": 2.0, **extra}
+            assert perf_gate.codec_floor_findings(new) == []
+
+    def test_missing_keys_never_gate(self):
+        assert perf_gate.codec_floor_findings({"device": True}) == []
+        assert perf_gate.codec_floor_findings({}) == []
+
+
 class TestPerfGateSlo:
     """--slo mode over loadgen reports (tools/loadgen.py emissions)."""
 

@@ -190,3 +190,79 @@ def test_probe_same_verdict_is_not_a_transition(_probe_cache_file):
     with open(_probe_cache_file) as f:
         doc = json.load(f)
     assert doc["transitions"] == [] and doc["transition"] is None
+
+
+def test_probe_transition_counts(monkeypatch):
+    monkeypatch.setattr(runtime, "_transition_counts", {"fallback": 0, "recovery": 0})
+    monkeypatch.setattr(runtime, "_last_transition", None)
+    runtime._note_transition(
+        runtime._transition_between("tpu", runtime.ProbeResult(None, error="x"))
+    )
+    runtime._note_transition(
+        runtime._transition_between(None, runtime.ProbeResult("tpu"))
+    )
+    runtime._note_transition(None)  # same-verdict: no flip, no count
+    assert runtime.probe_transition_counts() == {"fallback": 1, "recovery": 1}
+
+
+# -- periodic recovery re-probe (BENCH r04-r05 wedge: CPU-parked node) ---------
+
+
+def test_recovery_reprobe_reinstalls_device_codec(monkeypatch):
+    """A node that booted onto the host codec (failed probe) re-acquires the
+    device on the recovery cadence without a restart."""
+    import time
+
+    verdicts = [runtime.ProbeResult(None, error="wedged at boot")]
+
+    def probe(t):
+        return verdicts.pop(0) if verdicts else runtime.ProbeResult("tpu")
+
+    monkeypatch.setattr(runtime, "probe_device", probe)
+    monkeypatch.setenv("MTPU_PROBE_RECOVERY_S", "0.05")
+    codec = runtime.install_data_plane_codec(mode="auto")
+    try:
+        assert isinstance(codec, HostCodec)  # boot verdict: fall back
+        t0 = time.monotonic()
+        while not isinstance(codec_mod.default_codec(), BatchingDeviceCodec):
+            assert time.monotonic() - t0 < 10, "recovery re-probe never landed"
+            time.sleep(0.02)
+        # The daemon exits after the swap: one recovery, then done.
+        t = runtime._reprobe_thread
+        if t is not None:
+            t.join(timeout=5)
+            assert not t.is_alive()
+    finally:
+        runtime.shutdown_data_plane(codec_mod._default)
+
+
+def test_recovery_reprobe_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("MTPU_PROBE_RECOVERY_S", "0")
+    monkeypatch.setattr(runtime, "probe_device", lambda t: runtime.ProbeResult(None, error="x"))
+    monkeypatch.setattr(runtime, "_reprobe_thread", None)
+    codec = runtime.install_data_plane_codec(mode="auto")
+    assert isinstance(codec, HostCodec)
+    assert runtime._reprobe_thread is None  # no daemon armed
+
+
+def test_recovery_reprobe_stops_on_shutdown(monkeypatch):
+    """shutdown_data_plane stops a still-waiting recovery daemon (the probe
+    keeps failing, so only the stop event can end it)."""
+    monkeypatch.setattr(runtime, "probe_device", lambda t: runtime.ProbeResult(None, error="x"))
+    monkeypatch.setenv("MTPU_PROBE_RECOVERY_S", "30")
+    codec = runtime.install_data_plane_codec(mode="auto")
+    assert isinstance(codec, HostCodec)
+    t = runtime._reprobe_thread
+    assert t is not None and t.is_alive()
+    runtime.shutdown_data_plane(codec)
+    t.join(timeout=5)
+    assert not t.is_alive()
+
+
+def test_probe_summary_shape(monkeypatch):
+    monkeypatch.setenv("MTPU_PROBE_RECOVERY_S", "0")
+    s = runtime.probe_summary()
+    assert set(s) >= {"done", "ok", "platform", "cached",
+                      "transition", "transition_counts", "recovery"}
+    assert s["recovery"]["interval_s"] == 0.0
+    assert set(s["transition_counts"]) == {"fallback", "recovery"}

@@ -14,6 +14,16 @@ A stage is flagged when BOTH hold:
   * its absolute time grew too -- a share can grow because OTHER stages
     got faster, which is an improvement, not a regression.
 
+Codec-floor mode (automatic in stage mode): when the new BENCH line claims
+`device: true`, its headline encode number -- and the fused Pallas number,
+when measured -- must beat the same line's recorded CPU floor
+(`cpu_avx2_gibs`). A "device" round that encodes slower than the host AVX2
+path means the device codec regressed into net-negative territory; the
+seed shipped exactly that (`pallas_encode_gibs: 0.0`) for five rounds
+without any gate noticing. Wedged-probe rounds report `device: false` and
+are never floor-gated -- a dead tunnel is a probe finding, not a codec
+regression.
+
 SLO mode (`--slo`) gates loadgen reports (tools/loadgen.py) instead:
 per-op p99 regressions between two same-scenario reports, plus absolute
 SLO violations (budget burn > 1, declared p99 target missed) in the new
@@ -79,6 +89,43 @@ def compare(old: dict, new: dict, threshold: float = DEFAULT_THRESHOLD) -> list[
                     }
                 )
     return flagged
+
+
+def codec_floor_findings(new: dict) -> list[dict]:
+    """Device-codec floor violations in one BENCH line (empty when the line
+    makes no device claim or carries no codec keys).
+
+    Gated metrics: the headline `value` (device encode GiB/s) always; the
+    fused Pallas number only when it was actually measured (non-zero, no
+    recorded error) -- a skipped secondary metric is absence of evidence,
+    not a regression.
+    """
+    if new.get("device") is not True:
+        return []
+    try:
+        floor = float(new.get("cpu_avx2_gibs", 0.0))
+    except (TypeError, ValueError):
+        return []
+    if floor <= 0:
+        return []
+    findings: list[dict] = []
+    for key, err_key in (("value", None), ("pallas_fused_gibs", "pallas_fused_error")):
+        if key not in new:
+            continue
+        if err_key and new.get(err_key):
+            continue
+        try:
+            v = float(new[key])
+        except (TypeError, ValueError):
+            continue
+        if key != "value" and v == 0.0:
+            continue
+        if v <= floor:
+            findings.append(
+                {"kind": "codec-floor", "metric": key,
+                 "gibs": v, "cpu_floor_gibs": floor}
+            )
+    return findings
 
 
 def compare_slo(
@@ -207,10 +254,16 @@ def main(argv: list[str]) -> int:
         if not findings:
             print("perf_gate: slo ok")
         return 1 if findings else 0
+    floor = codec_floor_findings(new)
+    for f in floor:
+        print(
+            f"CODEC FLOOR {f['metric']}: {f['gibs']:.2f} GiB/s on-device "
+            f"<= CPU floor {f['cpu_floor_gibs']:.2f} GiB/s"
+        )
     if not _breakdowns(old) or not _breakdowns(new):
         print("perf_gate: no stage_breakdown on one side; nothing to compare",
               file=sys.stderr)
-        return 2
+        return 1 if floor else 2
     flagged = compare(old, new, threshold)
     for f in flagged:
         print(
@@ -218,9 +271,9 @@ def main(argv: list[str]) -> int:
             f"{f['old_share']:.3f} -> {f['new_share']:.3f}, "
             f"{f['old_total_ms']:.1f} ms -> {f['new_total_ms']:.1f} ms"
         )
-    if not flagged:
+    if not flagged and not floor:
         print("perf_gate: ok")
-    return 1 if flagged else 0
+    return 1 if (flagged or floor) else 0
 
 
 if __name__ == "__main__":
