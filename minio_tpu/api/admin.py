@@ -566,6 +566,11 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             # codec and will retry the device in N seconds".
             "probe": runtime.probe_summary(),
         }
+        # Hot-read memory tier counters (absent when MTPU_MEMCACHE_MB=0):
+        # the loadgen report's cache block reads these.
+        mc = getattr(ctx.metrics, "memcache", None) if ctx.metrics else None
+        if mc is not None:
+            out["memcache"] = mc.stats()
 
         drives = {}
         for p in ctx.layer.pools:

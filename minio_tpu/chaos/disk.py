@@ -118,6 +118,15 @@ class FaultyDisk:
                 args = (args[0], args[1], flip_byte(bytes(args[2]))) + args[3:]
             elif op in _BITROT_READ_OPS:
                 return flip_byte(bytes(attr(*args, **kwargs)))
+            elif op == "read_file_into":
+                # In-place read: run the real call, then flip a byte inside
+                # the caller's pooled window so verify fails downstream.
+                n = attr(*args, **kwargs)
+                buf = kwargs.get("buf") if len(args) < 4 else args[3]
+                if n and buf is not None:
+                    i = int(n) // 2
+                    buf[i] ^= 0xFF
+                return n
         if attr is None:  # walk_dir latency path
             return None
         return attr(*args, **kwargs)

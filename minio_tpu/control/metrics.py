@@ -76,6 +76,7 @@ class MetricsSys:
         self.healmgr = None  # HealManager sequence counters
         self.mrf = None  # MRFQueue heal backlog
         self.disk_heal = None  # DiskHealMonitor completed trackers
+        self.memcache = None  # MemObjectCache: hot-read tier counters
 
     # -- recording -----------------------------------------------------------
 
@@ -205,6 +206,7 @@ class MetricsSys:
         self._render_crash(metric)
         self._render_degrade(metric)
         self._render_san(metric)
+        self._render_memcache(metric)
 
         if self.layer is not None:
             total = free = 0
@@ -684,6 +686,33 @@ class MetricsSys:
             metric("minio_tpu_san_lock_wait_seconds_total",
                    st["wait_s"], {"lock": name},
                    help_="Cumulative time spent waiting to acquire, by lock class.")
+
+    def _render_memcache(self, metric) -> None:
+        """Hot-read memory cache tier (object/memcache.py). Absent when the
+        node runs without MTPU_MEMCACHE_MB -- no tier, no series."""
+        mc = self.memcache
+        if mc is None:
+            return
+        st = mc.stats()
+        metric("minio_tpu_memcache_limit_bytes", st["limit_bytes"],
+               help_="Configured memory cache budget.", type_="gauge")
+        metric("minio_tpu_memcache_used_bytes", st["bytes"],
+               help_="Bytes currently cached.", type_="gauge")
+        metric("minio_tpu_memcache_entries", st["entries"],
+               help_="Entries currently cached.", type_="gauge")
+        metric("minio_tpu_memcache_hits_total", st["hits"],
+               help_="Reads served from the memory cache.")
+        metric("minio_tpu_memcache_misses_total", st["misses"],
+               help_="Reads that fell through to the erasure layer.")
+        metric("minio_tpu_memcache_fills_total", st["fills"],
+               help_="Entries admitted after a miss.")
+        metric("minio_tpu_memcache_evictions_total", st["evictions"],
+               help_="Entries evicted to stay under budget.")
+        metric("minio_tpu_memcache_invalidations_total", st["invalidations"],
+               help_="Entries dropped by write-path or peer invalidation.")
+        metric("minio_tpu_memcache_singleflight_waits_total",
+               st["singleflight_waits"],
+               help_="Concurrent misses that waited on an in-flight fill.")
 
     # -- cluster view --------------------------------------------------------
 

@@ -76,7 +76,12 @@ STAGES: frozenset = frozenset({
     ("object", "shard-fanout"),
     ("object", "commit"),
     ("object", "shard-read"),
+    ("object", "frame-parse"),
     ("object", "decode"),
+    # object/memcache.py hot-tier stages (direct ledger records: hits are
+    # served on whatever thread asked; fills time the leader's backend read)
+    ("object", "cache-hit"),
+    ("object", "cache-fill"),
     ("object", "object.PutObject"),
     ("object", "object.GetObject"),
     ("object", "object.DeleteObject"),

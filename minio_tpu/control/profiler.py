@@ -52,6 +52,8 @@ ROLE_PREFIXES: tuple[tuple[str, str], ...] = (
     ("encode-batch", "codec-batch"),     # parallel/batching.py workers
     ("codec-", "codec-batch"),           # codec-warmup / codec-probe
     ("etag-md5", "hash"),                # object/erasure.py pipelined MD5
+    ("put-stager", "stager"),            # PUT readahead (object/erasure.py)
+    ("get-stager", "stager"),            # GET readahead (object/erasure.py)
     ("peer-stream-pump", "rpc"),
     ("hub-bridge", "rpc"),
     ("lock-refresh", "rpc"),

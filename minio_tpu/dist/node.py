@@ -346,8 +346,29 @@ class Node:
 
         cache_cfg = CacheConfig.from_env()
         self.cache = CacheObjectLayer(self.pools, cache_cfg) if cache_cfg else None
+        # Hot tier above the disk cache: an in-memory coherent LRU
+        # (MTPU_MEMCACHE_MB). Writes through the serving layer invalidate
+        # every peer's memcache BEFORE acking, via the same peer channel
+        # bucket metadata rides (object/memcache.py).
+        from ..object.memcache import (
+            MemCacheConfig,
+            MemCacheObjectLayer,
+            MemObjectCache,
+        )
+
+        mem_cfg = MemCacheConfig.from_env()
+        self.memcache = MemObjectCache(mem_cfg) if mem_cfg else None
+        serving_layer = self.cache if cache_cfg else self.pools
+        if self.memcache is not None:
+            serving_layer = MemCacheObjectLayer(
+                serving_layer,
+                self.memcache,
+                on_invalidate=(
+                    lambda b, o: self.notification.invalidate_memcache_all(b, o)
+                ),
+            )
         self.s3 = S3Server(
-            self.cache if cache_cfg else self.pools,
+            serving_layer,
             self.iam,
             region=self.region,
             check_skew=False,
@@ -436,6 +457,7 @@ class Node:
         self.metrics.healmgr = self.healmgr
         self.metrics.mrf = self.mrf
         self.metrics.disk_heal = self.disk_heal
+        self.metrics.memcache = self.memcache
         # Rehydrate notification rules from persisted bucket metadata: the
         # notifier starts empty, and without this pass a restart silently
         # stops event delivery for every configured bucket until an

@@ -93,6 +93,20 @@ def make_peer_app(node, token: str) -> web.Application:
             node.pools.invalidate_bucket_cache(bucket)
         return {"ok": True}
 
+    def h_memcache_invalidate(a):
+        """Per-object memcache invalidation (the hot-read tier's coherence
+        channel): a peer that just acked a PUT/DELETE/COPY drops OUR cached
+        entries before its client sees the ack. Empty object = whole bucket."""
+        mc = getattr(node, "memcache", None)
+        if mc is not None:
+            bucket = a.get("bucket", "")
+            obj = a.get("object", "")
+            if obj:
+                mc.invalidate_object(bucket, obj)
+            elif bucket:
+                mc.invalidate_bucket(bucket)
+        return {"ok": True}
+
     def h_top_locks(a):
         return node.locker.top_locks()
 
@@ -244,6 +258,7 @@ def make_peer_app(node, token: str) -> web.Application:
         "serverinfo": h_server_info,
         "reloadiam": h_reload_iam,
         "reloadbucketmeta": h_reload_bucket_meta,
+        "memcacheinv": h_memcache_invalidate,
         "toplocks": h_top_locks,
         "speedtest": h_speedtest,
         "profilestart": h_profile_start,
@@ -282,6 +297,14 @@ class PeerClient:
         self, bucket: str = "", timeout: float | None = None
     ) -> None:
         self.client.call("/reloadbucketmeta", {"bucket": bucket}, timeout=timeout)
+
+    def invalidate_memcache(
+        self, bucket: str, object_name: str = "", timeout: float | None = None
+    ) -> None:
+        self.client.call(
+            "/memcacheinv", {"bucket": bucket, "object": object_name},
+            timeout=timeout,
+        )
 
     def node_metrics(self, timeout: float | None = None) -> str:
         r = self.client.call("/metrics", {}, timeout=timeout)
@@ -373,6 +396,12 @@ class NotificationSys:
 
     def reload_bucket_meta_all(self, bucket: str = "") -> None:
         self._fanout(lambda p, t: p.reload_bucket_meta(bucket, timeout=t))
+
+    def invalidate_memcache_all(self, bucket: str, object_name: str = "") -> None:
+        """Synchronous cross-node memcache invalidation: the writing node
+        calls this BEFORE acking its client, so a subsequent read on any
+        peer misses (or revalidates) instead of serving the old bytes."""
+        self._fanout(lambda p, t: p.invalidate_memcache(bucket, object_name, timeout=t))
 
     def server_info_all(self) -> list[dict]:
         out = []

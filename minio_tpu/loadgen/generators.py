@@ -31,7 +31,8 @@ class ZipfianGenerator:
     (YCSB default 0.99 gives ~10% of keys ~60% of traffic at n=256).
     """
 
-    def __init__(self, n: int, theta: float, rng: random.Random):
+    def __init__(self, n: int, theta: float, rng: random.Random,
+                 perm_rng: random.Random | None = None):
         if n <= 0:
             raise ValueError("zipfian needs n > 0")
         if not (0.0 <= theta < 1.0):
@@ -45,8 +46,11 @@ class ZipfianGenerator:
             zeta2 = sum(1.0 / (i + 1) ** theta for i in range(min(2, n)))
             self._eta = (1.0 - (2.0 / n) ** (1.0 - theta)) / (1.0 - zeta2 / self._zetan)
         # Scramble ranks -> key ids so the hot set is namespace-spread.
+        # perm_rng (when given) decouples WHICH keys are hot from the draw
+        # stream: phases seeded differently still agree on the hot set, so
+        # a warmed cache phase actually re-reads the keys that warmed it.
         self._perm = list(range(n))
-        rng.shuffle(self._perm)
+        (perm_rng or rng).shuffle(self._perm)
 
     def next_rank(self) -> int:
         """Next popularity rank (0 = hottest)."""
@@ -120,7 +124,12 @@ def generate_ops(scenario: Scenario, phase: Phase, count: int) -> list[Op]:
     """
     seed = (scenario.seed * 1_000_003 + _phase_ordinal(scenario, phase)) & 0x7FFFFFFF
     rng = random.Random(seed)
-    zipf = ZipfianGenerator(scenario.keys, scenario.zipf_theta, rng)
+    theta = scenario.zipf_theta if phase.zipf_theta is None else phase.zipf_theta
+    # The rank->key permutation is scenario-seeded (NOT phase-seeded): the
+    # hot set is a property of the workload, stable across phases.
+    zipf = ZipfianGenerator(
+        scenario.keys, theta, rng, perm_rng=random.Random(scenario.seed ^ 0x5A1F)
+    )
     sizes = SizeDistribution(phase.sizes or scenario.sizes)
     kinds = sorted(phase.mix)
     weights = [phase.mix[k] for k in kinds]

@@ -149,6 +149,39 @@ def _evaluate_one(cmp: dict, phases: dict) -> dict:
     }
 
 
+def evaluate_cache(scenario: Scenario, cache: dict, phases: dict) -> dict | None:
+    """Judge the memcache hit ratio against the spec's `cache` block.
+
+    The judged ratio is one phase's counter DELTA when the block names a
+    phase (a cold sweep legitimately misses; only the hot storm is held to
+    the promise), else the run-cumulative ratio. A spec that declares the
+    gate but ran against a cluster without the tier fails loudly -- a
+    hot-read scenario silently measuring the uncached path is the worst
+    outcome."""
+    gate = scenario.cache
+    if gate is None:
+        return None
+    phase_name = gate.get("phase") or ""
+    if phase_name:
+        row = phases.get(phase_name, {}).get("cache", {})
+    else:
+        row = cache
+    if not row:
+        return {
+            "min_hit_ratio": gate["min_hit_ratio"],
+            "phase": phase_name,
+            "error": "no memcache counters (tier disabled? MTPU_MEMCACHE_MB)",
+            "ok": False,
+        }
+    ratio = float(row.get("hit_ratio", 0.0))
+    return {
+        "min_hit_ratio": gate["min_hit_ratio"],
+        "phase": phase_name,
+        "hit_ratio": ratio,
+        "ok": ratio >= gate["min_hit_ratio"],
+    }
+
+
 def build_report(
     scenario: Scenario,
     results: list[PhaseResult],
@@ -157,6 +190,7 @@ def build_report(
     probe_cached: bool = False,
     lock_profile: dict | None = None,
     profile: dict | None = None,
+    cache: dict | None = None,
 ) -> dict:
     phases: dict = {}
     for pr in results:
@@ -173,6 +207,8 @@ def build_report(
             ],
             "chaos_windows": pr.chaos_windows,
         }
+        if pr.cache:
+            phases[pr.name]["cache"] = pr.cache
     merged = _merged_ops(results)
     report = {
         "loadgen_report": 1,
@@ -196,6 +232,11 @@ def build_report(
         # stacks, sampler overhead, and the per-hop copy ledger -- so the
         # report names the bottleneck, not just the tails.
         report["profile"] = profile
+    if cache:
+        report["cache"] = dict(cache)
+    cache_slo = evaluate_cache(scenario, cache or {}, phases)
+    if cache_slo is not None:
+        report["cache_slo"] = cache_slo
     cmp = _evaluate_compare(scenario, phases)
     if cmp is not None:
         report["compare"] = cmp
@@ -278,4 +319,16 @@ def render_prometheus(report: dict) -> str:
                 f'minio_tpu_loadgen_slo_burn{{scenario="{sc}",op="{_esc(op)}"}} '
                 f"{row['budget_burn']}"
             )
+
+    cache = report.get("cache") or {}
+    if cache:
+        lines.append(
+            "# HELP minio_tpu_loadgen_cache_hit_ratio Run-cumulative memcache "
+            "hit ratio of the driven cluster."
+        )
+        lines.append("# TYPE minio_tpu_loadgen_cache_hit_ratio gauge")
+        lines.append(
+            f'minio_tpu_loadgen_cache_hit_ratio{{scenario="{sc}"}} '
+            f"{cache.get('hit_ratio', 0.0)}"
+        )
     return "\n".join(lines) + "\n"

@@ -59,6 +59,26 @@ class PhaseResult:
     # second offset -> {"ops": n, "errors": n}
     timeline: dict = field(default_factory=dict)
     chaos_windows: list = field(default_factory=list)
+    # memcache counter DELTA over this phase ({} when the tier is off):
+    # run-cumulative counters can't judge one phase's hit ratio.
+    cache: dict = field(default_factory=dict)
+
+
+_CACHE_COUNTERS = (
+    "hits", "misses", "fills", "evictions", "invalidations",
+    "singleflight_waits",
+)
+
+
+def _cache_delta(before: dict, after: dict) -> dict:
+    if not after:
+        return {}
+    out = {k: after.get(k, 0) - before.get(k, 0) for k in _CACHE_COUNTERS}
+    out["bytes"] = after.get("bytes", 0)
+    out["entries"] = after.get("entries", 0)
+    lookups = out["hits"] + out["misses"]
+    out["hit_ratio"] = round(out["hits"] / lookups, 4) if lookups else 0.0
+    return out
 
 
 class ScenarioRunner:
@@ -133,6 +153,10 @@ class ScenarioRunner:
             truncated=not phase.ops,
             op_hash=op_sequence_hash(ops),
         )
+        try:
+            cache_before = self.admin.cache_stats()
+        except Exception:  # noqa: BLE001 - a live target may deny admin
+            cache_before = {}
         stats_lock = san_lock("ScenarioRunner.stats_lock")
         next_idx = itertools.count()
         stop = threading.Event()
@@ -232,6 +256,10 @@ class ScenarioRunner:
             for fid in list(armed):
                 disarm(fid)
         pr.wall_s = time.monotonic() - start
+        try:
+            pr.cache = _cache_delta(cache_before, self.admin.cache_stats())
+        except Exception:  # noqa: BLE001
+            pr.cache = {}
         return pr
 
     # -- whole run ---------------------------------------------------------
@@ -271,6 +299,10 @@ class ScenarioRunner:
             degrade = self.admin.degrade()
         except Exception:  # noqa: BLE001
             degrade = {}
+        try:
+            cache = self.admin.cache_stats()
+        except Exception:  # noqa: BLE001
+            cache = {}
         profile = None
         if sc.profile:
             try:
@@ -287,4 +319,5 @@ class ScenarioRunner:
             probe_cached=bool(getattr(self.admin, "probe_cached", False)),
             lock_profile=profile_if_armed(),
             profile=profile,
+            cache=cache,
         )

@@ -73,6 +73,9 @@ class Phase:
     ops: int = 0                   # op count budget (0 = duration-bounded)
     duration_s: float = 0.0        # wall budget (0 = op-count-bounded)
     sizes: dict | None = None      # per-phase override of scenario sizes
+    zipf_theta: float | None = None  # per-phase key-skew override (a cache
+    #                                  scenario drives a uniform cold sweep
+    #                                  then a zipfian hot storm)
     chaos: list[ChaosWindow] = field(default_factory=list)
 
 
@@ -96,6 +99,12 @@ class Scenario:
     phases: list[Phase] = field(default_factory=list)
     compare: dict | None = None    # {"a": phase, "b": phase, "op": kind,
     #                                 "metric": ..., "min_ratio": r}
+    cache: dict | None = None      # {"min_hit_ratio": r, "phase": name?}:
+    #                                 judge the memcache hit ratio (of one
+    #                                 phase's delta, or the whole run)
+    env: dict = field(default_factory=dict)  # env knobs the in-process
+    #                                 cluster is built under (e.g.
+    #                                 MTPU_MEMCACHE_MB); ignored for live
     get_miss_is_loss: bool = False  # scenario never deletes + GETs only
     #                                 prepopulated keys: a GET NoSuchKey is
     #                                 an acked object lost, a hard verdict
@@ -165,6 +174,11 @@ def _parse_phase(doc, path: str) -> Phase:
         ph.sizes = _parse_sizes(
             _require(doc, path, "sizes", dict, required=True), f"{path}.sizes"
         )
+    if "zipf_theta" in doc:
+        theta = float(_number(doc, path, "zipf_theta", required=True, minimum=0))
+        if theta >= 1.0:
+            raise SpecError(f"{path}.zipf_theta", f"must be < 1.0, got {theta}")
+        ph.zipf_theta = theta
     if not ph.ops and not ph.duration_s:
         raise SpecError(path, "phase needs ops or duration_s (both zero)")
     for i, cw in enumerate(doc.get("chaos") or []):
@@ -268,6 +282,20 @@ def parse_scenario(doc: dict) -> Scenario:
     names = [p.name for p in sc.phases]
     if len(set(names)) != len(names):
         raise SpecError("$.phases", f"duplicate phase names: {names}")
+    env = _require(doc, "$", "env", dict, default={})
+    for k, v in env.items():
+        if not isinstance(v, (str, int, float)) or isinstance(v, bool):
+            raise SpecError(f"$.env.{k}", f"expected string/number, got {type(v).__name__}")
+        sc.env[str(k)] = str(v)
+    cache = _require(doc, "$", "cache", dict, default=None)
+    if cache is not None:
+        ratio = _number(cache, "$.cache", "min_hit_ratio", required=True, minimum=0)
+        if ratio > 1.0:
+            raise SpecError("$.cache.min_hit_ratio", f"must be <= 1.0, got {ratio}")
+        phase_name = _require(cache, "$.cache", "phase", str, default="")
+        if phase_name and phase_name not in names:
+            raise SpecError("$.cache.phase", f"unknown phase {phase_name!r}")
+        sc.cache = {"min_hit_ratio": float(ratio), "phase": phase_name}
     if sc.compare is not None:
         # One block (dict, the historical shape) or a list of blocks (e.g.
         # a concurrency sweep asserting one ratio per rung).

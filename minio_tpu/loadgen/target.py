@@ -223,10 +223,31 @@ class InProcessAdmin:
 
     probe_cached = False
 
+    def __init__(self, cluster=None):
+        # Optional InProcessCluster handle: memcache counters are per-node
+        # objects (not global singletons), so the hot-read report needs the
+        # nodes to sum them over. Tests that don't care pass nothing.
+        self.cluster = cluster
+
     def stage_breakdown(self) -> dict:
         from ..control.perf import GLOBAL_PERF, summarize
 
         return summarize(GLOBAL_PERF.ledger.snapshot())
+
+    def cache_stats(self) -> dict:
+        """Cluster-summed memcache counters ({} when no node runs the tier)."""
+        nodes = getattr(self.cluster, "nodes", None) or ()
+        stats = [
+            n.memcache.stats()
+            for n in nodes
+            if getattr(n, "memcache", None) is not None
+        ]
+        if not stats:
+            return {}
+        out = {k: sum(s[k] for s in stats) for k in stats[0] if k != "hit_ratio"}
+        lookups = out["hits"] + out["misses"]
+        out["hit_ratio"] = round(out["hits"] / lookups, 4) if lookups else 0.0
+        return out
 
     def degrade(self) -> dict:
         from ..control.degrade import GLOBAL_DEGRADE
@@ -291,6 +312,9 @@ class EndpointAdmin:
 
     def degrade(self) -> dict:
         return self._get_json(ADMIN + "/perf").get("degrade", {})
+
+    def cache_stats(self) -> dict:
+        return self._get_json(ADMIN + "/perf").get("memcache", {})
 
     def reset_perf(self) -> None:
         self.target.request("GET", ADMIN + "/perf",

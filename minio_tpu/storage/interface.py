@@ -78,6 +78,21 @@ class StorageAPI(abc.ABC):
     @abc.abstractmethod
     def read_file(self, volume: str, path: str, offset: int = 0, length: int = -1) -> bytes: ...
 
+    def read_file_into(
+        self, volume: str, path: str, offset: int, buf: memoryview
+    ) -> int:
+        """Read up to len(buf) bytes at `offset` directly into `buf`.
+
+        The zero-copy GET pipeline hands each drive a writable window over a
+        pooled shard buffer; LocalDrive services this with readinto so the
+        bytes land in pooled storage once. The default keeps remote/test
+        drives working through read_file (one read, one copy into the view).
+        Returns the byte count actually read (short at EOF)."""
+        data = self.read_file(volume, path, offset, len(buf))
+        n = len(data)
+        buf[:n] = data
+        return n
+
     @abc.abstractmethod
     def stat_file(self, volume: str, path: str) -> int: ...
 

@@ -423,6 +423,28 @@ class LocalDrive(StorageAPI):
         except IsADirectoryError:
             raise errors.FileNotFound()
 
+    def read_file_into(
+        self, volume: str, path: str, offset: int, buf: memoryview
+    ) -> int:
+        """readinto a caller-owned (pooled) window: bytes land in the
+        destination storage once, with no intermediate bytes object."""
+        p = self._file_path(volume, path)
+        try:
+            with open(p, "rb", buffering=0) as f:
+                f.seek(offset)
+                total = 0
+                want = len(buf)
+                while total < want:
+                    n = f.readinto(buf[total:])
+                    if not n:
+                        break  # EOF short read
+                    total += n
+                return total
+        except FileNotFoundError:
+            raise errors.FileNotFound()
+        except IsADirectoryError:
+            raise errors.FileNotFound()
+
     def stat_file(self, volume: str, path: str) -> int:
         p = self._file_path(volume, path)
         try:

@@ -21,7 +21,8 @@ from ..control.sanitizer import san_lock, san_rlock
 _METERED = frozenset(
     (
         "disk_info make_vol stat_vol list_vols delete_vol write_all read_all "
-        "delete create_file append_file append_iov read_file stat_file read_xl "
+        "delete create_file append_file append_iov read_file read_file_into "
+        "stat_file read_xl "
         "read_version write_metadata update_metadata delete_version "
         "rename_data rename_file list_dir walk_dir verify_file"
     ).split()
@@ -137,6 +138,10 @@ class MeteredDrive:
                     GLOBAL_PROFILER.copy.record("drive-write", MOVED, len(data))
             elif name in _READ_BYTES and out is not None:
                 GLOBAL_PROFILER.copy.record("drive-read", COPIED, len(out))
+            elif name == "read_file_into" and out:
+                # readinto lands bytes in the caller's pooled window: the
+                # drive boundary moves them, nothing is materialized fresh.
+                GLOBAL_PROFILER.copy.record("drive-read", MOVED, int(out))
             return out
 
         return timed

@@ -82,18 +82,28 @@ class BufferPool:
         self._reuses = 0
         self._overflow = 0
 
-    def acquire(self) -> PooledBuffer:
+    def acquire(self, size: int | None = None) -> PooledBuffer:
+        """Hand out a buffer of at least `size` bytes (default buf_size).
+
+        Requests that fit buf_size reuse the pooled storage -- callers slice
+        their own window with view(0, size), so a short read never shrinks
+        the pooled bytearray. Oversize requests overflow-allocate exactly
+        `size` bytes; _recycle_locked drops odd-size storage on release.
+        """
+        want = self.buf_size if size is None else size
+        if want <= 0:
+            raise ValueError("acquire size must be positive")
         with self._lock:
             self._gets += 1
             self._outstanding += 1
-            if self._free:
+            if want <= self.buf_size and self._free:
                 self._reuses += 1
                 return PooledBuffer(self._free.pop(), self)
-            if self._outstanding > self.capacity:
+            if self._outstanding > self.capacity or want > self.buf_size:
                 self._overflow += 1
         # Allocation happens outside the lock: a multi-MiB bytearray fill is
         # not something to serialize the whole data plane behind.
-        return PooledBuffer(bytearray(self.buf_size), self)
+        return PooledBuffer(bytearray(self.buf_size if want <= self.buf_size else want), self)
 
     def _recycle_locked(self, pb: PooledBuffer) -> None:
         self._outstanding -= 1
@@ -138,3 +148,21 @@ def window_pool() -> BufferPool:
             cap = max(1, int(os.environ.get("MTPU_POOL_BUFFERS", "8")))
             _GLOBAL = BufferPool(WINDOW_BYTES, cap, name="put-window")
         return _GLOBAL
+
+
+# The GET pipeline reads one shard row (WINDOW_BYTES / k data bytes plus
+# 32 B digest framing per block) per drive per window. Rows for common k
+# (4..12) fit a 2 MiB buffer; larger rows overflow-allocate exactly.
+SHARD_BYTES = 2 * (1 << 20)
+
+_SHARD: BufferPool | None = None
+
+
+def shard_pool() -> BufferPool:
+    """The shared GET shard-row pool (MTPU_SHARD_BUFFERS sizes it)."""
+    global _SHARD
+    with _global_lock:
+        if _SHARD is None:
+            cap = max(1, int(os.environ.get("MTPU_SHARD_BUFFERS", "32")))
+            _SHARD = BufferPool(SHARD_BYTES, cap, name="get-shard")
+        return _SHARD

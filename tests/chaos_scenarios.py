@@ -510,7 +510,7 @@ class TestHedgedReads:
         before = GLOBAL_DEGRADE.snapshot()
         fid = reg.arm(FaultSpec(
             kind=DRIVE_LATENCY, target=f"disk{target}", delay_ms=1000,
-            ops=("read_file",),
+            ops=("read_file", "read_file_into"),
         ))
         try:
             t0 = time.monotonic()

@@ -886,6 +886,36 @@ def test_hot_path_copy_scoped_to_data_plane_files(tmp_path):
     assert findings == []
 
 
+def test_hot_path_copy_fires_in_memcache(tmp_path):
+    # The hot-read tier (object/memcache.py) is GET-path scope: a cache
+    # hit that materializes the cached bytes instead of handing out views
+    # is exactly the copy the tier exists to avoid.
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/memcache.py": """
+            def serve(entry):
+                buf = bytearray()
+                for c in entry.chunks():
+                    buf += c
+                return bytes(buf)
+        """,
+    }, HotPathCopyRule())
+    assert [f.rule for f in findings] == ["hot-path-copy"] * 2
+    assert sorted(f.line for f in findings) == [4, 5]
+
+
+def test_hot_path_copy_suppressed_with_justification(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/memcache.py": """
+            def serve(entry):
+                buf = bytearray()
+                for c in entry.chunks():
+                    buf += c  # mtpulint: disable=hot-path-copy -- buffered convenience API
+                return bytes(buf)  # mtpulint: disable=hot-path-copy -- buffered convenience API
+        """,
+    }, HotPathCopyRule())
+    assert findings == []
+
+
 # -- unsynced-commit ----------------------------------------------------------
 
 

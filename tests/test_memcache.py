@@ -1,0 +1,314 @@
+"""Hot-read memory cache: coherence, fault isolation, singleflight.
+
+The tier's three promises, each with a test class:
+
+  * Coherence -- a PUT/DELETE through any node drops every node's cached
+    entries BEFORE the write acks (write-path invalidation + synchronous
+    peer fanout), so no reader anywhere observes pre-write bytes from
+    cache after the writer's ack.
+  * Fault isolation -- drive faults during a fill (offline drives, bitrot)
+    either reconstruct the true bytes or cache nothing; a degraded read
+    never poisons the tier with wrong data.
+  * Singleflight -- N concurrent misses on one hot key cost exactly one
+    backend read; followers wait on the leader's flight and serve the
+    fresh entry.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from minio_tpu.object.memcache import (
+    MemCacheConfig,
+    MemCacheObjectLayer,
+    MemObjectCache,
+)
+from minio_tpu.object.types import GetObjectOptions, ObjectInfo
+from minio_tpu.utils import errors
+from tests.harness import ErasureHarness
+
+
+def _mc_layer(backend, limit_mb: int = 64, validate: bool = False):
+    store = MemObjectCache(MemCacheConfig(limit_bytes=limit_mb << 20, validate=validate))
+    return MemCacheObjectLayer(backend, store), store
+
+
+def _read_all(layer, bucket: str, key: str) -> bytes:
+    _, data = layer.get_object(bucket, key)
+    return data
+
+
+# -- store basics -------------------------------------------------------------
+
+
+class TestMemObjectCache:
+    def test_lru_evicts_under_budget(self):
+        store = MemObjectCache(MemCacheConfig(limit_bytes=1 << 20, max_entry_bytes=1 << 20))
+        oi = ObjectInfo(bucket="b", name="o", size=300 << 10, etag="e")
+        for i in range(5):
+            assert store.put(("b", f"o{i}", "", ()), oi, bytes(300 << 10))
+        st = store.stats()
+        assert st["bytes"] <= 1 << 20
+        assert st["evictions"] >= 2
+        # Evicted keys left no reverse-index debris: invalidating them is a
+        # no-op, invalidating a live one drops exactly its entry.
+        live = [k for k in [("b", f"o{i}", "", ()) for i in range(5)] if store.get(k)]
+        assert store.invalidate_object("b", live[0][1]) == 1
+        assert store.get(live[0]) is None
+
+    def test_oversized_entry_rejected(self):
+        store = MemObjectCache(MemCacheConfig(limit_bytes=1 << 20, max_entry_bytes=64 << 10))
+        oi = ObjectInfo(bucket="b", name="o", size=65 << 10, etag="e")
+        assert not store.put(("b", "o", "", ()), oi, bytes(65 << 10))
+        assert store.stats()["entries"] == 0
+
+
+# -- write-path invalidation (single node) ------------------------------------
+
+
+class TestWriteInvalidation:
+    def test_put_drops_cached_entry_before_ack(self, tmp_path):
+        h = ErasureHarness(tmp_path)
+        h.layer.make_bucket("b")
+        mc, store = _mc_layer(h.layer)
+        v1 = os.urandom(1 << 20)
+        mc.put_object("b", "obj", v1)
+        assert _read_all(mc, "b", "obj") == v1  # miss + fill
+        assert store.stats()["fills"] == 1
+        v2 = os.urandom(1 << 20)
+        mc.put_object("b", "obj", v2)
+        # The ack already returned: the stale entry must be gone NOW.
+        assert store.get(("b", "obj", "", ())) is None
+        assert store.stats()["invalidations"] >= 1
+        assert _read_all(mc, "b", "obj") == v2
+
+    def test_delete_drops_cached_entry(self, tmp_path):
+        h = ErasureHarness(tmp_path)
+        h.layer.make_bucket("b")
+        mc, store = _mc_layer(h.layer)
+        mc.put_object("b", "obj", os.urandom(256 << 10))
+        _read_all(mc, "b", "obj")
+        mc.delete_object("b", "obj")
+        assert store.get(("b", "obj", "", ())) is None
+        with pytest.raises(errors.ObjectNotFound):
+            mc.get_object("b", "obj")
+
+
+# -- drive faults during hot GETs ---------------------------------------------
+
+
+class TestFaultsDontPoison:
+    def test_degraded_fill_caches_reconstructed_truth(self, tmp_path):
+        """A fill racing drive loss reconstructs through parity; the entry
+        admitted to the tier must be the true bytes, and later healthy hits
+        serve those same bytes."""
+        h = ErasureHarness(tmp_path)
+        h.layer.make_bucket("b")
+        mc, store = _mc_layer(h.layer)
+        body = os.urandom(2 << 20)
+        mc.put_object("b", "hot", body)
+        h.take_offline(0, 1)
+        try:
+            assert _read_all(mc, "b", "hot") == body  # degraded fill
+        finally:
+            h.bring_online(0, 1)
+        assert store.stats()["fills"] == 1
+        assert _read_all(mc, "b", "hot") == body  # served from cache
+        assert store.stats()["hits"] >= 1
+
+    def test_bitrot_during_fill_caches_reconstructed_truth(self, tmp_path):
+        h = ErasureHarness(tmp_path)
+        h.layer.make_bucket("b")
+        mc, store = _mc_layer(h.layer)
+        body = os.urandom(2 << 20)
+        mc.put_object("b", "hot", body)
+        corrupted = sum(
+            1 for i in range(2) if h.corrupt_shard(i, "b", "hot")
+        )
+        assert corrupted  # at least one shard really flipped
+        assert _read_all(mc, "b", "hot") == body
+        assert _read_all(mc, "b", "hot") == body  # the cached copy is true
+        assert store.stats()["hits"] >= 1
+
+    def test_failed_read_caches_nothing(self, tmp_path):
+        """Below read quorum the GET raises -- and the tier must hold NO
+        entry for the key (caching an error or a partial read would pin the
+        outage past drive recovery)."""
+        h = ErasureHarness(tmp_path)
+        h.layer.make_bucket("b")
+        mc, store = _mc_layer(h.layer)
+        body = os.urandom(1 << 20)
+        mc.put_object("b", "hot", body)
+        h.take_offline(0, 1, 2, 3, 4)  # 11 of 16 rows < k=12
+        try:
+            with pytest.raises(errors.StorageError):
+                _read_all(mc, "b", "hot")
+        finally:
+            h.bring_online(0, 1, 2, 3, 4)
+        assert store.get(("b", "hot", "", ())) is None
+        assert store.stats()["entries"] == 0
+        assert _read_all(mc, "b", "hot") == body  # recovers on healthy drives
+
+
+# -- singleflight -------------------------------------------------------------
+
+
+class _SlowBackend:
+    """Counting stand-in for the erasure layer: one slow read, thread-safe
+    counters, deterministic bytes."""
+
+    def __init__(self, data: bytes, delay_s: float = 0.25):
+        self.data = data
+        self.delay_s = delay_s
+        self.oi = ObjectInfo(bucket="b", name="hot", size=len(data), etag="e1")
+        self.reads = 0
+        self.infos = 0
+        self._lock = threading.Lock()
+
+    def get_object_info(self, bucket, object_name, opts=None):
+        with self._lock:
+            self.infos += 1
+        return self.oi
+
+    def get_object(self, bucket, object_name, opts=None, offset=0, length=-1):
+        with self._lock:
+            self.reads += 1
+        time.sleep(self.delay_s)
+        return self.oi, self.data
+
+
+@pytest.mark.race
+class TestSingleflight:
+    def test_concurrent_hot_misses_read_backend_once(self):
+        """N threads stampede one cold key: exactly one leader pays the
+        backend read; every follower waits on its flight and serves the
+        fresh entry."""
+        n = 8
+        backend = _SlowBackend(os.urandom(512 << 10))
+        mc, store = _mc_layer(backend)
+        barrier = threading.Barrier(n)
+        results: list[bytes | None] = [None] * n
+        failures: list[BaseException] = []
+
+        def reader(i: int) -> None:
+            try:
+                barrier.wait(timeout=10)
+                _, stream = mc.get_object_stream("b", "hot")
+                results[i] = b"".join(bytes(c) for c in stream)
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                failures.append(e)
+
+        threads = [threading.Thread(target=reader, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert not failures
+        assert all(r == backend.data for r in results)
+        assert backend.reads == 1
+        st = store.stats()
+        assert st["fills"] == 1
+        assert st["singleflight_waits"] == n - 1
+
+
+# -- cross-node coherence (2-node cluster) ------------------------------------
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+ROOT = "memadmin"
+SECRET = "memcache-secret-key"
+
+
+@pytest.fixture(scope="module")
+def memcluster(tmp_path_factory):
+    """Two nodes, both with the memory tier armed and per-hit validation
+    off: coherence rides ENTIRELY on the write-path peer fanout, which is
+    exactly what these tests must prove."""
+    from minio_tpu.api.server import ThreadedServer
+    from minio_tpu.dist.node import Node
+    from tests.s3client import S3TestClient
+
+    saved = {
+        k: os.environ.get(k) for k in ("MTPU_MEMCACHE_MB", "MTPU_MEMCACHE_VALIDATE")
+    }
+    os.environ["MTPU_MEMCACHE_MB"] = "64"
+    os.environ["MTPU_MEMCACHE_VALIDATE"] = "0"
+    tmp = tmp_path_factory.mktemp("memcluster")
+    ports = [_free_port(), _free_port()]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    endpoints = []
+    for ni in range(2):
+        for di in range(4):
+            endpoints.append(f"{urls[ni]}{tmp}/n{ni}d{di}")
+    servers = []
+    try:
+        nodes = [
+            Node(endpoints, url=urls[ni], root_user=ROOT, root_password=SECRET,
+                 set_drive_count=8)
+            for ni in range(2)
+        ]
+        for ni, node in enumerate(nodes):
+            ts = ThreadedServer(SimpleNamespace(app=node.make_app()), port=ports[ni])
+            ts.start()
+            servers.append(ts)
+        threads = [threading.Thread(target=n.build) for n in nodes]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert all(n.pools is not None for n in nodes), "cluster failed to build"
+        assert all(n.memcache is not None for n in nodes), "memcache tier absent"
+        clients = [S3TestClient(urls[ni], ROOT, SECRET) for ni in range(2)]
+        yield {"nodes": nodes, "clients": clients}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for ts in servers:
+            ts.stop()
+
+
+class TestCrossNodeCoherence:
+    def test_put_on_a_invalidates_b_memcache_before_ack(self, memcluster):
+        a, b = memcluster["clients"]
+        node_b = memcluster["nodes"][1]
+        assert a.make_bucket("cohere").status_code == 200
+        v1 = os.urandom(128 << 10)
+        assert a.put_object("cohere", "hot.bin", v1).status_code == 200
+        # Warm node B's tier.
+        r = b.get_object("cohere", "hot.bin")
+        assert r.status_code == 200 and r.content == v1
+        assert node_b.memcache.get(("cohere", "hot.bin", "", ())) is not None
+        # Overwrite through node A. The fanout runs before A's ack, so by
+        # the time put_object returns, B's entry is ALREADY gone -- no
+        # sleep, no retry loop.
+        v2 = os.urandom(128 << 10)
+        assert a.put_object("cohere", "hot.bin", v2).status_code == 200
+        assert node_b.memcache.get(("cohere", "hot.bin", "", ())) is None
+        r = b.get_object("cohere", "hot.bin")
+        assert r.status_code == 200 and r.content == v2
+
+    def test_delete_on_a_404s_warm_reader_on_b(self, memcluster):
+        a, b = memcluster["clients"]
+        node_b = memcluster["nodes"][1]
+        body = os.urandom(64 << 10)
+        assert a.put_object("cohere", "gone.bin", body).status_code == 200
+        assert b.get_object("cohere", "gone.bin").content == body
+        assert a.delete_object("cohere", "gone.bin").status_code == 204
+        assert node_b.memcache.get(("cohere", "gone.bin", "", ())) is None
+        assert b.get_object("cohere", "gone.bin").status_code == 404

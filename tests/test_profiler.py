@@ -279,11 +279,18 @@ class TestCopyConservation:
         _, got = hz.layer.get_object("cb", "obj")
         assert got == data
         get_hops = GLOBAL_PROFILER.copy.snapshot()["hops"]
-        # Healthy read: drive frames are fresh buffers (copied), frame
-        # parsing slices them zero-copy, and no decode happens.
-        assert get_hops["drive-read"]["copied_bytes"] >= self.SIZE
+        # Zero-copy healthy read: drives readinto pooled shard buffers
+        # (moved), frame parsing slices them by reference (moved), no
+        # decode happens -- so the whole GET copies NOTHING. (The buffered
+        # get_object() convenience join above sits outside the ledger; the
+        # server streams the same views straight to the socket.)
+        assert get_hops["drive-read"]["moved_bytes"] >= self.SIZE
+        assert get_hops["drive-read"]["copied_bytes"] == 0
         assert get_hops["frame-parse"]["moved_bytes"] >= self.SIZE
+        assert get_hops["frame-parse"]["copied_bytes"] == 0
         assert "decode" not in get_hops
+        copied = sum(h["copied_bytes"] for h in get_hops.values())
+        assert copied == 0, f"healthy GET copied {copied} bytes: {get_hops}"
 
     def test_degraded_read_pays_the_decode_copy(self, tmp_path):
         from tests.harness import ErasureHarness
@@ -297,6 +304,10 @@ class TestCopyConservation:
         # on 8 drives, at least one of drives 0..4 holds a DATA row, so
         # knocking each out in turn must trigger reconstruction at least
         # once (pigeonhole) while parity keeps every read succeeding.
+        # With k=4 data rows and one drive out, a degraded read rebuilds
+        # exactly one row per block: SIZE/4 bytes -- the decode hop must
+        # charge exactly that, never the whole object.
+        shard_bytes = self.SIZE // 4
         decoded = 0
         for i in range(5):
             hz.take_offline(i)
@@ -304,7 +315,11 @@ class TestCopyConservation:
             _, got = hz.layer.get_object("cb", "obj")
             assert got == data
             hops = GLOBAL_PROFILER.copy.snapshot()["hops"]
-            decoded += hops.get("decode", {}).get("copied_bytes", 0)
+            this = hops.get("decode", {}).get("copied_bytes", 0)
+            assert this in (0, shard_bytes), (
+                f"drive {i}: decode charged {this}, want 0 or {shard_bytes}"
+            )
+            decoded += this
             hz.bring_online(i)
         assert decoded > 0, "no offline drive ever forced a decode"
 

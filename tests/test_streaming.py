@@ -38,6 +38,11 @@ class CountingDrive(LocalDrive):
         self.reads.append((path, offset, len(data)))
         return data
 
+    def read_file_into(self, volume, path, offset, buf):
+        n = super().read_file_into(volume, path, offset, buf)
+        self.reads.append((path, offset, n))
+        return n
+
 
 class RecordingCodec(HostCodec):
     def __init__(self):

@@ -94,6 +94,11 @@ def main(argv: list[str]) -> int:
         else:
             from minio_tpu.loadgen.cluster import InProcessCluster
 
+            # Spec-declared env knobs (e.g. MTPU_MEMCACHE_MB for the hot-read
+            # tier) must be live before the nodes build. setdefault: the
+            # operator's explicit environment wins over the spec.
+            for k, v in scenario.env.items():
+                os.environ.setdefault(k, v)
             workdir = tempfile.mkdtemp(prefix="mtpu-loadgen-")
             _log(
                 f"building in-process cluster: {scenario.nodes} nodes x "
@@ -107,7 +112,7 @@ def main(argv: list[str]) -> int:
                 _log(str(e))
                 return 2
             target = S3Target(cluster.urls, cluster.root_user, cluster.root_password)
-            admin = InProcessAdmin()
+            admin = InProcessAdmin(cluster)
 
         report = ScenarioRunner(scenario, target, admin, log=_log).run()
 
@@ -143,6 +148,8 @@ def main(argv: list[str]) -> int:
     cmp_ok = all(b.get("reproduced", True) for b in cmp_blocks)
     loss = report.get("acked_object_loss")
     loss_ok = loss.get("ok", True) if isinstance(loss, dict) else True
+    cache_slo = report.get("cache_slo")
+    cache_ok = cache_slo.get("ok", True) if isinstance(cache_slo, dict) else True
     if not slo_ok:
         _log("SLO VIOLATED (see report.slo)")
     if not cmp_ok:
@@ -152,7 +159,9 @@ def main(argv: list[str]) -> int:
             f"ACKED OBJECT LOSS: {loss.get('get_miss_count')} GET(s) hit "
             "NoSuchKey on a prepopulated, never-deleted key"
         )
-    return 0 if slo_ok and cmp_ok and loss_ok else 1
+    if not cache_ok:
+        _log("cache hit-ratio promise missed (see report.cache_slo)")
+    return 0 if slo_ok and cmp_ok and loss_ok and cache_ok else 1
 
 
 if __name__ == "__main__":

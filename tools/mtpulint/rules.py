@@ -1420,6 +1420,7 @@ class HotPathCopyRule(Rule):
     scope = (
         "minio_tpu/api/streaming.py",
         "minio_tpu/object/erasure.py",
+        "minio_tpu/object/memcache.py",
         "minio_tpu/storage/local.py",
     )
 

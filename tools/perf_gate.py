@@ -136,14 +136,16 @@ def compare_slo(
 ) -> list[dict]:
     """SLO findings between two loadgen reports (tolerates partial shapes).
 
-    Four finding kinds:
+    Five finding kinds:
       * p99-regression: an op's p99 grew past old * (1 + p99_tol) AND by
         more than min_ms (both sides must report the op);
       * burn-violation: the new report burned more than its whole error
         budget (burn > 1.0) -- absolute, old report not required;
       * p99-violation: the new report misses its own declared p99 target;
       * compare-violation: a compare block in the new report (dict, or one
-        entry of a sweep list like put_scaling's) missed its min_ratio.
+        entry of a sweep list like put_scaling's) missed its min_ratio;
+      * cache-violation: the report's cache_slo block (hot-read memcache
+        hit-ratio promise) judged itself not ok.
     """
     findings: list[dict] = []
     old_ops = old.get("ops") if isinstance(old.get("ops"), dict) else {}
@@ -192,6 +194,15 @@ def compare_slo(
                  "ratio": entry.get("ratio"),
                  "min_ratio": entry.get("min_ratio")}
             )
+    cache_slo = new.get("cache_slo")
+    if isinstance(cache_slo, dict) and cache_slo.get("ok") is False:
+        findings.append(
+            {"kind": "cache-violation",
+             "phase": cache_slo.get("phase", ""),
+             "hit_ratio": cache_slo.get("hit_ratio"),
+             "min_hit_ratio": cache_slo.get("min_hit_ratio"),
+             "error": cache_slo.get("error", "")}
+        )
     return findings
 
 
@@ -248,6 +259,11 @@ def main(argv: list[str]) -> int:
             elif f["kind"] == "compare-violation":
                 print(f"COMPARE MISS {f['a']}/{f['b']} {f['op']} {f['metric']}: "
                       f"ratio {f['ratio']} < {f['min_ratio']}")
+            elif f["kind"] == "cache-violation":
+                where = f" ({f['phase']})" if f.get("phase") else ""
+                why = f": {f['error']}" if f.get("error") else (
+                    f": hit ratio {f['hit_ratio']} < {f['min_hit_ratio']}")
+                print(f"CACHE MISS{where}{why}")
             else:
                 print(f"SLO MISS {f['op']}: p99 {f['p99_ms']} ms "
                       f"over target {f['target_p99_ms']} ms")
