@@ -268,6 +268,9 @@ def object_layer_metrics(use_device: bool) -> dict:
         put_snap = GLOBAL_PERF.ledger.snapshot()
         out["putobject_gibs"] = round(PUT_OBJECTS * PUT_SIZE / total / (1 << 30), 3)
         out["putobject_p50_ms"] = round(statistics.median(lat) * 1000, 1)
+        # Requests/second as a first-class axis (the live cluster reports the
+        # same unit via /mtpu/admin/v1/timeseries and the object speedtest).
+        out["puts_per_s"] = round(PUT_OBJECTS / total, 2) if total else 0.0
         out["fsync_mode"] = local_mod.fsync_mode()
 
         # --- durability-knob overhead: same single-stream PUT, barriers off -
@@ -340,9 +343,12 @@ def object_layer_metrics(use_device: bool) -> dict:
         for gi in range(get_iters):
             with tracing.root_span("bench.get", "bench", f"bench-get-{gi}"):
                 read_once(layer, "getobj")
-        out["getobject_gibs"] = round(
-            get_iters * PUT_SIZE / (time.perf_counter() - t0) / (1 << 30), 3
-        )
+        get_dt = time.perf_counter() - t0
+        out["getobject_gibs"] = round(get_iters * PUT_SIZE / get_dt / (1 << 30), 3)
+        out["gets_per_s"] = round(get_iters / get_dt, 2) if get_dt else 0.0
+        out["total_ops_per_s"] = round(
+            (PUT_OBJECTS + get_iters) / (total + get_dt), 2
+        ) if (total + get_dt) else 0.0
         # Zero-copy scorecard for the healthy cold loop just timed: readinto
         # drive reads and memoryview frame-parse are MOVED hops; a single
         # COPIED byte here is a read-pipeline regression (the ISSUE 13

@@ -43,6 +43,8 @@ class AdminContext:
     site_repl: object | None = None  # SiteReplicationSys (site-replication.go)
     bucket_meta: object | None = None  # BucketMetadataSys (quota config)
     kms: object | None = None  # KMS (kms status / key checks)
+    local_drives: object | None = None  # {path: StorageAPI} for the drive probe
+    node_url: str = "local"  # this node's URL (keys selftest per-node results)
 
 
 def make_admin_app(ctx: AdminContext) -> web.Application:
@@ -688,6 +690,94 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             "ramp": ramp,
         }
 
+    # -- live-cluster self-measurement (control/selftest.py; the reference's
+    # speedtest.go / perf-drive.go / perf-net.go admin probes). POST runs a
+    # probe NOW and returns its report; GET re-reads the last completed
+    # report without re-running (a speedtest is expensive). ------------------
+
+    def _selftest():
+        from ..control import selftest
+
+        return selftest
+
+    def _node_url():
+        return getattr(ctx, "node_url", None) or "local"
+
+    def h_speedtest_object(request, body):
+        doc = json.loads(body) if body else {}
+        return _selftest().object_speedtest(
+            ctx.layer,
+            peers=_peer_clients(),
+            node_url=_node_url(),
+            size=int(doc.get("size", 0)) or None,
+            start=int(doc.get("concurrency", 0)) or None,
+            max_concurrency=int(doc.get("max_concurrency", 0)) or None,
+        )
+
+    def h_speedtest_drive(request, body):
+        drives = getattr(ctx, "local_drives", None)
+        if not drives:
+            raise S3Error("NotImplemented", "no local drives on this node")
+        doc = json.loads(body) if body else {}
+        return _selftest().drive_probe(
+            drives,
+            size=int(doc.get("size", 0)) or None,
+            files=int(doc.get("files", 4)),
+            rand_reads=int(doc.get("rand_reads", 16)),
+        )
+
+    def h_speedtest_net(request, body):
+        doc = json.loads(body) if body else {}
+        return _selftest().netperf(
+            _peer_clients(),
+            node_url=_node_url(),
+            size=int(doc.get("size", 0)) or None,
+            rounds=int(doc.get("rounds", 4)),
+        )
+
+    def _h_speedtest_last(kind: str):
+        def h(request, body):
+            last = _selftest().last_result(kind)
+            if last is None:
+                raise S3Error(
+                    "InvalidArgument", f"no completed {kind} probe; POST to run one"
+                )
+            return last
+
+        return h
+
+    def h_timeseries(request, body):
+        """Always-on ops/s time series (control/perf.py OpsTimeSeries):
+        per-second request count / errors / bytes / p99 per op class over
+        the ring window. ?cluster=1 merges every peer's ring second-by-
+        second; ?horizon=N also reports trailing per-class rates."""
+        from ..control.perf import GLOBAL_PERF, merge_timeseries, summarize_timeseries
+
+        q = request.rel_url.query
+        try:
+            horizon = int(q.get("horizon", "60"))
+        except ValueError:
+            raise S3Error("InvalidArgument", "horizon must be an integer")
+        snap = GLOBAL_PERF.timeseries.snapshot()
+        out: dict = {
+            "window_s": snap["window_s"],
+            "node": summarize_timeseries(snap),
+            "rates": GLOBAL_PERF.timeseries.rates(horizon_s=horizon),
+        }
+        if q.get("cluster", "") in ("1", "true"):
+            snaps = [snap]
+            peers = {}
+            for p in _peer_clients():
+                try:
+                    r = p.timeseries_snapshot(timeout=5.0)
+                    snaps.append(r.get("timeseries", {}))
+                    peers[p.url] = {"ok": True}
+                except oerr.StorageError as e:
+                    peers[p.url] = {"ok": False, "error": str(e)}
+            out["cluster"] = summarize_timeseries(merge_timeseries(snaps))
+            out["peers"] = peers
+        return out
+
     # -- profiling (admin-handlers.go:511-716 role): start broadcasts to
     # every peer; stop collects one dump per node -- plain text single-node,
     # a zip with per-node entries in a cluster. The profiler samples
@@ -1038,6 +1128,13 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_get("/perf", handler(h_perf))
     app.router.add_get("/perf/slow", handler(h_perf_slow))
     app.router.add_post("/speedtest", handler(h_speedtest))
+    app.router.add_post("/speedtest/object", handler(h_speedtest_object))
+    app.router.add_get("/speedtest/object", handler(_h_speedtest_last("object")))
+    app.router.add_post("/speedtest/drive", handler(h_speedtest_drive))
+    app.router.add_get("/speedtest/drive", handler(_h_speedtest_last("drive")))
+    app.router.add_post("/speedtest/net", handler(h_speedtest_net))
+    app.router.add_get("/speedtest/net", handler(_h_speedtest_last("net")))
+    app.router.add_get("/timeseries", handler(h_timeseries))
     app.router.add_post("/profile/start", handler(h_profile_start))
     app.router.add_post("/profile/stop", handler(h_profile_stop))
     app.router.add_get("/profile", handler(h_profile))

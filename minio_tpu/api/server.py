@@ -36,6 +36,7 @@ from ..control import objectlock as ol
 from ..control import tiering as tiering_mod
 from ..control.iam import IAMSys
 from ..control.logging import GLOBAL_LOGGER
+from ..control.perf import GLOBAL_PERF, op_class
 from ..control import policy as policy_mod
 from ..control import tracing
 from ..control.profiler import COPIED, GLOBAL_PROFILER, MOVED
@@ -440,6 +441,11 @@ class S3Server:
                 root.set(status=resp.status, shed=True)
             if self.metrics is not None:
                 self.metrics.record_http(request.method, resp.status)
+            # Shed requests land in the ops/s ring as errors: a dashboard
+            # reading QPS during an overload must see the refusals.
+            GLOBAL_PERF.timeseries.record(
+                op_class(api_name), _time.perf_counter() - t0, ok=False
+            )
             return resp
         # The client's remaining budget (X-Mtpu-Deadline, seconds) binds the
         # whole dispatch: every internal RPC below inherits and decrements it.
@@ -480,6 +486,18 @@ class S3Server:
         if self.metrics is not None:
             self.metrics.record_http(request.method, resp.status)
             self.metrics.record_api(api_name, duration, resp.status < 400)
+        # Always-on ops/s ring (control/perf.py OpsTimeSeries): one bump per
+        # request under its op class. Bytes from the headers -- rx is the
+        # client's declared body, tx what we are about to send.
+        try:
+            nbytes = int(request.headers.get("Content-Length") or 0) + (
+                resp.content_length or 0
+            )
+        except (TypeError, ValueError):
+            nbytes = 0
+        GLOBAL_PERF.timeseries.record(
+            op_class(api_name), duration, ok=resp.status < 400, nbytes=nbytes
+        )
         if self.trace is not None and self.trace.enabled():
             self.trace.publish(
                 "http",

@@ -207,6 +207,7 @@ class MetricsSys:
         self._render_degrade(metric)
         self._render_san(metric)
         self._render_memcache(metric)
+        self._render_timeseries(metric)
 
         if self.layer is not None:
             total = free = 0
@@ -652,6 +653,40 @@ class MetricsSys:
         for point, n in sorted(fired.items()):
             metric("minio_tpu_crash_fired_total", n, {"point": point},
                    help_="Crash points fired, by point name.")
+
+    def _render_timeseries(self, metric) -> None:
+        """Always-on ops/s plane (control/perf.py OpsTimeSeries) plus the
+        self-measurement probe counters (control/selftest.py SelfTestStats).
+        Rates are trailing 60 s means per op class -- the gauge form of the
+        per-second series /mtpu/admin/v1/timeseries serves raw."""
+        from .perf import GLOBAL_PERF, OP_CLASSES
+        from .selftest import STATS
+
+        rates = GLOBAL_PERF.timeseries.rates(horizon_s=60)
+        zero = {"ops_per_s": 0.0, "errors_per_s": 0.0, "bytes_per_s": 0.0}
+        for cls in OP_CLASSES:
+            row = rates.get(cls, zero)
+            metric("minio_tpu_ops_per_second", row["ops_per_s"],
+                   {"class": cls},
+                   help_="Requests per second over the trailing minute, by op class.",
+                   type_="gauge")
+            metric("minio_tpu_op_errors_per_second", row["errors_per_s"],
+                   {"class": cls},
+                   help_="Failed requests per second over the trailing minute.",
+                   type_="gauge")
+            metric("minio_tpu_op_bytes_per_second", row["bytes_per_s"],
+                   {"class": cls},
+                   help_="Request+response bytes per second over the trailing minute.",
+                   type_="gauge")
+        st = STATS.snapshot()
+        for probe, key in (("object", "object_runs"), ("drive", "drive_runs"),
+                           ("net", "net_runs")):
+            metric("minio_tpu_selftest_runs_total", st[key], {"probe": probe},
+                   help_="Self-measurement probe runs, by probe kind.")
+        metric("minio_tpu_selftest_probe_failures_total", st["probe_failures"],
+               help_="Probe runs that reported a failed node/drive/link.")
+        metric("minio_tpu_selftest_scratch_cleanups_total", st["scratch_cleanups"],
+               help_="Scratch-bucket cleanup passes after speedtest rounds.")
 
     def _render_san(self, metric) -> None:
         """Concurrency-sanitizer plane (control/sanitizer.py). Emitted only

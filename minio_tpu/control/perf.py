@@ -105,10 +105,11 @@ STAGES: frozenset = frozenset({
 })
 
 # Layers whose stage names are computed at runtime (per-API root spans,
-# per-peer endpoints, per-StorageAPI call names, per-op loadgen latencies):
-# checked by layer only.
+# per-peer endpoints, per-StorageAPI call names, per-op loadgen latencies,
+# per-probe selftest marks -- control/selftest.py records one series per
+# probe kind and target).
 DYNAMIC_STAGE_LAYERS: frozenset = frozenset(
-    {"api", "rpc", "rpc-peer", "storage", "loadgen"}
+    {"api", "rpc", "rpc-peer", "storage", "loadgen", "selftest"}
 )
 
 # -- stage ledger -------------------------------------------------------------
@@ -252,6 +253,205 @@ def summarize(snap: dict) -> dict:
                 "max_ms": round(bucket_max(counts) * 1e3, 3),
             }
     return out
+
+
+# -- ops/s time series --------------------------------------------------------
+
+# Op classes the per-second ring aggregates S3 APIs into. A bounded, closed
+# set on purpose: the ring holds one latency histogram PER CLASS PER SECOND,
+# so an unbounded per-API keyspace would turn a 300 s window into an
+# unbounded allocation. Dashboards that need per-API detail read the
+# cumulative histograms in MetricsSys; the ring answers "what is this
+# cluster's QPS shape RIGHT NOW".
+OP_CLASSES = ("put", "get", "delete", "list", "other")
+
+
+def op_class(api: str) -> str:
+    """Coarse op class for an S3 API name (PutObject -> put, ListObjectsV2
+    -> list). Multipart writes count as puts -- they are the write path."""
+    if api.startswith(("Put", "Post", "Complete", "NewMultipart", "Copy", "Upload")):
+        return "put"
+    if api.startswith(("Get", "Head", "Select")):
+        return "get"
+    if api.startswith(("Delete", "Abort", "Remove")):
+        return "delete"
+    if api.startswith("List"):
+        return "list"
+    return "other"
+
+
+class _TsCell:
+    __slots__ = ("count", "errors", "bytes", "counts")
+
+    def __init__(self):
+        self.count = 0
+        self.errors = 0
+        self.bytes = 0
+        self.counts = [0] * (N_BUCKETS + 1)
+
+
+class OpsTimeSeries:
+    """Per-second op-class ring: the always-on requests/second axis.
+
+    `window_s` one-second slots (MTPU_TIMESERIES_WINDOW_S, default 300),
+    each holding per-op-class count / errors / bytes plus the same
+    log2-bucket latency histogram the stage ledger uses -- so per-second
+    p99 falls out of quantile() instead of needing raw samples. A slot is
+    reused in place when its epoch second comes around again (classic ring:
+    index = second mod window), so memory is bounded by
+    window * |OP_CLASSES| regardless of load or uptime.
+
+    Snapshots are mergeable across peers (merge_timeseries) the same way
+    ledger snapshots are: per-(second, class) element-wise sums, so the
+    cluster QPS view is exact, not sampled.
+    """
+
+    def __init__(self, window_s: int | None = None):
+        self.window_s = max(
+            10, window_s if window_s is not None
+            else _env_int("MTPU_TIMESERIES_WINDOW_S", 300)
+        )
+        # slot: None or [second, {op_class: _TsCell}]
+        self._slots: list = [None] * self.window_s
+        self._lock = san_lock("OpsTimeSeries._lock")
+
+    def record(
+        self,
+        cls: str,
+        seconds: float,
+        ok: bool = True,
+        nbytes: int = 0,
+        now: float | None = None,
+    ) -> None:
+        """One finished request. `now` is injectable for ring-math tests."""
+        t = int(now if now is not None else time.time())
+        with self._lock:
+            i = t % self.window_s
+            slot = self._slots[i]
+            if slot is None or slot[0] != t:
+                slot = self._slots[i] = [t, {}]
+            cell = slot[1].get(cls)
+            if cell is None:
+                cell = slot[1][cls] = _TsCell()
+            cell.count += 1
+            if not ok:
+                cell.errors += 1
+            cell.bytes += nbytes
+            cell.counts[bucket_index(seconds)] += 1
+
+    def snapshot(self, now: float | None = None) -> dict:
+        """Mergeable copy: seconds ascending, raw histogram counts included
+        (summarize_timeseries() turns them into p99 for the wire). Slots
+        older than the window at `now` are dead ring positions awaiting
+        reuse and are excluded."""
+        t_now = int(now if now is not None else time.time())
+        series = []
+        with self._lock:
+            for slot in self._slots:
+                if slot is None or slot[0] <= t_now - self.window_s:
+                    continue
+                classes = {
+                    cls: {
+                        "count": c.count,
+                        "errors": c.errors,
+                        "bytes": c.bytes,
+                        "counts": list(c.counts),
+                    }
+                    for cls, c in slot[1].items()
+                }
+                series.append({"t": slot[0], "classes": classes})
+        series.sort(key=lambda e: e["t"])
+        return {
+            "window_s": self.window_s,
+            "buckets_us": list(BUCKET_LE_US),
+            "series": series,
+        }
+
+    def rates(self, horizon_s: int = 60, now: float | None = None) -> dict:
+        """Trailing per-class {ops_per_s, errors_per_s, bytes_per_s} over
+        min(horizon, window) seconds -- what the Prometheus gauges export."""
+        t_now = int(now if now is not None else time.time())
+        horizon = min(max(1, horizon_s), self.window_s)
+        agg: dict[str, list] = {}
+        with self._lock:
+            for slot in self._slots:
+                if slot is None or not (t_now - horizon < slot[0] <= t_now):
+                    continue
+                for cls, c in slot[1].items():
+                    row = agg.get(cls)
+                    if row is None:
+                        row = agg[cls] = [0, 0, 0]
+                    row[0] += c.count
+                    row[1] += c.errors
+                    row[2] += c.bytes
+        return {
+            cls: {
+                "ops_per_s": round(row[0] / horizon, 3),
+                "errors_per_s": round(row[1] / horizon, 3),
+                "bytes_per_s": round(row[2] / horizon, 1),
+            }
+            for cls, row in agg.items()
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._slots = [None] * self.window_s
+
+
+def merge_timeseries(snaps: list[dict]) -> dict:
+    """Element-wise merge of ring snapshots keyed by (second, class) --
+    associative and commutative like merge_snapshots, so the cluster QPS
+    view is independent of peer answer order. Bucket-count skew (a peer on
+    a different histogram version) skips that snapshot."""
+    merged: dict[int, dict[str, dict]] = {}
+    window = 0
+    for snap in snaps:
+        if not snap or len(snap.get("buckets_us", ())) != N_BUCKETS:
+            continue
+        window = max(window, int(snap.get("window_s", 0)))
+        for entry in snap.get("series", ()):
+            t = int(entry.get("t", 0))
+            dst_classes = merged.setdefault(t, {})
+            for cls, c in entry.get("classes", {}).items():
+                dst = dst_classes.get(cls)
+                if dst is None:
+                    dst_classes[cls] = {
+                        "count": int(c["count"]),
+                        "errors": int(c["errors"]),
+                        "bytes": int(c["bytes"]),
+                        "counts": list(c["counts"]),
+                    }
+                else:
+                    dst["count"] += c["count"]
+                    dst["errors"] += c["errors"]
+                    dst["bytes"] += c["bytes"]
+                    dst["counts"] = [a + b for a, b in zip(dst["counts"], c["counts"])]
+    return {
+        "window_s": window,
+        "buckets_us": list(BUCKET_LE_US),
+        "series": [
+            {"t": t, "classes": merged[t]} for t in sorted(merged)
+        ],
+    }
+
+
+def summarize_timeseries(snap: dict) -> dict:
+    """Wire shape for /mtpu/admin/v1/timeseries: per second per class
+    count/errors/bytes plus p99_ms from the bucket histogram; raw counts
+    dropped (the merged cluster payload would otherwise be ~30x larger)."""
+    series = []
+    for entry in snap.get("series", ()):
+        classes = {
+            cls: {
+                "count": c["count"],
+                "errors": c["errors"],
+                "bytes": c["bytes"],
+                "p99_ms": round(quantile(c["counts"], 0.99) * 1e3, 3),
+            }
+            for cls, c in entry.get("classes", {}).items()
+        }
+        series.append({"t": entry["t"], "classes": classes})
+    return {"window_s": snap.get("window_s", 0), "series": series}
 
 
 # -- slow-request capture -----------------------------------------------------
@@ -432,6 +632,10 @@ class PerfSys:
     def __init__(self):
         self.ledger = StageLedger()
         self.slow = SlowRequestCapture()
+        # The ops/s time-series ring is NOT reset by /perf?reset -- it is a
+        # continuous axis (dashboards difference it), not a measurement
+        # window.
+        self.timeseries = OpsTimeSeries()
 
     def on_span_finish(
         self, span, duration_s: float, error: str | None, cpu_s: float = 0.0

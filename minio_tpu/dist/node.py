@@ -710,6 +710,16 @@ class _LazyAdminContext:
     def kms(self):
         return getattr(self._node, "kms", None)
 
+    @property
+    def local_drives(self):
+        # The selftest drive probe walks the PRODUCTION drive stacks
+        # (metered/health-gated wrappers included), keyed by drive path.
+        return self._node.local_drives
+
+    @property
+    def node_url(self):
+        return self._node.url
+
 
 def _default_set_count(n: int) -> int:
     """Largest set size in [4..16] dividing n; else n itself (small rigs).

@@ -138,6 +138,45 @@ def make_peer_app(node, token: str) -> web.Application:
             "get_bytes_per_s": size * count / get_t if get_t else 0,
         }
 
+    # Peer side of the live-cluster self-measurement plane
+    # (control/selftest.py; the reference's peer-rest selfSpeedtest /
+    # netperf verbs): the admin node fans a probe round out to every peer
+    # so all nodes drive load AT THE SAME TIME.
+
+    def h_selftest_object(a):
+        """Run one object PUT+GET round locally at the requested
+        concurrency (this node's contribution to a cluster speedtest)."""
+        from ..control import selftest
+
+        return selftest.run_object_round(
+            node.pools,
+            size=int(a.get("size", 1 << 20)),
+            n_ops=int(a.get("ops", 8)),
+            workers=int(a.get("workers", 4)),
+            tag=node.url.replace("://", "-").replace(":", "-").replace("/", "-"),
+        )
+
+    def h_netperf_run(a):
+        """Stream payloads from THIS node to all ITS peers: one row of the
+        full-mesh bandwidth/latency matrix."""
+        from ..control import selftest
+
+        peers = list(getattr(node.notification, "peers", []) or [])
+        return {
+            "row": selftest.netperf_row(
+                peers,
+                size=int(a.get("size", 1 << 20)),
+                rounds=int(a.get("rounds", 4)),
+            )
+        }
+
+    def h_timeseries(a):
+        """This node's raw ops/s ring snapshot; the admin
+        /timeseries?cluster=1 endpoint merges rings second-by-second."""
+        from ..control.perf import GLOBAL_PERF
+
+        return {"timeseries": GLOBAL_PERF.timeseries.snapshot()}
+
     # Per-node profiling (peer side of the admin start/download broadcast,
     # cmd/admin-handlers.go:511-716: every node profiles itself with a
     # whole-process sampler; the admin node collects one dump per node).
@@ -268,10 +307,27 @@ def make_peer_app(node, token: str) -> web.Application:
         "metrics": h_node_metrics,
         "perf": h_perf,
         "chaos": h_chaos,
+        "selftestobject": h_selftest_object,
+        "netperfrun": h_netperf_run,
+        "timeseries": h_timeseries,
     }.items():
         app.router.add_post(f"/{name}", handler(fn))
     app.router.add_post("/listen", h_listen_stream)
     app.router.add_post("/trace", h_trace_stream)
+
+    async def h_netperf_sink(request: web.Request):
+        """Netperf receive side: drain the raw payload, acknowledge its
+        length. Raw body on purpose -- a msgpack round-trip would price the
+        codec, not the link."""
+        if not check_token(request):
+            return web.Response(status=403)
+        body = await request.read()
+        return web.Response(
+            body=msgpack.packb({"received": len(body)}, use_bin_type=True),
+            content_type="application/x-msgpack",
+        )
+
+    app.router.add_post("/netperf", h_netperf_sink)
     return app
 
 
@@ -318,6 +374,27 @@ class PeerClient:
 
     def speedtest(self, size: int = 1 << 20, count: int = 4) -> dict:
         return self.client.call("/speedtest", {"size": size, "count": count}, timeout=120.0)
+
+    def selftest_object(self, size: int, ops: int, workers: int) -> dict:
+        """One object PUT+GET round on the peer (control/selftest.py)."""
+        return self.client.call(
+            "/selftestobject",
+            {"size": size, "ops": ops, "workers": workers},
+            timeout=120.0,
+        )
+
+    def netperf_run(self, size: int = 1 << 20, rounds: int = 4) -> dict:
+        """Ask the peer to stream to ITS peers: its row of the mesh."""
+        return self.client.call(
+            "/netperfrun", {"size": size, "rounds": rounds}, timeout=120.0
+        )
+
+    def netperf_payload(self, payload) -> dict:
+        """Send one raw payload to the peer's netperf sink."""
+        return self.client.call("/netperf", body=payload, timeout=60.0)
+
+    def timeseries_snapshot(self, timeout: float | None = None) -> dict:
+        return self.client.call("/timeseries", {}, timeout=timeout) or {}
 
     def bandwidth(self, bucket: str = "") -> dict:
         return self.client.call("/bandwidth", {"bucket": bucket})

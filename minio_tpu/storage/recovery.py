@@ -57,7 +57,14 @@ _COUNTER_KEYS = (
     "corrupt_meta",     # xl.meta that failed to parse (left for heal)
     "partial_healed",   # sub-set-width versions queued for heal
     "partial_gc",       # below-quorum versions rolled back
+    "selftest_debris",  # aborted-speedtest scratch volumes dropped
 )
+
+# Mirrors control/selftest.py SCRATCH_BUCKET -- kept as a literal so the
+# storage layer never imports the control plane (test_selftest pins the two
+# constants equal). An aborted speedtest (admin node died mid-ramp) leaves
+# probe objects here; they are debris by definition, never client data.
+_SELFTEST_BUCKET = ".mtpu-speedtest"
 
 _lock = san_lock("recovery.counters")
 _counters: dict = {k: 0 for k in _COUNTER_KEYS}
@@ -109,6 +116,7 @@ def recover_drive(drive, meta_bucket: str = ".minio_tpu.sys") -> dict:
     before = counters()
     _sweep_tmp(drive, meta_bucket)
     _sweep_multipart_stages(drive, meta_bucket)
+    _sweep_selftest(drive)
     for vol in _safe_vols(drive):
         _sweep_volume(drive, vol.name)
     _bump("scans")
@@ -138,6 +146,18 @@ def _sweep_tmp(drive, meta_bucket: str) -> None:
             _bump("tmp_dirs")
         except errors.StorageError:
             pass
+
+
+def _sweep_selftest(drive) -> None:
+    """Drop the whole speedtest scratch volume if a dead probe left it
+    behind (a completed probe already removed it)."""
+    try:
+        drive.delete_vol(_SELFTEST_BUCKET, force=True)
+        _bump("selftest_debris")
+    except errors.VolumeNotFound:
+        pass
+    except errors.StorageError:
+        pass
 
 
 def _sweep_multipart_stages(drive, meta_bucket: str) -> None:

@@ -1,0 +1,509 @@
+"""Live-cluster self-measurement plane (control/selftest.py) + ops/s ring.
+
+Covers the three probes end to end -- object speedtest with autotuned
+concurrency and a scaling-efficiency verdict on a real 2-node cluster,
+drive probe through the metered/chaos drive stack, full-mesh netperf --
+plus the always-on per-second op-class ring (control/perf.py
+OpsTimeSeries): rotation, stale-slot exclusion, cluster merge math, the
+/mtpu/admin/v1/timeseries endpoint, and the Prometheus gauges, lint-clean
+under tools/metrics_lint.py. The scratch-bucket lifecycle is pinned too:
+invisible to ListBuckets, gone after a probe, swept by restart recovery
+when a probe dies mid-run.
+"""
+
+import importlib.util
+import json
+import os
+import socket
+import threading
+from pathlib import Path
+from types import SimpleNamespace
+
+import pytest
+
+from minio_tpu.api.server import ThreadedServer
+from minio_tpu.chaos.disk import FaultyDisk
+from minio_tpu.chaos.faults import REGISTRY, FaultSpec
+from minio_tpu.control import selftest
+from minio_tpu.control.perf import (
+    N_BUCKETS,
+    OpsTimeSeries,
+    merge_timeseries,
+    op_class,
+    summarize_timeseries,
+)
+from minio_tpu.dist.node import Node
+from minio_tpu.storage import recovery
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.storage.metered import MeteredDrive
+from minio_tpu.utils import errors
+from tests.harness import ErasureHarness
+from tests.s3client import S3TestClient
+
+_LINT_PATH = Path(__file__).resolve().parent.parent / "tools" / "metrics_lint.py"
+_spec = importlib.util.spec_from_file_location("metrics_lint", _LINT_PATH)
+metrics_lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(metrics_lint)
+
+ROOT = "selftestadmin"
+SECRET = "selftest-secret-key"
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+# ---------------------------------------------------------------------------
+# op classes + time-series ring (pure math, injectable clock)
+# ---------------------------------------------------------------------------
+
+
+class TestOpClass:
+    def test_mapping(self):
+        assert op_class("PutObject") == "put"
+        assert op_class("CompleteMultipartUpload") == "put"
+        assert op_class("CopyObject") == "put"
+        assert op_class("GetObject") == "get"
+        assert op_class("HeadBucket") == "get"
+        assert op_class("DeleteObject") == "delete"
+        assert op_class("AbortMultipartUpload") == "delete"
+        assert op_class("ListObjectsV2") == "list"
+        assert op_class("WeirdNewApi") == "other"
+
+
+class TestOpsTimeSeries:
+    def test_record_and_snapshot(self):
+        ts = OpsTimeSeries(window_s=30)
+        t0 = 5000
+        for i in range(3):
+            ts.record("get", 0.002, ok=True, nbytes=100, now=t0 + i)
+        ts.record("put", 0.050, ok=False, nbytes=2048, now=t0)
+        snap = ts.snapshot(now=t0 + 2)
+        assert [s["t"] for s in snap["series"]] == [t0, t0 + 1, t0 + 2]
+        first = snap["series"][0]["classes"]
+        assert first["get"]["count"] == 1
+        assert first["put"]["errors"] == 1
+        assert first["put"]["bytes"] == 2048
+        assert len(first["get"]["counts"]) == N_BUCKETS + 1
+
+    def test_ring_rotation_reuses_slot_in_place(self):
+        ts = OpsTimeSeries(window_s=10)
+        t0 = 9000
+        ts.record("get", 0.001, now=t0)
+        # t0+10 maps to the SAME ring slot; the stale second must be
+        # replaced, not summed into.
+        ts.record("put", 0.001, now=t0 + 10)
+        snap = ts.snapshot(now=t0 + 10)
+        assert [s["t"] for s in snap["series"]] == [t0 + 10]
+        classes = snap["series"][0]["classes"]
+        assert "put" in classes and "get" not in classes
+
+    def test_snapshot_excludes_seconds_older_than_window(self):
+        ts = OpsTimeSeries(window_s=10)
+        ts.record("get", 0.001, now=100)
+        # Slot survives in the ring, but falls outside the window axis.
+        assert ts.snapshot(now=200)["series"] == []
+
+    def test_merge_sums_per_second_per_class(self):
+        a = OpsTimeSeries(window_s=20)
+        b = OpsTimeSeries(window_s=20)
+        for node in (a, b):
+            node.record("get", 0.004, nbytes=10, now=700)
+        b.record("get", 0.004, nbytes=10, now=701)
+        merged = merge_timeseries([a.snapshot(now=701), b.snapshot(now=701)])
+        by_t = {s["t"]: s["classes"] for s in merged["series"]}
+        assert by_t[700]["get"]["count"] == 2
+        assert by_t[700]["get"]["bytes"] == 20
+        assert by_t[701]["get"]["count"] == 1
+
+    def test_summarize_reports_p99_ms_and_drops_raw_counts(self):
+        ts = OpsTimeSeries(window_s=20)
+        for _ in range(100):
+            ts.record("get", 0.002, now=800)
+        out = summarize_timeseries(ts.snapshot(now=800))
+        row = out["series"][0]["classes"]["get"]
+        assert row["count"] == 100
+        assert "counts" not in row
+        # log2 bucket upper edge containing 2 ms.
+        assert 2.0 <= row["p99_ms"] <= 4.1
+
+    def test_rates_trailing_horizon(self):
+        ts = OpsTimeSeries(window_s=60)
+        t0 = 2000
+        for i in range(10):
+            ts.record("put", 0.001, nbytes=1000, now=t0 + i)
+        r = ts.rates(horizon_s=10, now=t0 + 9)
+        assert r["put"]["ops_per_s"] == 1.0
+        assert r["put"]["bytes_per_s"] == 1000.0
+
+    def test_window_knob(self, monkeypatch):
+        monkeypatch.setenv("MTPU_TIMESERIES_WINDOW_S", "45")
+        assert OpsTimeSeries().window_s == 45
+
+
+# ---------------------------------------------------------------------------
+# autotune (fake target: no storage in the loop)
+# ---------------------------------------------------------------------------
+
+
+class TestAutotune:
+    def test_converges_on_knee(self):
+        curve = {1: 10.0, 2: 20.0, 4: 40.0, 8: 80.0, 16: 81.0, 32: 300.0}
+        calls = []
+
+        def fake(c):
+            calls.append(c)
+            return {"score": curve[c]}
+
+        best, ramp = selftest.autotune(fake, start=1, max_concurrency=32)
+        # 16 fails the 2.5% bar over 8: the ramp stops there and never
+        # pays for 32, even though 32 would have scored higher.
+        assert best["concurrency"] == 8
+        assert calls == [1, 2, 4, 8, 16]
+        assert [r["concurrency"] for r in ramp] == calls
+
+    def test_respects_ceiling(self):
+        best, ramp = selftest.autotune(
+            lambda c: {"score": float(c)}, start=4, max_concurrency=16
+        )
+        assert best["concurrency"] == 16
+        assert [r["concurrency"] for r in ramp] == [4, 8, 16]
+
+    def test_single_step_when_flat(self):
+        best, ramp = selftest.autotune(
+            lambda c: {"score": 100.0}, start=4, max_concurrency=64
+        )
+        assert best["concurrency"] == 4
+        assert len(ramp) == 2  # first step + the one that failed the bar
+
+
+# ---------------------------------------------------------------------------
+# drive probe through the production drive stack
+# ---------------------------------------------------------------------------
+
+
+class TestDriveProbe:
+    def test_probe_through_metered_stack(self, tmp_path):
+        h = ErasureHarness(tmp_path, n_disks=4)
+        drives = {d: MeteredDrive(LocalDrive(d)) for d in h.dirs[:2]}
+        out = selftest.drive_probe(drives, size=1 << 16, files=2, rand_reads=4)
+        assert out["ok"] and out["probe"] == "drive"
+        assert set(out["drives"]) == set(h.dirs[:2])
+        for row in out["drives"].values():
+            assert row["seq_write_bytes_per_s"] > 0
+            assert row["seq_read_bytes_per_s"] > 0
+            assert row["rand_read_iops"] > 0
+        # The metered wrapper saw the probe's IO: results price the real
+        # request path, not the bare device.
+        lats = next(iter(drives.values())).api_latencies()
+        assert sum(v["count"] for v in lats.values()) > 0
+        # Scratch volume removed from every probed drive.
+        for d in h.dirs[:2]:
+            assert not os.path.isdir(os.path.join(d, selftest.SCRATCH_BUCKET))
+
+    def test_armed_chaos_fails_probe_not_node(self, tmp_path):
+        h = ErasureHarness(tmp_path, n_disks=4)
+        path = h.dirs[0]
+        stack = MeteredDrive(FaultyDisk(LocalDrive(path)))
+        fid = REGISTRY.arm(FaultSpec(kind="drive-error", ops=("create_file",)))
+        try:
+            out = selftest.drive_probe({path: stack}, size=1 << 14, files=1, rand_reads=1)
+        finally:
+            REGISTRY.disarm(fid)
+        # The probe REPORTS the fault instead of raising out of the admin
+        # handler: node up, report says which drive is sick.
+        assert out["ok"] is False
+        row = out["drives"][path]
+        assert row["ok"] is False and "FaultyDisk" in row["error"]
+        # ...and the drive still works once the fault is disarmed.
+        out2 = selftest.drive_probe({path: stack}, size=1 << 14, files=1, rand_reads=1)
+        assert out2["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# scratch-bucket lifecycle: hidden, cleaned, swept on restart
+# ---------------------------------------------------------------------------
+
+
+class TestScratchLifecycle:
+    def test_recovery_constant_matches(self):
+        # storage/recovery.py keeps its own literal to avoid importing the
+        # control plane; the two must never drift.
+        assert recovery._SELFTEST_BUCKET == selftest.SCRATCH_BUCKET
+
+    def test_hidden_from_list_buckets(self, tmp_path):
+        h = ErasureHarness(tmp_path, n_disks=4)
+        selftest.ensure_scratch_bucket(h.layer)
+        assert selftest.SCRATCH_BUCKET not in [b.name for b in h.layer.list_buckets()]
+        selftest.cleanup_scratch(h.layer)
+
+    def test_aborted_probe_debris_swept_by_recovery(self, tmp_path):
+        h = ErasureHarness(tmp_path, n_disks=4)
+        # Simulate a probe that died mid-round: scratch bucket + objects
+        # on disk, nobody left to clean them.
+        selftest.ensure_scratch_bucket(h.layer)
+        h.layer.put_object(selftest.SCRATCH_BUCKET, "probe/dead/x", b"y" * 4096)
+        assert os.path.isdir(os.path.join(h.dirs[0], selftest.SCRATCH_BUCKET))
+        before = recovery.counters()["selftest_debris"]
+        for d in h.dirs:
+            recovery.recover_drive(LocalDrive(d))
+        for d in h.dirs:
+            assert not os.path.isdir(os.path.join(d, selftest.SCRATCH_BUCKET))
+        assert recovery.counters()["selftest_debris"] == before + len(h.dirs)
+
+    def test_completed_speedtest_leaves_no_debris(self, tmp_path):
+        h = ErasureHarness(tmp_path, n_disks=4)
+        res = selftest.object_speedtest(
+            h.layer, peers=[], node_url="n", size=1 << 14, start=2, max_concurrency=2
+        )
+        assert res["ok"]
+        for d in h.dirs:
+            assert not os.path.isdir(os.path.join(d, selftest.SCRATCH_BUCKET))
+
+
+# ---------------------------------------------------------------------------
+# 2-node cluster: admin endpoints, peer fan-out, merged time series
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("selftest-cluster")
+    ports = [_free_port(), _free_port()]
+    urls = [f"http://127.0.0.1:{p}" for p in ports]
+    endpoints = []
+    for ni in range(2):
+        for di in range(4):
+            endpoints.append(f"{urls[ni]}{tmp}/n{ni}d{di}")
+    nodes = [
+        Node(endpoints, url=urls[ni], root_user=ROOT, root_password=SECRET,
+             set_drive_count=8)
+        for ni in range(2)
+    ]
+    servers = []
+    for ni, node in enumerate(nodes):
+        ts = ThreadedServer(SimpleNamespace(app=node.make_app()), port=ports[ni])
+        ts.start()
+        servers.append(ts)
+    threads = [threading.Thread(target=n.build) for n in nodes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert all(n.pools is not None for n in nodes), "cluster failed to build"
+    clients = [S3TestClient(urls[ni], ROOT, SECRET) for ni in range(2)]
+    clients[0].make_bucket("stbkt")
+    yield {"nodes": nodes, "clients": clients, "urls": urls}
+    for ts in servers:
+        ts.stop()
+
+
+class TestClusterSelfTest:
+    def _post(self, cluster, path, doc=None):
+        return cluster["clients"][0].request(
+            "POST", path, body=json.dumps(doc or {}).encode()
+        )
+
+    def test_object_speedtest_per_node_aggregate_and_verdict(self, cluster):
+        r = self._post(
+            cluster,
+            "/mtpu/admin/v1/speedtest/object",
+            {"size": 1 << 14, "concurrency": 2, "max_concurrency": 2},
+        )
+        assert r.status_code == 200, r.text
+        doc = r.json()
+        assert doc["ok"] is True
+        # Per-node results keyed by node URL: the coordinator plus the peer
+        # both drove load.
+        for url in cluster["urls"]:
+            row = doc["nodes"][url]
+            assert row["ok"] and row["put_gibs"] >= 0 and row["put_ops_per_s"] > 0
+        agg = doc["aggregate"]
+        assert agg["put_gibs"] > 0 and agg["get_gibs"] > 0
+        assert agg["total_ops_per_s"] > 0
+        sc = doc["scaling"]
+        assert sc["nodes"] == 2
+        assert 0.0 < sc["efficiency"] <= 1.0 + 1e-9
+        assert sc["verdict"] in ("linear", "sublinear", "poor")
+        assert doc["ramp"], "autotune ramp missing"
+        # GET re-serves the stored report without re-running.
+        r2 = cluster["clients"][0].request("GET", "/mtpu/admin/v1/speedtest/object")
+        assert r2.status_code == 200
+        assert r2.json()["finished_at"] == doc["finished_at"]
+
+    def test_object_speedtest_leaves_no_scratch(self, cluster):
+        # After the run above: invisible via S3, gone from every drive on
+        # disk, and gone at the layer (modulo the 2 s bucket-info TTL cache,
+        # which we drop explicitly -- the probe bypasses the S3 surface, so
+        # peers may serve stale info for one TTL).
+        r = cluster["clients"][0].request("GET", "/")
+        assert selftest.SCRATCH_BUCKET not in r.text
+        for node in cluster["nodes"]:
+            for path in node.local_drives:
+                assert not os.path.isdir(
+                    os.path.join(path, selftest.SCRATCH_BUCKET)
+                )
+            node.pools.pools[0].invalidate_bucket_cache()
+            with pytest.raises(errors.StorageError):
+                node.pools.get_bucket_info(selftest.SCRATCH_BUCKET)
+
+    def test_netperf_full_mesh_matrix(self, cluster):
+        r = self._post(
+            cluster, "/mtpu/admin/v1/speedtest/net", {"size": 1 << 16, "rounds": 2}
+        )
+        assert r.status_code == 200, r.text
+        doc = r.json()
+        assert doc["ok"] is True
+        u0, u1 = cluster["urls"]
+        matrix = doc["matrix"]
+        # Symmetry: each node has a row, each row targets the OTHER node.
+        assert set(matrix) == {u0, u1}
+        assert set(matrix[u0]) == {u1}
+        assert set(matrix[u1]) == {u0}
+        for row in matrix.values():
+            for cell in row.values():
+                assert cell["ok"] and cell["bytes_per_s"] > 0
+                assert cell["rtt_ms"] >= 0
+
+    def test_drive_probe_keyed_by_drive_path(self, cluster):
+        r = self._post(
+            cluster,
+            "/mtpu/admin/v1/speedtest/drive",
+            {"size": 1 << 14, "files": 1, "rand_reads": 2},
+        )
+        assert r.status_code == 200, r.text
+        doc = r.json()
+        assert doc["ok"] is True
+        drives = doc["drives"]
+        assert len(drives) == 4  # node 0's local drives
+        for path, row in drives.items():
+            assert "/n0d" in path
+            assert row["ok"] and row["seq_write_bytes_per_s"] > 0
+
+    def test_timeseries_cluster_merge(self, cluster):
+        # Drive S3 traffic through BOTH nodes so each ring has data.
+        for ci, client in enumerate(cluster["clients"]):
+            assert client.put_object("stbkt", f"ts-{ci}", b"z" * 4096).status_code == 200
+            assert client.get_object("stbkt", f"ts-{ci}").status_code == 200
+        r = cluster["clients"][0].request(
+            "GET", "/mtpu/admin/v1/timeseries", query=[("cluster", "1")]
+        )
+        assert r.status_code == 200, r.text
+        doc = r.json()
+        assert doc["window_s"] >= 10
+        # The merged view saw both classes, and the peer answered.
+        merged_classes = {
+            cls for s in doc["cluster"]["series"] for cls in s["classes"]
+        }
+        assert {"put", "get"} <= merged_classes
+        peer_url = cluster["urls"][1]
+        assert doc["peers"][peer_url]["ok"] is True
+        # Per-second rows carry the full schema, raw bucket arrays do not
+        # ride the wire.
+        row = doc["cluster"]["series"][-1]["classes"]
+        for cell in row.values():
+            assert {"count", "errors", "bytes", "p99_ms"} <= set(cell)
+            assert "counts" not in cell
+        # Cluster merge is a superset of (or equal to) the local view.
+        local_total = sum(
+            c["count"] for s in doc["node"]["series"] for c in s["classes"].values()
+        )
+        merged_total = sum(
+            c["count"] for s in doc["cluster"]["series"] for c in s["classes"].values()
+        )
+        assert merged_total >= local_total
+
+    def test_metrics_exposition_lint_clean_with_ops_family(self, cluster):
+        r = cluster["clients"][0].request("GET", "/minio/v2/metrics/node")
+        assert r.status_code == 200
+        text = r.text
+        assert metrics_lint.validate_exposition(text) == []
+        assert metrics_lint.lint_exposition(text) == []
+        assert "minio_tpu_ops_per_second" in text
+        assert "minio_tpu_op_errors_per_second" in text
+        assert "minio_tpu_selftest_runs_total" in text
+        # The probes above ran on this process: counters moved.
+        runs = {
+            lbls.get("probe"): v
+            for _ln, name, lbls, v in metrics_lint.parse_samples(text)
+            if name == "minio_tpu_selftest_runs_total"
+        }
+        assert runs.get("object", 0) >= 1
+        assert runs.get("net", 0) >= 1
+        assert runs.get("drive", 0) >= 1
+
+    def test_probe_ledger_attribution(self, cluster):
+        # Probes are attributable in /perf: ("selftest", ...) stage rows.
+        r = cluster["clients"][0].request("GET", "/mtpu/admin/v1/perf")
+        assert r.status_code == 200
+        rows = r.json()["node"]["stages"].get("selftest", {})
+        assert "object-put" in rows and rows["object-put"]["count"] >= 1
+        assert "net-stream" in rows
+
+
+# ---------------------------------------------------------------------------
+# selftest_gate (CI leg)
+# ---------------------------------------------------------------------------
+
+
+class TestSelftestGate:
+    def _gate(self):
+        spec = importlib.util.spec_from_file_location(
+            "selftest_gate",
+            Path(__file__).resolve().parent.parent / "tools" / "selftest_gate.py",
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_ok_and_floor_violations(self):
+        gate = self._gate()
+        speedtest = {
+            "ok": True,
+            "aggregate": {"put_gibs": 0.5},
+            "scaling": {"nodes": 2, "efficiency": 0.9, "verdict": "linear"},
+        }
+        bench = {"putobject_gibs": 1.0}
+        assert gate.findings(speedtest, bench) == []
+        # Live throughput collapsed below the factor.
+        slow = dict(speedtest, aggregate={"put_gibs": 0.01})
+        kinds = [f["kind"] for f in gate.findings(slow, bench)]
+        assert kinds == ["throughput-floor"]
+        # Nodes that add nothing: efficiency floor (N>1 only).
+        flat = dict(speedtest,
+                    scaling={"nodes": 2, "efficiency": 0.2, "verdict": "poor"})
+        kinds = [f["kind"] for f in gate.findings(flat, bench)]
+        assert kinds == ["efficiency-floor"]
+        single = dict(speedtest,
+                      scaling={"nodes": 1, "efficiency": 0.2, "verdict": "poor"})
+        assert gate.findings(single, bench) == []
+
+    def test_failed_probe_blocks(self):
+        gate = self._gate()
+        bad = {"ok": False, "nodes": {"http://n1": {"ok": False, "error": "x"}},
+               "aggregate": {"put_gibs": 9.9}}
+        kinds = [f["kind"] for f in gate.findings(bad, {"putobject_gibs": 0.1})]
+        assert kinds == ["probe-failed"]
+
+    def test_main_last_json_line_contract(self, tmp_path):
+        gate = self._gate()
+        st = tmp_path / "SPEEDTEST_x.json"
+        st.write_text(
+            "noise\n"
+            + json.dumps({
+                "ok": True,
+                "aggregate": {"put_gibs": 0.5},
+                "scaling": {"nodes": 2, "efficiency": 0.9, "verdict": "linear"},
+            })
+            + "\n"
+        )
+        be = tmp_path / "BENCH_x.json"
+        be.write_text(json.dumps({"putobject_gibs": 1.0}) + "\n")
+        assert gate.main([str(st), str(be)]) == 0
+        assert gate.main([str(st), str(be), "--factor=2.0"]) == 1
+        be.write_text("not json\n")
+        assert gate.main([str(st), str(be)]) == 2

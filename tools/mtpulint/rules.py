@@ -31,6 +31,7 @@ PERF = "minio_tpu/control/perf.py"
 METRICS = "minio_tpu/control/metrics.py"
 DEGRADE = "minio_tpu/control/degrade.py"
 PROFILER = "minio_tpu/control/profiler.py"
+SELFTEST = "minio_tpu/control/selftest.py"
 
 
 def _call_name(node: ast.Call) -> str:
@@ -542,14 +543,17 @@ class MetricsRenderedRule(Rule):
     A counter nobody exports is a measurement nobody sees: the increment
     costs a lock on the hot path and buys zero observability. Every public
     `self.<name> += ...` / keyed-dict bump in DegradeStats,
-    SlowRequestCapture, and the profiling plane's CopyLedger must appear
-    (as a string key or attribute) in the exposition renderer."""
+    SlowRequestCapture, the profiling plane's CopyLedger, and the
+    self-measurement plane's SelfTestStats must appear (as a string key or
+    attribute) in the exposition renderer."""
 
     id = "metrics-rendered"
     title = "counter incremented but never rendered in control/metrics.py"
-    scope = (DEGRADE, PERF, PROFILER)
+    scope = (DEGRADE, PERF, PROFILER, SELFTEST)
 
-    _COUNTER_CLASSES = {"DegradeStats", "SlowRequestCapture", "CopyLedger"}
+    _COUNTER_CLASSES = {
+        "DegradeStats", "SlowRequestCapture", "CopyLedger", "SelfTestStats",
+    }
 
     def _counters(self, ctx) -> list[tuple[str, int]]:
         out: list[tuple[str, int]] = []

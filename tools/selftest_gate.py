@@ -1,0 +1,133 @@
+#!/usr/bin/env python3
+"""Live-vs-offline gate over object-speedtest JSON (control/selftest.py).
+
+The offline harness (bench.py) says what the machine CAN do; the live
+cluster's object speedtest (POST /mtpu/admin/v1/speedtest/object) says what
+it actually delivers with auth, dispatch, peers, and production drive
+stacks in the path. This gate holds the two to each other:
+
+  * throughput floor -- the live cluster's aggregate PUT GiB/s must be at
+    least `--factor` of the latest BENCH line's `putobject_gibs` (default
+    0.1: the live path carries per-request overhead an in-process bench
+    never pays, but an order-of-magnitude collapse means a real bottleneck
+    -- a dead codec, a wedged drive, an accidental serial path).
+  * scaling floor (N>1 only) -- the speedtest's own scaling-efficiency
+    verdict (aggregate / (N x best single node)) must clear
+    `--efficiency-floor` (default 0.5): nodes that add no throughput are a
+    topology bug, not capacity.
+  * probe health -- a speedtest that reports ok=false (a node's round
+    failed) can vouch for nothing.
+
+Inputs are files whose LAST JSON-object line is the report (the speedtest
+JSON saved from the admin endpoint; BENCH_*.json as bench.py writes it).
+
+Usage:
+    python tools/selftest_gate.py SPEEDTEST.json BENCH.json \\
+        [--factor=0.1] [--efficiency-floor=0.5]
+
+Exit 0 = live numbers hold up, 1 = violation(s) flagged, 2 = unusable
+input (the gate cannot vouch either way; callers decide whether that
+blocks). chaos_check --invariants runs this automatically when both
+artifacts exist.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+DEFAULT_FACTOR = 0.1
+DEFAULT_EFFICIENCY_FLOOR = 0.5
+
+
+def findings(speedtest: dict, bench: dict, factor: float = DEFAULT_FACTOR,
+             efficiency_floor: float = DEFAULT_EFFICIENCY_FLOOR) -> list[dict]:
+    """Violations of the live-vs-offline contract; empty means it holds."""
+    out: list[dict] = []
+    if not speedtest.get("ok", False):
+        failed = [
+            url for url, r in (speedtest.get("nodes") or {}).items()
+            if not r.get("ok")
+        ]
+        out.append({"kind": "probe-failed", "nodes": failed})
+        return out  # failed rounds make the numbers below meaningless
+    agg = speedtest.get("aggregate") or {}
+    live_put = float(agg.get("put_gibs", 0.0))
+    bench_put = float(bench.get("putobject_gibs", 0.0))
+    if bench_put > 0 and live_put < bench_put * factor:
+        out.append({
+            "kind": "throughput-floor",
+            "live_put_gibs": live_put,
+            "bench_put_gibs": bench_put,
+            "factor": factor,
+        })
+    scaling = speedtest.get("scaling") or {}
+    n = int(scaling.get("nodes", 1))
+    eff = float(scaling.get("efficiency", 0.0))
+    if n > 1 and eff < efficiency_floor:
+        out.append({
+            "kind": "efficiency-floor",
+            "nodes": n,
+            "efficiency": eff,
+            "floor": efficiency_floor,
+            "verdict": scaling.get("verdict", ""),
+        })
+    return out
+
+
+def _load(path: str) -> dict | None:
+    """Last parseable JSON object line of a file (same contract as
+    perf_gate: BENCH logs are JSONL, the final line is the report)."""
+    try:
+        with open(path) as f:
+            lines = [ln.strip() for ln in f if ln.strip()]
+    except OSError as e:
+        print(f"selftest_gate: {path}: {e}", file=sys.stderr)
+        return None
+    for ln in reversed(lines):
+        try:
+            doc = json.loads(ln)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(doc, dict):
+            return doc
+    print(f"selftest_gate: {path}: no JSON object line", file=sys.stderr)
+    return None
+
+
+def main(argv: list[str]) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    factor = DEFAULT_FACTOR
+    floor = DEFAULT_EFFICIENCY_FLOOR
+    for a in argv:
+        if a.startswith("--factor="):
+            factor = float(a.split("=", 1)[1])
+        elif a.startswith("--efficiency-floor="):
+            floor = float(a.split("=", 1)[1])
+    if len(args) != 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    speedtest, bench = _load(args[0]), _load(args[1])
+    if speedtest is None or bench is None:
+        return 2
+    if "aggregate" not in speedtest or "putobject_gibs" not in bench:
+        print("selftest_gate: inputs lack aggregate/putobject_gibs; "
+              "nothing to gate", file=sys.stderr)
+        return 2
+    found = findings(speedtest, bench, factor, floor)
+    for f in found:
+        if f["kind"] == "probe-failed":
+            print(f"PROBE FAILED on nodes: {', '.join(f['nodes']) or 'unknown'}")
+        elif f["kind"] == "throughput-floor":
+            print(f"LIVE FLOOR: {f['live_put_gibs']:.3f} GiB/s live PUT < "
+                  f"{f['factor']:.2f} x bench {f['bench_put_gibs']:.3f} GiB/s")
+        else:
+            print(f"SCALING FLOOR: efficiency {f['efficiency']:.3f} "
+                  f"({f['verdict']}) < {f['floor']:.2f} across {f['nodes']} nodes")
+    if not found:
+        print("selftest_gate: ok")
+    return 1 if found else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
