@@ -45,6 +45,7 @@ class AdminContext:
     kms: object | None = None  # KMS (kms status / key checks)
     local_drives: object | None = None  # {path: StorageAPI} for the drive probe
     node_url: str = "local"  # this node's URL (keys selftest per-node results)
+    poolmgr: object | None = None  # PoolManager (pool lifecycle admin)
 
 
 def make_admin_app(ctx: AdminContext) -> web.Application:
@@ -454,6 +455,48 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             "healed": st.healed,
             "failed": st.failed,
         }
+
+    # -- pool lifecycle (object/poolmgr.py; the reference's
+    # admin pools attach / decommission / rebalance verbs) -------------------
+
+    def _poolmgr():
+        pm = getattr(ctx, "poolmgr", None)
+        if pm is None:
+            raise S3Error("NotImplemented", "pool lifecycle needs a running node")
+        return pm
+
+    def h_pools_status(request, body):
+        return _poolmgr().status()
+
+    def h_pools_attach(request, body):
+        """POST {"endpoints": [...]} -- runtime attach-pool expansion."""
+        doc = json.loads(body) if body else {}
+        eps = doc.get("endpoints") or []
+        if not eps or not isinstance(eps, list):
+            raise S3Error("InvalidArgument", "endpoints list required")
+        idx = _poolmgr().attach_endpoints([str(e) for e in eps])
+        return {"pool": idx, "status": "active"}
+
+    def h_pools_decommission(request, body):
+        """POST {"pool": i, "wait": false} -- start (or resume) a drain."""
+        doc = json.loads(body) if body else {}
+        if "pool" not in doc:
+            raise S3Error("InvalidArgument", "pool index required")
+        tracker = _poolmgr().start_decommission(
+            int(doc["pool"]), wait=bool(doc.get("wait", False))
+        )
+        from dataclasses import asdict as _asdict
+
+        return {"drain": _asdict(tracker)}
+
+    def h_pools_rebalance(request, body):
+        """POST {"start": true, "threshold": 0.1} | {"start": false}."""
+        doc = json.loads(body) if body else {}
+        pm = _poolmgr()
+        if doc.get("start", True):
+            thr = doc.get("threshold")
+            return pm.start_rebalance(None if thr is None else float(thr))
+        return pm.stop_rebalance()
 
     # -- chaos (fault injection; minio_tpu/chaos/) ---------------------------
     # POST arms a fault (body = FaultSpec JSON + optional "cluster": false),
@@ -1116,6 +1159,10 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_put("/policies/{name}", handler(h_put_policy))
     app.router.add_delete("/policies/{name}", handler(h_delete_policy))
     app.router.add_post("/service-accounts", handler(h_service_account))
+    app.router.add_get("/pools/status", handler(h_pools_status))
+    app.router.add_post("/pools/attach", handler(h_pools_attach))
+    app.router.add_post("/pools/decommission", handler(h_pools_decommission))
+    app.router.add_post("/pools/rebalance", handler(h_pools_rebalance))
     app.router.add_post("/chaos", handler(h_chaos_arm))
     app.router.add_get("/chaos", handler(h_chaos_list))
     app.router.add_delete("/chaos", handler(h_chaos_disarm))

@@ -77,6 +77,7 @@ class MetricsSys:
         self.mrf = None  # MRFQueue heal backlog
         self.disk_heal = None  # DiskHealMonitor completed trackers
         self.memcache = None  # MemObjectCache: hot-read tier counters
+        self.poolmgr = None  # PoolManager: pool lifecycle gauges
 
     # -- recording -----------------------------------------------------------
 
@@ -207,6 +208,7 @@ class MetricsSys:
         self._render_degrade(metric)
         self._render_san(metric)
         self._render_memcache(metric)
+        self._render_pools(metric)
         self._render_timeseries(metric)
 
         if self.layer is not None:
@@ -653,6 +655,81 @@ class MetricsSys:
         for point, n in sorted(fired.items()):
             metric("minio_tpu_crash_fired_total", n, {"point": point},
                    help_="Crash points fired, by point name.")
+
+    def _render_pools(self, metric) -> None:
+        """Pool lifecycle plane (object/poolmgr.py + control/rebalance.py):
+        per-pool capacity/used/objects gauges, drain progress, and the
+        process-wide lifecycle counters. Emitted only on nodes with a
+        PoolManager (i.e. inside a built server)."""
+        pm = self.poolmgr
+        if pm is None:
+            return
+        from ..object.poolmgr import STATS
+        from .rebalance import _budgets_lock, _live_budgets
+
+        st = STATS.snapshot()
+        metric("minio_tpu_pool_attached_total", st["pools_attached"],
+               help_="Pools attached at runtime.")
+        metric("minio_tpu_pool_epoch_bumps_total", st["epoch_bumps"],
+               help_="Pool-config epoch bumps (attach/drain transitions).")
+        metric("minio_tpu_pool_decommissions_started_total",
+               st["decommissions_started"],
+               help_="Decommission drains started.")
+        metric("minio_tpu_pool_decommissions_resumed_total",
+               st["decommissions_resumed"],
+               help_="Decommission drains resumed from a checkpoint.")
+        metric("minio_tpu_pool_decommissions_completed_total",
+               st["decommissions_completed"],
+               help_="Decommission drains completed.")
+        metric("minio_tpu_pool_objects_moved_total", st["objects_moved"],
+               help_="Objects migrated between pools (drain + rebalance).")
+        metric("minio_tpu_pool_moved_bytes_total", st["bytes_moved"],
+               help_="Bytes migrated between pools (drain + rebalance).")
+        metric("minio_tpu_pool_move_failures_total", st["move_failures"],
+               help_="Object moves that failed.")
+        metric("minio_tpu_pool_checkpoints_total", st["checkpoints"],
+               help_="Drain cursor checkpoints persisted.")
+        metric("minio_tpu_pool_rebalance_rounds_total", st["rebalance_rounds"],
+               help_="Rebalance rounds executed.")
+        with _budgets_lock:
+            waits = sum(b.throttle_waits for b in _live_budgets)
+            secs = sum(b.throttled_seconds for b in _live_budgets)
+            mig_ops = sum(b.ops for b in _live_budgets)
+            mig_bytes = sum(b.bytes for b in _live_budgets)
+        metric("minio_tpu_pool_throttle_waits_total", waits,
+               help_="Migration ops delayed by the ops/bytes budget.")
+        metric("minio_tpu_pool_throttled_seconds_total", round(secs, 6),
+               help_="Seconds migration traffic spent throttled.")
+        metric("minio_tpu_pool_migration_ops_total", mig_ops,
+               help_="Moves charged against migration budgets.")
+        metric("minio_tpu_pool_migration_budget_bytes_total", mig_bytes,
+               help_="Bytes charged against migration budgets.")
+        try:
+            status = pm.status()
+        except Exception:  # noqa: BLE001 - scrape must not die on a gauge walk
+            return
+        for row in status.get("pools", []):
+            labels = {"pool": row["index"], "status": row["status"]}
+            metric("minio_tpu_pool_capacity_bytes", row["capacity_bytes"],
+                   labels, help_="Per-pool raw capacity.", type_="gauge")
+            metric("minio_tpu_pool_free_bytes", row["free_bytes"], labels,
+                   help_="Per-pool raw free bytes.", type_="gauge")
+            metric("minio_tpu_pool_used_bytes", row["data_bytes"], labels,
+                   help_="Per-pool object data bytes.", type_="gauge")
+            metric("minio_tpu_pool_objects", row["objects"], labels,
+                   help_="Per-pool object count.", type_="gauge")
+            drain = row.get("drain")
+            if drain:
+                dl = {"pool": row["index"]}
+                metric("minio_tpu_pool_drain_objects_moved", drain["objects_moved"],
+                       dl, help_="Objects this pool's drain has moved out.",
+                       type_="gauge")
+                metric("minio_tpu_pool_drain_bytes_moved", drain["bytes_moved"],
+                       dl, help_="Bytes this pool's drain has moved out.",
+                       type_="gauge")
+                metric("minio_tpu_pool_drain_finished", int(bool(drain["finished"])),
+                       dl, help_="1 once this pool's drain completed.",
+                       type_="gauge")
 
     def _render_timeseries(self, metric) -> None:
         """Always-on ops/s plane (control/perf.py OpsTimeSeries) plus the

@@ -102,6 +102,13 @@ STAGES: frozenset = frozenset({
     # MTPU_FSYNC discipline issues; the layer is otherwise dynamic, the
     # entry documents the one literal key bench JSON reports).
     ("storage", "drive-sync"),
+    # object/poolmgr.py + control/rebalance.py pool lifecycle stages
+    # (attach is an in-request span; the rest are direct ledger records
+    # from the drain/rebalance worker threads).
+    ("pool", "attach"),
+    ("pool", "drain"),
+    ("pool", "move-object"),
+    ("pool", "rebalance-round"),
 })
 
 # Layers whose stage names are computed at runtime (per-API root spans,

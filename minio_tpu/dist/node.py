@@ -291,6 +291,16 @@ class Node:
         self.notification = NotificationSys(
             [PeerClient(u, self.token) for u in self.peer_urls]
         )
+        # Pool lifecycle manager: owns attach/decommission/rebalance and the
+        # persisted pool-config epoch. load_config() here picks up pools that
+        # were attached at runtime before this process (re)started -- built
+        # BEFORE the subsystems below so they all see the full pool set.
+        from ..object.poolmgr import PoolManager
+
+        self.poolmgr = PoolManager(
+            self.pools, notification=self.notification, node=self
+        )
+        self.poolmgr.load_config()
 
         # Control plane assembly (newAllSubsystems role, server-main.go:451).
         from ..control.config import ConfigStore, ConfigSys
@@ -458,6 +468,7 @@ class Node:
         self.metrics.mrf = self.mrf
         self.metrics.disk_heal = self.disk_heal
         self.metrics.memcache = self.memcache
+        self.metrics.poolmgr = self.poolmgr
         # Rehydrate notification rules from persisted bucket metadata: the
         # notifier starts empty, and without this pass a restart silently
         # stops event delivery for every configured bucket until an
@@ -511,6 +522,11 @@ class Node:
         from ..control.profiler import GLOBAL_PROFILER
 
         GLOBAL_PROFILER.ensure_started()
+        # Resume any drain the previous process left running (the leader
+        # drives drains, like format orchestration; MTPU_POOL_RESUME=0
+        # vetoes for surgical restarts).
+        if self.is_leader and os.environ.get("MTPU_POOL_RESUME", "1") != "0":
+            self.poolmgr.resume_pending()
         return self
 
     def refresh_bucket_notification(self, bucket: str) -> None:
@@ -570,6 +586,97 @@ class Node:
             return None
         return cache.bucket_usage(bucket).size
 
+    # -- pool expansion -------------------------------------------------------
+
+    def build_pool_from_endpoints(self, raw_endpoints: list[str]) -> ErasureSets:
+        """Construct (and register) the drive stacks + erasure sets for one
+        new pool at runtime. Formats the drives with the cluster deployment
+        id when ALL of them are unformatted (the attach orchestrator
+        formats regardless of boot leadership -- wait_for_format only
+        auto-inits for the leader); a pre-formatted foreign pool is
+        rejected. Called by attach_pool on the orchestrating node and by
+        PoolManager.load_config on peers replaying the persisted config."""
+        if self.pools is None:
+            raise errors.StorageError("node not built yet")
+        from ..chaos.disk import FaultyDisk
+        from ..control.pubsub import GLOBAL_TRACE
+        from ..storage.breaker import HealthGatedDrive
+        from ..storage.metered import MeteredDrive
+
+        eps = [Endpoint.parse(e) for e in raw_endpoints]
+        drives: list[StorageAPI] = []
+        for ep in eps:
+            if ep.is_local_path or ep.url == self.url:
+                d = MeteredDrive(
+                    HealthGatedDrive(FaultyDisk(LocalDrive(ep.path))),
+                    trace=GLOBAL_TRACE,
+                )
+                # Registering here makes the drive instantly peer-servable:
+                # make_storage_app resolves this dict at request time.
+                self.local_drives[ep.path] = d
+                drives.append(d)
+            else:
+                drives.append(RemoteDrive(ep.url, ep.path, self.token))
+        if len(drives) % self.set_drive_count:
+            raise ValueError(
+                f"attached pool: {len(drives)} drives not divisible into "
+                f"sets of {self.set_drive_count}"
+            )
+        dep_id = self.pools.pools[0].deployment_id
+        if not any(f is not None for f in self._read_formats(drives)):
+            n_sets = len(drives) // self.set_drive_count
+            fresh = fmt_mod.init_format(
+                n_sets, self.set_drive_count, deployment_id=dep_id
+            )
+            for d, f in zip(drives, fresh):
+                try:
+                    d.write_all(
+                        fmt_mod.SYS_DIR, fmt_mod.FORMAT_FILE, f.to_json().encode()
+                    )
+                except errors.DiskError:
+                    pass
+        quorum = self.wait_for_format(
+            timeout=10.0, drives=drives, deployment_id=dep_id
+        )
+        if quorum.deployment_id != dep_id:
+            raise errors.UnformattedDisk(
+                f"attached pool belongs to deployment {quorum.deployment_id}, "
+                f"cluster is {dep_id}"
+            )
+        sets = ErasureSets.from_drives(
+            list(drives), quorum, parity=self.parity,
+            pool_index=len(self.pools.pools), rrs_parity=self.rrs_parity,
+        )
+        self.pool_endpoints.append(eps)
+        self.endpoints.extend(eps)
+        self.pool_drives.append(drives)
+        self.drives.extend(drives)
+        return sets
+
+    def _wire_new_pool(self, sets: ErasureSets) -> None:
+        """Give a runtime-attached pool the same plumbing build() gives boot
+        pools: the namespace lock and the partial-write -> MRF feed."""
+        mrf = getattr(self, "mrf", None)
+        for s in sets.sets:
+            s.ns_lock = self.ns_lock
+            if mrf is not None:
+                s.on_partial = mrf.add
+
+    def attach_pool(self, raw_endpoints: list[str]) -> int:
+        """Runtime attach-pool expansion: build drives + sets, wire them,
+        then run the manager's two-phase (suspended -> fanout -> active ->
+        fanout) attach. Returns the new pool index."""
+        sets = self.build_pool_from_endpoints(list(raw_endpoints))
+        self._wire_new_pool(sets)
+        return self.poolmgr.attach(sets, endpoints=list(raw_endpoints))
+
+    def reload_pools(self) -> bool:
+        """Peer-RPC entry: re-read the persisted pool config (epoch-gated)."""
+        pm = getattr(self, "poolmgr", None)
+        if pm is None:
+            return False
+        return pm.load_config()
+
     # -- shutdown ------------------------------------------------------------
 
     def close(self) -> None:
@@ -583,7 +690,7 @@ class Node:
             s = getattr(self, sub, None)
             if s is not None:
                 s.close()
-        for sub in ("scanner", "disk_heal", "mrf", "healmgr"):
+        for sub in ("poolmgr", "scanner", "disk_heal", "mrf", "healmgr"):
             s = getattr(self, sub, None)
             if s is not None:
                 s.stop()
@@ -719,6 +826,10 @@ class _LazyAdminContext:
     @property
     def node_url(self):
         return self._node.url
+
+    @property
+    def poolmgr(self):
+        return getattr(self._node, "poolmgr", None)
 
 
 def _default_set_count(n: int) -> int:

@@ -110,6 +110,23 @@ def make_peer_app(node, token: str) -> web.Application:
     def h_top_locks(a):
         return node.locker.top_locks()
 
+    def h_pools_reload(a):
+        """Pool-config epoch fanout target: re-read the persisted pool set
+        (object/poolmgr.py). The attaching/draining node bumps the epoch,
+        persists, then broadcasts this verb so every node agrees on the
+        pool set before new writes can land on it."""
+        reload_fn = getattr(node, "reload_pools", None)
+        if reload_fn is None:
+            return {"ok": False}
+        return {"ok": True, "applied": bool(reload_fn())}
+
+    def h_pools_status(a):
+        """This node's view of the pool set (epoch + per-pool gauges)."""
+        pm = getattr(node, "poolmgr", None)
+        if pm is None:
+            return {}
+        return pm.status()
+
     def h_speedtest(a):
         """Self-benchmark PUT+GET through the object layer
         (peer-rest-server.go:1137 selfSpeedtest)."""
@@ -299,6 +316,8 @@ def make_peer_app(node, token: str) -> web.Application:
         "reloadbucketmeta": h_reload_bucket_meta,
         "memcacheinv": h_memcache_invalidate,
         "toplocks": h_top_locks,
+        "poolsreload": h_pools_reload,
+        "poolsstatus": h_pools_status,
         "speedtest": h_speedtest,
         "profilestart": h_profile_start,
         "profilestop": h_profile_stop,
@@ -361,6 +380,13 @@ class PeerClient:
             "/memcacheinv", {"bucket": bucket, "object": object_name},
             timeout=timeout,
         )
+
+    def pools_reload(self, timeout: float | None = None) -> bool:
+        r = self.client.call("/poolsreload", {}, timeout=timeout)
+        return bool(r and r.get("applied"))
+
+    def pools_status(self, timeout: float | None = None) -> dict:
+        return self.client.call("/poolsstatus", {}, timeout=timeout) or {}
 
     def node_metrics(self, timeout: float | None = None) -> str:
         r = self.client.call("/metrics", {}, timeout=timeout)
@@ -473,6 +499,12 @@ class NotificationSys:
 
     def reload_bucket_meta_all(self, bucket: str = "") -> None:
         self._fanout(lambda p, t: p.reload_bucket_meta(bucket, timeout=t))
+
+    def pools_reload_all(self) -> None:
+        """Pool-config epoch broadcast: every peer re-reads the persisted
+        pool set. Called under the attach/decommission transition so the
+        cluster agrees on pool membership before writes route to it."""
+        self._fanout(lambda p, t: p.pools_reload(timeout=t))
 
     def invalidate_memcache_all(self, bucket: str, object_name: str = "") -> None:
         """Synchronous cross-node memcache invalidation: the writing node

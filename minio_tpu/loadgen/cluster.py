@@ -38,6 +38,7 @@ class InProcessCluster:
         root_user: str = ROOT_USER,
         root_password: str = ROOT_PASSWORD,
         build_timeout_s: float = 120.0,
+        pools: int = 1,
     ):
         from ..api.server import ThreadedServer
         from ..dist.node import Node
@@ -46,12 +47,22 @@ class InProcessCluster:
         self.root_password = root_password
         ports = [_free_port() for _ in range(n_nodes)]
         self.urls = [f"http://127.0.0.1:{p}" for p in ports]
-        endpoints = []
-        for ni in range(n_nodes):
-            for di in range(drives_per_node):
-                d = os.path.join(workdir, f"n{ni}d{di}")
-                os.makedirs(d, exist_ok=True)
-                endpoints.append(f"{self.urls[ni]}{d}")
+        # pools > 1 builds a server-pools cluster: each pool is an
+        # independent endpoint group of the same shape (the reference's
+        # `minio server poolA{1...n} poolB{1...n}` expansion), which is
+        # what the pool-lifecycle scenarios decommission out from under
+        # live traffic.
+        endpoint_pools: list[list[str]] = []
+        for pi in range(pools):
+            group = []
+            for ni in range(n_nodes):
+                for di in range(drives_per_node):
+                    tag = f"p{pi}n{ni}d{di}" if pools > 1 else f"n{ni}d{di}"
+                    d = os.path.join(workdir, tag)
+                    os.makedirs(d, exist_ok=True)
+                    group.append(f"{self.urls[ni]}{d}")
+            endpoint_pools.append(group)
+        endpoints = endpoint_pools if pools > 1 else endpoint_pools[0]
         self.nodes = [
             Node(
                 endpoints,

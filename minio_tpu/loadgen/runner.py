@@ -234,8 +234,26 @@ class ScenarioRunner:
             except Exception as e:  # noqa: BLE001
                 rec["error"] = f"disarm: {type(e).__name__}: {e}"[:200]
 
+        def run_admin(admin_op: dict, at_s: float) -> None:
+            # One-shot admin window (pool attach / decommission /
+            # rebalance): fired once, never disarmed -- a drain keeps
+            # running after the window by design.
+            rec = {"at_s": at_s, "admin": admin_op}
+            try:
+                rec["result"] = self.admin.pool_admin(admin_op)
+                rec["ran_at_s"] = round(time.monotonic() - start, 3)
+            except Exception as e:  # noqa: BLE001 - report, don't kill workers
+                rec["error"] = f"{type(e).__name__}: {e}"[:200]
+            with armed_lock:
+                pr.chaos_windows.append(rec)
+
         for wi_c, cw in enumerate(phase.chaos):
-            t = threading.Timer(cw.at_s, arm, args=(wi_c, cw.fault, cw.at_s, cw.for_s))
+            if cw.admin is not None:
+                t = threading.Timer(cw.at_s, run_admin, args=(cw.admin, cw.at_s))
+            else:
+                t = threading.Timer(
+                    cw.at_s, arm, args=(wi_c, cw.fault, cw.at_s, cw.for_s)
+                )
             t.daemon = True
             timers.append(t)
             t.start()
@@ -309,9 +327,12 @@ class ScenarioRunner:
                 profile = self.admin.profile_summary() or None
             except Exception:  # noqa: BLE001
                 profile = None
+        pools_report = None
+        if sc.pools_gate is not None:
+            pools_report = self._await_drained(sc.pools_gate)
         from ..control.sanitizer import profile_if_armed
 
-        return build_report(
+        report = build_report(
             sc,
             results,
             stage_breakdown=stage_breakdown,
@@ -321,3 +342,42 @@ class ScenarioRunner:
             profile=profile,
             cache=cache,
         )
+        if pools_report is not None:
+            report["pools"] = pools_report
+        return report
+
+    def _await_drained(self, gate: dict) -> dict:
+        """Post-run pool gate: poll the pool-lifecycle status until every
+        pool in require_drained reports 'decommissioned' (or max_drain_s
+        runs out). The drain keeps working after the traffic stops, so the
+        wait happens off the measurement clock."""
+        require = list(gate.get("require_drained") or [])
+        max_s = float(gate.get("max_drain_s", 120.0))
+        t0 = time.monotonic()
+        status: dict = {}
+        drained = not require
+        while not drained and time.monotonic() - t0 < max_s:
+            try:
+                status = self.admin.pool_status()
+            except Exception:  # noqa: BLE001 - a live target may deny admin
+                status = {}
+            rows = {r.get("index"): r for r in status.get("pools", [])}
+            drained = all(
+                rows.get(pi, {}).get("status") == "decommissioned"
+                for pi in require
+            )
+            if not drained:
+                time.sleep(0.25)
+        out = {
+            "require_drained": require,
+            "max_drain_s": max_s,
+            "drained": drained,
+            "ok": drained,
+            "status": status,
+        }
+        if drained and require:
+            out["time_to_drained_s"] = round(time.monotonic() - t0, 3)
+            self._log(f"pools {require} drained in {out['time_to_drained_s']}s")
+        elif require:
+            self._log(f"pools {require} NOT drained within {max_s}s")
+        return out

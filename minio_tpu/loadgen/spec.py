@@ -60,8 +60,12 @@ class SloTarget:
 @dataclass
 class ChaosWindow:
     at_s: float           # offset from phase start when the fault arms
-    for_s: float          # how long it stays armed
-    fault: dict           # chaos/faults.py FaultSpec.from_dict payload
+    for_s: float          # how long it stays armed (0 for one-shot admin ops)
+    fault: dict | None = None  # chaos/faults.py FaultSpec.from_dict payload
+    admin: dict | None = None  # one-shot admin op instead of a fault, e.g.
+    #                            {"op": "decommission", "pool": 0}: fired
+    #                            once at at_s, never disarmed (exactly one
+    #                            of fault/admin per window)
 
 
 @dataclass
@@ -87,6 +91,11 @@ class Scenario:
     bucket: str = "loadgen"
     nodes: int = 4                 # in-process cluster shape (ignored for live)
     drives_per_node: int = 4
+    pools: int = 1                 # server pools in the in-process cluster
+    pools_gate: dict | None = None  # {"require_drained": [pool...],
+    #                                  "max_drain_s": s}: after the phases,
+    #                                  wait for those pools to reach
+    #                                  'decommissioned' and gate the run on it
     keys: int = 256                # keyspace size
     prefix: str = "lg/"
     prepopulate: int = 128         # objects PUT before the clock starts
@@ -185,14 +194,23 @@ def _parse_phase(doc, path: str) -> Phase:
         cpath = f"{path}.chaos[{i}]"
         if not isinstance(cw, dict):
             raise SpecError(cpath, "chaos window must be an object")
-        fault = _require(cw, cpath, "fault", dict, required=True)
-        if "kind" not in fault:
+        fault = _require(cw, cpath, "fault", dict, default=None)
+        admin = _require(cw, cpath, "admin", dict, default=None)
+        if (fault is None) == (admin is None):
+            raise SpecError(cpath, "chaos window needs exactly one of fault/admin")
+        if fault is not None and "kind" not in fault:
             raise SpecError(f"{cpath}.fault", "fault spec needs a 'kind'")
+        if admin is not None and "op" not in admin:
+            raise SpecError(f"{cpath}.admin", "admin op needs an 'op'")
         ph.chaos.append(
             ChaosWindow(
                 at_s=float(_number(cw, cpath, "at_s", default=0.0, minimum=0)),
-                for_s=float(_number(cw, cpath, "for_s", required=True, minimum=0)),
-                fault=dict(fault),
+                for_s=float(
+                    _number(cw, cpath, "for_s",
+                            required=fault is not None, default=0.0, minimum=0)
+                ),
+                fault=dict(fault) if fault is not None else None,
+                admin=dict(admin) if admin is not None else None,
             )
         )
     return ph
@@ -239,6 +257,7 @@ def parse_scenario(doc: dict) -> Scenario:
         drives_per_node=int(
             _number(cluster, "$.cluster", "drives_per_node", default=4, minimum=1)
         ),
+        pools=int(_number(cluster, "$.cluster", "pools", default=1, minimum=1)),
         keys=int(_number(ks, "$.keyspace", "keys", default=256, minimum=1)),
         prefix=_require(ks, "$.keyspace", "prefix", str, default="lg/"),
         prepopulate=int(_number(ks, "$.keyspace", "prepopulate", default=128, minimum=0)),
@@ -287,6 +306,23 @@ def parse_scenario(doc: dict) -> Scenario:
         if not isinstance(v, (str, int, float)) or isinstance(v, bool):
             raise SpecError(f"$.env.{k}", f"expected string/number, got {type(v).__name__}")
         sc.env[str(k)] = str(v)
+    pg = _require(doc, "$", "pools", dict, default=None)
+    if pg is not None:
+        req = _require(pg, "$.pools", "require_drained", list, default=[])
+        drained: list[int] = []
+        for i, p in enumerate(req):
+            if not isinstance(p, int) or isinstance(p, bool) or not 0 <= p < sc.pools:
+                raise SpecError(
+                    f"$.pools.require_drained[{i}]",
+                    f"expected pool index 0..{sc.pools - 1}, got {p!r}",
+                )
+            drained.append(p)
+        sc.pools_gate = {
+            "require_drained": drained,
+            "max_drain_s": float(
+                _number(pg, "$.pools", "max_drain_s", default=120.0, minimum=0)
+            ),
+        }
     cache = _require(doc, "$", "cache", dict, default=None)
     if cache is not None:
         ratio = _number(cache, "$.cache", "min_hit_ratio", required=True, minimum=0)

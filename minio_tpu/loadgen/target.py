@@ -285,6 +285,39 @@ class InProcessAdmin:
 
         return GLOBAL_PROFILER.summary()
 
+    # -- pool lifecycle ----------------------------------------------------
+
+    def _poolmgr(self):
+        for n in getattr(self.cluster, "nodes", None) or ():
+            pm = getattr(n, "poolmgr", None)
+            if pm is not None:
+                return pm
+        raise RuntimeError("no pool manager in the in-process cluster")
+
+    def pool_admin(self, op: dict) -> dict:
+        """One-shot pool lifecycle op: {"op": "decommission", "pool": i} /
+        {"op": "attach", "endpoints": [...]} / {"op": "rebalance", ...}."""
+        from dataclasses import asdict
+
+        pm = self._poolmgr()
+        kind = op.get("op")
+        if kind == "decommission":
+            tr = pm.start_decommission(
+                int(op["pool"]), wait=bool(op.get("wait", False))
+            )
+            return {"drain": asdict(tr)}
+        if kind == "attach":
+            idx = pm.attach_endpoints([str(e) for e in op.get("endpoints", [])])
+            return {"pool": idx}
+        if kind == "rebalance":
+            if op.get("start", True):
+                return {"rebalance": pm.start_rebalance(op.get("threshold"))}
+            return {"rebalance": pm.stop_rebalance()}
+        raise RuntimeError(f"unknown pool admin op {kind!r}")
+
+    def pool_status(self) -> dict:
+        return self._poolmgr().status()
+
 
 class EndpointAdmin:
     """Admin surface over the wire (live-endpoint mode): the signed admin
@@ -340,3 +373,29 @@ class EndpointAdmin:
 
     def profile_summary(self) -> dict:
         return self._get_json(ADMIN + "/profile", query=[("summary", "1")])
+
+    # -- pool lifecycle ----------------------------------------------------
+
+    def pool_admin(self, op: dict) -> dict:
+        import json as _json
+
+        paths = {
+            "decommission": "/pools/decommission",
+            "attach": "/pools/attach",
+            "rebalance": "/pools/rebalance",
+        }
+        kind = op.get("op")
+        path = paths.get(str(kind))
+        if path is None:
+            raise RuntimeError(f"unknown pool admin op {kind!r}")
+        body = {k: v for k, v in op.items() if k != "op"}
+        r = self.target.request("POST", ADMIN + path,
+                                body=_json.dumps(body).encode())
+        if r.status_code != 200:
+            raise RuntimeError(
+                f"pool admin {kind} failed: {r.status_code} {r.text[:200]}"
+            )
+        return r.json()
+
+    def pool_status(self) -> dict:
+        return self._get_json(ADMIN + "/pools/status")

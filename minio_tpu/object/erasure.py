@@ -2114,11 +2114,19 @@ class ErasureObjects:
 
         results = meta_mod.parallel_map(rm, disks)
         errs = [e for _, e in results]
+        not_found = (errors.FileNotFound, errors.FileVersionNotFound)
+        if errs and all(e is not None and isinstance(e, not_found) for e in errs):
+            # Every drive agrees the version was never there: that is a clean
+            # not-found, not a write-quorum failure (the multi-pool delete
+            # sweep relies on this to skip pools that never held the object).
+            if vid:
+                raise errors.VersionNotFound(bucket, object_name)
+            raise errors.ObjectNotFound(bucket, object_name)
         err = errors.reduce_quorum_errs(
             errs,
             write_quorum,
             errors.ErasureWriteQuorum(bucket, object_name),
-            ignored=(errors.FileNotFound, errors.FileVersionNotFound),
+            ignored=not_found,
         )
         if err:
             raise err

@@ -5,6 +5,15 @@ multiple independent pools of erasure sets behind one namespace. New objects
 go to the pool with the most free space (:222-288); reads/deletes probe the
 pool that actually holds the object (:289-372); buckets and listings span all
 pools. This is the object the API layer holds (its `ObjectAPI()`).
+
+Pools carry a lifecycle status (the decommission states of
+cmd/erasure-server-pool-decom.go): ACTIVE pools take new writes; SUSPENDED
+pools exist cluster-wide but do not place yet (the first phase of a two-phase
+attach, object/poolmgr.py); DRAINING pools serve reads while their objects
+migrate out but never receive placements; DECOMMISSIONED pools are empty and
+skipped entirely. Placement (`_pool_with_space`) considers only ACTIVE pools;
+existence probes (`_pool_holding`, listings, multipart, heal) consider all
+non-decommissioned pools.
 """
 
 from __future__ import annotations
@@ -27,6 +36,13 @@ from .types import (
     PutObjectOptions,
 )
 
+# Pool lifecycle statuses (poolMeta decommission states, reference
+# cmd/erasure-server-pool-decom.go; transitions owned by object/poolmgr.py).
+POOL_ACTIVE = "active"
+POOL_SUSPENDED = "suspended"
+POOL_DRAINING = "draining"
+POOL_DECOMMISSIONED = "decommissioned"
+
 
 class ServerPools:
     # The API front streams request/response bodies through this layer
@@ -37,6 +53,7 @@ class ServerPools:
         if not pools:
             raise ValueError("need at least one pool")
         self.pools = pools
+        self.statuses: list[str] = [POOL_ACTIVE] * len(pools)
 
     # -- convenience constructors ---------------------------------------------
 
@@ -51,11 +68,38 @@ class ServerPools:
         count = set_drive_count or len(disks)
         return cls([ErasureSets(list(disks), count, parity=parity, codec=codec)])
 
+    # -- pool lifecycle --------------------------------------------------------
+
+    def add_pool(self, sets: ErasureSets, status: str = POOL_SUSPENDED) -> int:
+        """Append a pool at runtime (attach-pool expansion). Returns its
+        index. Added SUSPENDED by default: object/poolmgr.py flips it
+        ACTIVE only after the pool-config epoch has fanned out."""
+        self.pools.append(sets)
+        self.statuses.append(status)
+        return len(self.pools) - 1
+
+    def set_pool_status(self, pool_index: int, status: str) -> None:
+        self.statuses[pool_index] = status
+
+    def _probe_pools(self) -> list[tuple[int, ErasureSets]]:
+        """Pools that may hold data: everything not decommissioned."""
+        return [
+            (i, p) for i, p in enumerate(self.pools)
+            if self.statuses[i] != POOL_DECOMMISSIONED
+        ]
+
     # -- pool selection --------------------------------------------------------
 
     def _pool_with_space(self) -> ErasureSets:
-        best, best_free = self.pools[0], -1
-        for p in self.pools:
+        """Placement target: the ACTIVE pool with the most free bytes,
+        ties broken by lowest pool index -- deterministic, so every node
+        running the same pool config places identically. Suspended /
+        draining / decommissioned pools never receive new writes."""
+        best: ErasureSets | None = None
+        best_key: tuple[int, int] | None = None
+        for i, p in enumerate(self.pools):
+            if self.statuses[i] != POOL_ACTIVE:
+                continue
             free = 0
             for d in p.disks:
                 if d is None:
@@ -64,24 +108,54 @@ class ServerPools:
                     free += d.disk_info().free
                 except errors.DiskError:
                     continue
-            if free > best_free:
-                best, best_free = p, free
+            key = (-free, i)
+            if best_key is None or key < best_key:
+                best, best_key = p, key
+        if best is None:
+            raise errors.DiskFull("no active pool available for writes")
+        return best
+
+    def _pool_holding_index(
+        self, bucket: str, object_name: str, version_id: str = ""
+    ) -> int:
+        """Index of the pool holding the newest copy. Probes run in
+        parallel across candidate pools; during a migration window (the
+        object momentarily present in two pools) the newest mod_time wins,
+        lowest pool index on an exact tie."""
+        if len(self.pools) == 1:
+            return 0
+        cands = [
+            i for i, st in enumerate(self.statuses)
+            if st != POOL_DECOMMISSIONED
+        ]
+        if len(cands) == 1:
+            # Negative-lookup fast path: decommissioned pools are empty by
+            # invariant, so a single live pool needs no existence probe.
+            return cands[0]
+
+        def probe(i: int) -> ObjectInfo:
+            return self.pools[i].get_object_info(
+                bucket, object_name, GetObjectOptions(version_id)
+            )
+
+        best: int | None = None
+        best_key: tuple[float, int] | None = None
+        last: Exception | None = None
+        for i, (oi, err) in zip(cands, meta_mod.parallel_map(probe, cands)):
+            if err is not None:
+                if isinstance(err, errors.ObjectError):
+                    last = err
+                    continue
+                raise err
+            key = (oi.mod_time, -i)
+            if best_key is None or key > best_key:
+                best, best_key = i, key
+        if best is None:
+            raise last or errors.ObjectNotFound(bucket, object_name)
         return best
 
     def _pool_holding(self, bucket: str, object_name: str, version_id: str = "") -> ErasureSets:
-        if len(self.pools) == 1:
-            return self.pools[0]
-        newest: tuple[float, ErasureSets] | None = None
-        for p in self.pools:
-            try:
-                oi = p.get_object_info(bucket, object_name, GetObjectOptions(version_id))
-                if newest is None or oi.mod_time > newest[0]:
-                    newest = (oi.mod_time, p)
-            except errors.ObjectError:
-                continue
-        if newest is None:
-            raise errors.ObjectNotFound(bucket, object_name)
-        return newest[1]
+        return self.pools[self._pool_holding_index(bucket, object_name, version_id)]
 
     # -- buckets ---------------------------------------------------------------
 
@@ -127,10 +201,18 @@ class ServerPools:
         self, bucket: str, object_name: str, data: bytes, opts: PutObjectOptions | None = None
     ) -> ObjectInfo:
         _validate_object_name(bucket, object_name)
-        # Overwrites must land in the pool that already holds the object.
+        # Overwrites must land in the pool that already holds the object --
+        # unless that pool stopped taking writes (draining/suspended), in
+        # which case the overwrite places fresh and the drain removes the
+        # old copy.
+        pool = None
         try:
-            pool = self._pool_holding(bucket, object_name)
+            idx = self._pool_holding_index(bucket, object_name)
+            if self.statuses[idx] == POOL_ACTIVE:
+                pool = self.pools[idx]
         except errors.ObjectError:
+            pass
+        if pool is None:
             pool = self._pool_with_space()
         return pool.put_object(bucket, object_name, data, opts)
 
@@ -143,13 +225,11 @@ class ServerPools:
         length: int = -1,
     ) -> tuple[ObjectInfo, bytes]:
         opts = opts or GetObjectOptions()
-        last: Exception = errors.ObjectNotFound(bucket, object_name)
-        for p in self.pools:
-            try:
-                return p.get_object(bucket, object_name, opts, offset, length)
-            except (errors.ObjectNotFound, errors.VersionNotFound) as e:
-                last = e
-        raise last
+        # Resolve to the pool with the NEWEST copy (not first-found): during
+        # a drain/rebalance move window the object briefly exists in two
+        # pools, and first-found could serve the stale source copy.
+        i = self._pool_holding_index(bucket, object_name, opts.version_id)
+        return self.pools[i].get_object(bucket, object_name, opts, offset, length)
 
     def get_object_stream(
         self,
@@ -161,25 +241,15 @@ class ServerPools:
     ):
         """Streaming get: (ObjectInfo, iterator of decoded chunks)."""
         opts = opts or GetObjectOptions()
-        last: Exception = errors.ObjectNotFound(bucket, object_name)
-        for p in self.pools:
-            try:
-                return p.get_object_stream(bucket, object_name, opts, offset, length)
-            except (errors.ObjectNotFound, errors.VersionNotFound) as e:
-                last = e
-        raise last
+        i = self._pool_holding_index(bucket, object_name, opts.version_id)
+        return self.pools[i].get_object_stream(bucket, object_name, opts, offset, length)
 
     def get_object_info(
         self, bucket: str, object_name: str, opts: GetObjectOptions | None = None
     ) -> ObjectInfo:
         opts = opts or GetObjectOptions()
-        last: Exception = errors.ObjectNotFound(bucket, object_name)
-        for p in self.pools:
-            try:
-                return p.get_object_info(bucket, object_name, opts)
-            except (errors.ObjectNotFound, errors.VersionNotFound) as e:
-                last = e
-        raise last
+        i = self._pool_holding_index(bucket, object_name, opts.version_id)
+        return self.pools[i].get_object_info(bucket, object_name, opts)
 
     def put_object_metadata(
         self, bucket, object_name, version_id: str = "", updates=None, removes=None
@@ -207,20 +277,24 @@ class ServerPools:
     ) -> ObjectInfo:
         opts = opts or DeleteObjectOptions()
         if opts.versioned and not opts.version_id:
-            # Delete marker goes where the object lives (or first pool).
+            # Delete marker goes where the object lives (or a write pool).
             try:
                 pool = self._pool_holding(bucket, object_name)
             except errors.ObjectError:
-                pool = self.pools[0]
+                pool = self._pool_with_space()
             return pool.delete_object(bucket, object_name, opts)
+        # Physical delete sweeps EVERY live pool: during a migration window
+        # the object exists in two pools, and removing only the first-found
+        # copy would let the other pool resurrect it.
         last: Exception | None = None
-        for p in self.pools:
+        result: ObjectInfo | None = None
+        for _i, p in self._probe_pools():
             try:
-                return p.delete_object(bucket, object_name, opts)
+                result = p.delete_object(bucket, object_name, opts)
             except (errors.ObjectNotFound, errors.VersionNotFound) as e:
                 last = e
-        if last and len(self.pools) > 1:
-            raise last
+        if result is not None:
+            return result
         if last:
             raise last
         return ObjectInfo(bucket=bucket, name=object_name)
@@ -248,12 +322,14 @@ class ServerPools:
         delimiter: str = "",
         max_keys: int = 1000,
     ) -> ListObjectsInfo:
-        if len(self.pools) == 1:
-            return self.pools[0].list_objects(bucket, prefix, marker, delimiter, max_keys)
+        probes = self._probe_pools()
+        if len(probes) == 1:
+            return probes[0][1].list_objects(bucket, prefix, marker, delimiter, max_keys)
         # Merge per-pool listings (each sorted).
         merged = ListObjectsInfo()
         streams = [
-            p.list_objects(bucket, prefix, marker, delimiter, max_keys) for p in self.pools
+            p.list_objects(bucket, prefix, marker, delimiter, max_keys)
+            for _i, p in probes
         ]
         names: dict[str, ObjectInfo] = {}
         for s in streams:
@@ -280,12 +356,13 @@ class ServerPools:
         delimiter: str = "",
         max_keys: int = 1000,
     ) -> ListObjectVersionsInfo:
-        if len(self.pools) == 1:
-            return self.pools[0].list_object_versions(
+        probes = self._probe_pools()
+        if len(probes) == 1:
+            return probes[0][1].list_object_versions(
                 bucket, prefix, key_marker, version_marker, delimiter, max_keys
             )
         out = ListObjectVersionsInfo()
-        for p in self.pools:
+        for _i, p in probes:
             part = p.list_object_versions(
                 bucket, prefix, key_marker, version_marker, delimiter, max_keys
             )
@@ -303,15 +380,20 @@ class ServerPools:
 
     def new_multipart_upload(self, bucket, object_name, opts: PutObjectOptions | None = None) -> str:
         _validate_object_name(bucket, object_name)
+        pool = None
         try:
-            pool = self._pool_holding(bucket, object_name)
+            idx = self._pool_holding_index(bucket, object_name)
+            if self.statuses[idx] == POOL_ACTIVE:
+                pool = self.pools[idx]
         except errors.ObjectError:
+            pass
+        if pool is None:
             pool = self._pool_with_space()
         return pool.new_multipart_upload(bucket, object_name, opts)
 
     def _pool_with_upload(self, bucket: str, object_name: str, upload_id: str):
         last: Exception | None = None
-        for p in self.pools:
+        for _i, p in self._probe_pools():
             try:
                 p.list_parts(bucket, object_name, upload_id, 0, 1)
                 return p
@@ -341,7 +423,7 @@ class ServerPools:
 
     def list_multipart_uploads(self, bucket, prefix=""):
         out = []
-        for p in self.pools:
+        for _i, p in self._probe_pools():
             out.extend(p.list_multipart_uploads(bucket, prefix))
         return sorted(out, key=lambda u: (u["object"], u["initiated"]))
 
@@ -351,7 +433,7 @@ class ServerPools:
         self, bucket: str, object_name: str, version_id: str = "", dry_run: bool = False
     ) -> HealResultItem:
         last: Exception | None = None
-        for p in self.pools:
+        for _i, p in self._probe_pools():
             try:
                 return p.heal_object(bucket, object_name, version_id, dry_run)
             except (errors.ObjectError, errors.DiskError) as e:

@@ -1,0 +1,325 @@
+"""Pool lifecycle subsystem tests: attach, checkpointed decommission with
+crash/resume, rebalance-on-expansion, throttle math, status + metrics.
+
+The unit-level half of cmd/erasure-server-pool-decom.go coverage; the
+under-live-traffic end (node killed mid-drain, loadgen SLO gates) lives in
+tests/chaos_scenarios.py and scenarios/decommission_under_load.yaml.
+"""
+
+import json
+import os
+
+import pytest
+
+from minio_tpu.control.rebalance import RebalanceEngine, ThrottleBudget
+from minio_tpu.object import poolmgr as poolmgr_mod
+from minio_tpu.object.poolmgr import (
+    CONFIG_FILE,
+    DecommissionTracker,
+    PoolManager,
+    _read_sys,
+)
+from minio_tpu.object.pools import (
+    POOL_ACTIVE,
+    POOL_DECOMMISSIONED,
+    POOL_DRAINING,
+    ServerPools,
+)
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.object.types import DeleteObjectOptions, PutObjectOptions
+from minio_tpu.storage import format as fmt
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.utils import errors
+
+
+def make_sets(tmp_path, pi: int, n_disks: int = 4) -> ErasureSets:
+    formats = fmt.init_format(1, n_disks)
+    drives = []
+    for i in range(n_disks):
+        root = str(tmp_path / f"pool{pi}" / f"disk{i}")
+        os.makedirs(root, exist_ok=True)
+        formats[i].save(root)
+        drives.append(LocalDrive(root))
+    return ErasureSets.from_drives(drives, formats[0], pool_index=pi)
+
+
+@pytest.fixture
+def layer(tmp_path):
+    lp = ServerPools([make_sets(tmp_path, 0), make_sets(tmp_path, 1)])
+    lp.make_bucket("bucket")
+    return lp
+
+
+class TestThrottleBudget:
+    def test_unlimited_never_sleeps(self):
+        slept = []
+        b = ThrottleBudget(bytes_per_s=0, ops_per_s=0,
+                           clock=lambda: 0.0, sleep=slept.append)
+        for _ in range(10):
+            assert b.consume(1 << 20) == 0.0
+        assert slept == []
+        assert b.throttle_waits == 0
+        assert b.bytes == 10 << 20
+
+    def test_bytes_budget_paces(self):
+        now = [0.0]
+        slept = []
+        b = ThrottleBudget(bytes_per_s=1000, ops_per_s=0,
+                           clock=lambda: now[0], sleep=slept.append)
+        assert b.consume(500) == 0.0               # first move rides free
+        assert b.consume(500) == pytest.approx(0.5)  # clock ran 0.5s ahead
+        assert slept == [pytest.approx(0.5)]
+        assert b.throttle_waits == 1
+        assert b.throttled_seconds == pytest.approx(0.5)
+        now[0] = 10.0                               # idle drains the debt
+        assert b.consume(500) == 0.0
+
+    def test_ops_budget_paces(self):
+        now = [0.0]
+        slept = []
+        b = ThrottleBudget(bytes_per_s=0, ops_per_s=2,
+                           clock=lambda: now[0], sleep=slept.append)
+        assert b.consume(0) == 0.0
+        assert b.consume(0) == pytest.approx(0.5)
+        assert b.ops == 2
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("MTPU_REBALANCE_BYTES_PER_S", "2048")
+        monkeypatch.setenv("MTPU_REBALANCE_OPS_PER_S", "7")
+        b = ThrottleBudget(clock=lambda: 0.0, sleep=lambda s: None)
+        assert b.bytes_per_s == 2048.0
+        assert b.ops_per_s == 7.0
+
+
+class TestAttach:
+    def test_attach_is_two_phase_and_persisted(self, tmp_path, layer):
+        pm = PoolManager(layer)
+        idx = pm.attach(make_sets(tmp_path, 2), endpoints=["/fake/ep"])
+        assert idx == 2
+        assert layer.statuses == [POOL_ACTIVE] * 3
+        # SUSPENDED fanout + ACTIVE fanout = two epoch bumps.
+        assert pm.epoch == 2
+        doc = json.loads(_read_sys(layer, CONFIG_FILE).decode())
+        assert doc["epoch"] == 2
+        assert [p["status"] for p in doc["pools"]] == [POOL_ACTIVE] * 3
+        assert doc["pools"][2]["endpoints"] == ["/fake/ep"]
+
+    def test_attach_replicates_buckets(self, tmp_path, layer):
+        pm = PoolManager(layer)
+        pm.attach(make_sets(tmp_path, 2))
+        assert layer.pools[2].get_bucket_info("bucket").name == "bucket"
+        # And the joined pool takes part in the namespace immediately.
+        layer.pools[2].put_object("bucket", "landed", b"x")
+        _, data = layer.get_object("bucket", "landed")
+        assert data == b"x"
+
+    def test_load_config_applies_newer_epoch(self, tmp_path, layer):
+        pm = PoolManager(layer)
+        pm.attach(make_sets(tmp_path, 2))
+        layer.set_pool_status(2, POOL_DRAINING)
+        pm._bump_epoch_and_fanout()
+        # A fresh manager over the same pools (epoch 0) catches up from
+        # the persisted config; an already-current one is a no-op.
+        pm2 = PoolManager(layer)
+        assert pm2.load_config() is True
+        assert pm2.epoch == 3
+        assert layer.statuses[2] == POOL_DRAINING
+        assert pm2.load_config() is False
+
+
+class TestDecommission:
+    def _fill(self, layer, n=12, prefix="obj"):
+        for i in range(n):
+            layer.pools[0].put_object("bucket", f"{prefix}-{i:03d}",
+                                      f"payload-{i}".encode() * 8)
+
+    def test_drain_moves_everything(self, layer):
+        self._fill(layer, 12)
+        pm = PoolManager(layer)
+        pm.start_decommission(0, wait=True)
+        tr = pm.trackers[0]
+        assert tr.finished and not tr.failed
+        assert tr.objects_moved == 12
+        assert layer.statuses[0] == POOL_DECOMMISSIONED
+        assert pm._pool_object_count(layer.pools[0]) == 0
+        names = [o.name for o in layer.list_objects("bucket", max_keys=100).objects]
+        assert len(names) == 12
+        for i in range(12):
+            _, data = layer.get_object("bucket", f"obj-{i:03d}")
+            assert data == f"payload-{i}".encode() * 8
+
+    def test_drain_preserves_versions_and_markers(self, layer):
+        vids = []
+        for i in range(3):
+            oi = layer.pools[0].put_object(
+                "bucket", "ver", f"v{i}".encode(),
+                PutObjectOptions(versioned=True),
+            )
+            vids.append(oi.version_id)
+        layer.pools[0].put_object("bucket", "gone", b"soon",
+                                  PutObjectOptions(versioned=True))
+        layer.pools[0].delete_object("bucket", "gone",
+                                     DeleteObjectOptions(versioned=True))
+        pm = PoolManager(layer)
+        pm.start_decommission(0, wait=True)
+        assert pm.trackers[0].finished
+        # Every version is readable from the surviving pool, by id.
+        for i, vid in enumerate(vids):
+            from minio_tpu.object.types import GetObjectOptions
+
+            _, data = layer.get_object("bucket", "ver",
+                                       GetObjectOptions(version_id=vid))
+            assert data == f"v{i}".encode()
+        # The delete marker still shadows the deleted object.
+        with pytest.raises(errors.ObjectError):
+            layer.get_object("bucket", "gone")
+
+    def test_cannot_drain_last_active_pool(self, layer):
+        layer.set_pool_status(1, POOL_DRAINING)
+        pm = PoolManager(layer)
+        with pytest.raises(errors.InvalidArgument):
+            pm.start_decommission(0)
+
+    def test_double_drain_rejected(self, layer):
+        self._fill(layer, 4)
+        pm = PoolManager(layer)
+        pm.start_decommission(0, wait=True)
+        with pytest.raises(errors.InvalidArgument):
+            pm.start_decommission(0)
+
+    def test_drain_excluded_from_placement(self, layer):
+        layer.set_pool_status(0, POOL_DRAINING)
+        assert layer._pool_with_space() is layer.pools[1]
+
+
+class _Killed(Exception):
+    pass
+
+
+class TestCrashResume:
+    def test_kill_mid_drain_resumes_from_checkpoint(self, layer):
+        n = 24
+        for i in range(n):
+            layer.pools[0].put_object("bucket", f"k-{i:03d}", b"d" * 64)
+        pm = PoolManager(layer)
+        kills = {"left": 2}
+
+        def hook(tracker):
+            # Simulated hard kill after two move batches: the exception
+            # tears down the drain thread exactly like a process death
+            # would, leaving only the journaled checkpoint behind.
+            kills["left"] -= 1
+            if kills["left"] == 0:
+                raise _Killed("node killed mid-drain")
+
+        pm._drain_hook = hook
+        pm.start_decommission(0, wait=True, checkpoint_every=4)
+        tr1 = pm.trackers[0]
+        assert not tr1.finished and "Killed" in tr1.failed
+        moved_before = tr1.objects_moved
+        assert 0 < moved_before < n
+        assert layer.statuses[0] == POOL_DRAINING  # still mid-flight
+
+        # "Restart": a brand-new manager over the same storage. It reads
+        # the persisted pool config + drain journal and resumes the drain
+        # from the cursor -- no re-walk from the top.
+        pm2 = PoolManager(layer)
+        pm2.load_config()
+        saved = DecommissionTracker.load(layer, 0)
+        assert saved is not None and saved.resume_object
+        assert saved.objects_moved == moved_before
+        assert pm2.resume_pending() == [0]
+        pm2.join()
+        tr2 = pm2.trackers[0]
+        assert tr2.finished and not tr2.failed
+        # Resumed, not restarted: the tracker is cumulative across the
+        # kill, so the second leg moved only what the first leg left...
+        assert tr2.objects_moved - saved.objects_moved == n - moved_before
+        assert layer.statuses[0] == POOL_DECOMMISSIONED
+        # ...and nothing was lost or doubled.
+        listing = layer.list_objects("bucket", max_keys=100).objects
+        assert [o.name for o in listing] == [f"k-{i:03d}" for i in range(n)]
+        for i in range(n):
+            _, data = layer.get_object("bucket", f"k-{i:03d}")
+            assert data == b"d" * 64
+        assert pm2._pool_object_count(layer.pools[0]) == 0
+
+    def test_resume_noop_when_nothing_draining(self, layer):
+        pm = PoolManager(layer)
+        assert pm.resume_pending() == []
+
+
+class TestRebalance:
+    def test_skew_converges_without_oscillation(self, tmp_path, layer):
+        for i in range(20):
+            layer.pools[0].put_object("bucket", f"r-{i:03d}", b"z" * 256)
+        pm = PoolManager(layer)
+        pm.attach(make_sets(tmp_path, 2))
+        eng: RebalanceEngine = pm.rebalancer
+        pm.start_rebalance(threshold=0.10)
+        eng.join(60)
+        assert not eng.running
+        assert eng.objects_moved > 0
+        assert max(eng._skews().values()) <= 0.10
+        # The donor was not drained past its fair share into a ping-pong.
+        for i in range(20):
+            _, data = layer.get_object("bucket", f"r-{i:03d}")
+            assert data == b"z" * 256
+
+    def test_balanced_cluster_is_noop(self, layer):
+        pm = PoolManager(layer)
+        eng = pm.rebalancer
+        assert eng._round(0.10) == 0
+        assert eng.objects_moved == 0
+
+
+class TestStatusAndMetrics:
+    def test_status_shape(self, tmp_path, layer):
+        layer.pools[0].put_object("bucket", "one", b"x" * 100)
+        pm = PoolManager(layer)
+        st = pm.status()
+        assert st["epoch"] == 0
+        assert {"pools_attached", "objects_moved", "checkpoints"} <= set(st["stats"])
+        assert len(st["pools"]) == 2
+        row = st["pools"][0]
+        assert row["status"] == POOL_ACTIVE
+        assert row["capacity_bytes"] > 0
+        assert row["objects"] >= 1
+
+    def test_drain_progress_in_status(self, layer):
+        for i in range(6):
+            layer.pools[0].put_object("bucket", f"s-{i}", b"y" * 32)
+        pm = PoolManager(layer)
+        pm.start_decommission(0, wait=True)
+        pm._gauge_cache.clear()  # gauges were cached mid-drain
+        row = pm.status()["pools"][0]
+        assert row["status"] == POOL_DECOMMISSIONED
+        assert row["drain"]["finished"] is True
+        assert row["drain"]["objects_moved"] == 6
+
+    def test_metrics_exposition_renders_pool_series(self, layer):
+        from minio_tpu.control.metrics import MetricsSys
+
+        layer.pools[0].put_object("bucket", "m-0", b"w" * 50)
+        pm = PoolManager(layer)
+        pm.start_decommission(0, wait=True)
+        m = MetricsSys()
+        m.poolmgr = pm
+        text = m.render_node()
+        assert "minio_tpu_pool_attached_total" in text
+        assert "minio_tpu_pool_objects_moved_total" in text
+        assert 'minio_tpu_pool_capacity_bytes{pool="0"' in text
+        assert 'minio_tpu_pool_drain_finished{pool="0"} 1' in text
+
+    def test_tracker_roundtrip(self, layer):
+        tr = DecommissionTracker(pool_index=0, started=1.0, objects_moved=7,
+                                 resume_bucket="bucket", resume_object="k-5")
+        tr.save(layer)
+        back = DecommissionTracker.load(layer, 0)
+        assert back is not None
+        assert back.objects_moved == 7
+        assert (back.resume_bucket, back.resume_object) == ("bucket", "k-5")
+        # Journal lives OFF the draining pool: every copy is on pool 1.
+        assert DecommissionTracker.load(
+            ServerPools([layer.pools[1]]), 0
+        ) is not None

@@ -1,0 +1,168 @@
+"""Pool placement + lifecycle-status routing tests (ServerPools).
+
+The placement half of cmd/erasure-server-pool.go: new objects go to the
+ACTIVE pool with the most free space (deterministic tie-break by pool
+index), overwrites follow the holding pool, reads/deletes/listings span
+every non-decommissioned pool, and a draining pool never receives writes.
+"""
+
+import os
+from dataclasses import replace
+
+import pytest
+
+from minio_tpu.object.pools import (
+    POOL_ACTIVE,
+    POOL_DECOMMISSIONED,
+    POOL_DRAINING,
+    ServerPools,
+)
+from minio_tpu.object.sets import ErasureSets
+from minio_tpu.storage import format as fmt
+from minio_tpu.storage.local import LocalDrive
+from minio_tpu.utils import errors
+
+
+def make_pools(tmp_path, n_pools=2, n_disks=4) -> ServerPools:
+    pools = []
+    for pi in range(n_pools):
+        formats = fmt.init_format(1, n_disks)
+        drives = []
+        for i in range(n_disks):
+            root = str(tmp_path / f"pool{pi}" / f"disk{i}")
+            os.makedirs(root, exist_ok=True)
+            formats[i].save(root)
+            drives.append(LocalDrive(root))
+        pools.append(ErasureSets.from_drives(drives, formats[0], pool_index=pi))
+    return ServerPools(pools)
+
+
+def _override_free(pool: ErasureSets, free: int) -> None:
+    """Pin every drive's reported free bytes (instance-level shadow of
+    disk_info): placement tests need capacities the shared tmp filesystem
+    can't provide."""
+    for d in pool.disks:
+        real = d.disk_info()
+        d.disk_info = lambda di=replace(real, free=free): di
+
+
+@pytest.fixture
+def layer(tmp_path):
+    lp = make_pools(tmp_path)
+    lp.make_bucket("bucket")
+    return lp
+
+
+class TestPlacement:
+    def test_most_free_pool_wins(self, layer):
+        _override_free(layer.pools[0], 10 << 20)
+        _override_free(layer.pools[1], 50 << 20)
+        assert layer._pool_with_space() is layer.pools[1]
+
+    def test_tie_breaks_to_lowest_index(self, layer):
+        _override_free(layer.pools[0], 42 << 20)
+        _override_free(layer.pools[1], 42 << 20)
+        # Equal free bytes on every probe: deterministic, index 0 wins --
+        # every node running the same pool config must place identically.
+        for _ in range(5):
+            assert layer._pool_with_space() is layer.pools[0]
+
+    def test_capacity_weighted_put(self, layer):
+        _override_free(layer.pools[0], 1 << 20)
+        _override_free(layer.pools[1], 100 << 20)
+        layer.put_object("bucket", "fresh", b"x" * 128)
+        oi = layer.pools[1].get_object_info("bucket", "fresh")
+        assert oi.name == "fresh"
+        with pytest.raises(errors.ObjectError):
+            layer.pools[0].get_object_info("bucket", "fresh")
+
+    def test_overwrite_lands_in_holding_pool(self, layer):
+        # Seed the object in pool 1 directly, then make pool 1 look FULLER
+        # than pool 0: the overwrite must still follow the holding pool.
+        layer.pools[1].put_object("bucket", "sticky", b"v1")
+        _override_free(layer.pools[0], 100 << 20)
+        _override_free(layer.pools[1], 1 << 20)
+        layer.put_object("bucket", "sticky", b"v2")
+        _, data = layer.pools[1].get_object("bucket", "sticky")
+        assert data == b"v2"
+        with pytest.raises(errors.ObjectError):
+            layer.pools[0].get_object_info("bucket", "sticky")
+
+    def test_no_active_pool_is_disk_full(self, layer):
+        layer.set_pool_status(0, POOL_DRAINING)
+        layer.set_pool_status(1, POOL_DRAINING)
+        with pytest.raises(errors.DiskFull):
+            layer._pool_with_space()
+
+
+class TestLifecycleRouting:
+    def test_draining_pool_excluded_from_writes(self, layer):
+        _override_free(layer.pools[0], 100 << 20)
+        _override_free(layer.pools[1], 1 << 20)
+        layer.set_pool_status(0, POOL_DRAINING)
+        # Pool 0 has far more room but is draining: writes go to pool 1.
+        layer.put_object("bucket", "routed", b"data")
+        assert layer.pools[1].get_object_info("bucket", "routed").name == "routed"
+        with pytest.raises(errors.ObjectError):
+            layer.pools[0].get_object_info("bucket", "routed")
+
+    def test_overwrite_of_draining_pool_object_places_fresh(self, layer):
+        layer.pools[0].put_object("bucket", "mig", b"old")
+        layer.set_pool_status(0, POOL_DRAINING)
+        layer.put_object("bucket", "mig", b"new")
+        # New copy in the active pool; reads resolve the NEWEST copy even
+        # though the stale source copy still exists mid-migration.
+        _, data = layer.get_object("bucket", "mig")
+        assert data == b"new"
+        assert layer.pools[1].get_object_info("bucket", "mig").name == "mig"
+
+    def test_draining_pool_still_serves_reads(self, layer):
+        layer.pools[0].put_object("bucket", "readable", b"still-here")
+        layer.set_pool_status(0, POOL_DRAINING)
+        _, data = layer.get_object("bucket", "readable")
+        assert data == b"still-here"
+
+    def test_decommissioned_pool_skipped_entirely(self, layer):
+        layer.pools[1].put_object("bucket", "kept", b"kept")
+        layer.set_pool_status(0, POOL_DECOMMISSIONED)
+        assert layer.statuses == [POOL_DECOMMISSIONED, POOL_ACTIVE]
+        assert [i for i, _ in layer._probe_pools()] == [1]
+        # Single live candidate: the negative-lookup fast path answers
+        # without probing, and a miss is a clean ObjectNotFound.
+        _, data = layer.get_object("bucket", "kept")
+        assert data == b"kept"
+        with pytest.raises(errors.ObjectNotFound):
+            layer.get_object("bucket", "no-such-key")
+
+
+class TestNamespaceSpansPools:
+    def test_listing_merges_pools(self, layer):
+        layer.pools[0].put_object("bucket", "a-zero", b"0")
+        layer.pools[1].put_object("bucket", "b-one", b"1")
+        names = [o.name for o in layer.list_objects("bucket").objects]
+        assert names == ["a-zero", "b-one"]
+
+    def test_listing_dedupes_newest_copy(self, layer):
+        layer.pools[0].put_object("bucket", "dup", b"old")
+        layer.pools[1].put_object("bucket", "dup", b"new")
+        listed = layer.list_objects("bucket").objects
+        assert [o.name for o in listed] == ["dup"]
+        _, data = layer.get_object("bucket", "dup")
+        assert data == b"new"
+
+    def test_delete_sweeps_every_pool(self, layer):
+        # Mid-migration the object exists in BOTH pools; a delete must not
+        # let the second copy resurrect it.
+        layer.pools[0].put_object("bucket", "both", b"copy0")
+        layer.pools[1].put_object("bucket", "both", b"copy1")
+        layer.delete_object("bucket", "both")
+        with pytest.raises(errors.ObjectNotFound):
+            layer.get_object("bucket", "both")
+        assert layer.list_objects("bucket").objects == []
+
+    def test_bucket_ops_span_pools(self, layer):
+        layer.make_bucket("span-bucket")
+        for p in layer.pools:
+            assert p.get_bucket_info("span-bucket").name == "span-bucket"
+        layer.delete_bucket("span-bucket")
+        assert not layer.bucket_exists("span-bucket")

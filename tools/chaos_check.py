@@ -100,7 +100,8 @@ def main() -> int:
     if fast:
         cmd += ["-m", "not slow"]
     if invariants:
-        cmd += ["-k", "breaker or hedged or deadline or Hedged or Breaker or Deadline"]
+        cmd += ["-k", "breaker or hedged or deadline or Hedged or Breaker "
+                      "or Deadline or decommission or Decommission"]
     cmd += extra
     try:
         proc = subprocess.run(cmd, cwd=root, env=env, timeout=TIMEOUT_S)

@@ -102,11 +102,13 @@ def main(argv: list[str]) -> int:
             workdir = tempfile.mkdtemp(prefix="mtpu-loadgen-")
             _log(
                 f"building in-process cluster: {scenario.nodes} nodes x "
-                f"{scenario.drives_per_node} drives under {workdir}"
+                f"{scenario.drives_per_node} drives x {scenario.pools} pool(s) "
+                f"under {workdir}"
             )
             try:
                 cluster = InProcessCluster(
-                    workdir, scenario.nodes, scenario.drives_per_node
+                    workdir, scenario.nodes, scenario.drives_per_node,
+                    pools=scenario.pools,
                 )
             except RuntimeError as e:
                 _log(str(e))
@@ -150,6 +152,8 @@ def main(argv: list[str]) -> int:
     loss_ok = loss.get("ok", True) if isinstance(loss, dict) else True
     cache_slo = report.get("cache_slo")
     cache_ok = cache_slo.get("ok", True) if isinstance(cache_slo, dict) else True
+    pools_blk = report.get("pools")
+    pools_ok = pools_blk.get("ok", True) if isinstance(pools_blk, dict) else True
     if not slo_ok:
         _log("SLO VIOLATED (see report.slo)")
     if not cmp_ok:
@@ -161,7 +165,12 @@ def main(argv: list[str]) -> int:
         )
     if not cache_ok:
         _log("cache hit-ratio promise missed (see report.cache_slo)")
-    return 0 if slo_ok and cmp_ok and loss_ok and cache_ok else 1
+    if not pools_ok:
+        _log(
+            f"pool(s) {pools_blk.get('require_drained')} did not drain within "
+            f"{pools_blk.get('max_drain_s')}s (see report.pools)"
+        )
+    return 0 if slo_ok and cmp_ok and loss_ok and cache_ok and pools_ok else 1
 
 
 if __name__ == "__main__":

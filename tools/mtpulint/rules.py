@@ -32,6 +32,8 @@ METRICS = "minio_tpu/control/metrics.py"
 DEGRADE = "minio_tpu/control/degrade.py"
 PROFILER = "minio_tpu/control/profiler.py"
 SELFTEST = "minio_tpu/control/selftest.py"
+POOLMGR = "minio_tpu/object/poolmgr.py"
+REBALANCE = "minio_tpu/control/rebalance.py"
 
 
 def _call_name(node: ast.Call) -> str:
@@ -549,10 +551,11 @@ class MetricsRenderedRule(Rule):
 
     id = "metrics-rendered"
     title = "counter incremented but never rendered in control/metrics.py"
-    scope = (DEGRADE, PERF, PROFILER, SELFTEST)
+    scope = (DEGRADE, PERF, PROFILER, SELFTEST, POOLMGR, REBALANCE)
 
     _COUNTER_CLASSES = {
         "DegradeStats", "SlowRequestCapture", "CopyLedger", "SelfTestStats",
+        "PoolLifecycleStats", "ThrottleBudget",
     }
 
     def _counters(self, ctx) -> list[tuple[str, int]]:
