@@ -716,6 +716,17 @@ def emit(payload: dict) -> None:
         payload.setdefault("probe_transition", probe_transition())
     except Exception:  # noqa: BLE001 - the bench line must still emit
         payload.setdefault("probe_transition", None)
+    # Flight triggers fired mid-round taint the numbers: a bench second that
+    # also dumped a diagnostic bundle measured the incident, not the code.
+    try:
+        from minio_tpu.control.flight import GLOBAL_FLIGHT
+
+        payload.setdefault(
+            "flight_triggers_fired",
+            sum(GLOBAL_FLIGHT.stats()["triggers"].values()),
+        )
+    except Exception:  # noqa: BLE001 - the bench line must still emit
+        payload.setdefault("flight_triggers_fired", None)
     print(json.dumps(payload))
 
 

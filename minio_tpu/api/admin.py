@@ -821,6 +821,60 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
             out["peers"] = peers
         return out
 
+    # -- flight recorder (control/flight.py): the always-on black box. ------
+
+    def h_flight_dump(request, body):
+        """Manual trigger: capture a bundle NOW on this node and fan the
+        incident out so every peer freezes the same wall-clock window."""
+        from ..control.flight import GLOBAL_FLIGHT
+
+        doc = json.loads(body) if body else {}
+        incident = GLOBAL_FLIGHT.trigger(
+            "manual", detail={"via": "admin", **({"note": doc["note"]} if doc.get("note") else {})}
+        )
+        return {"ok": True, "incident": incident}
+
+    def h_flight_list(request, body):
+        from ..control.flight import GLOBAL_FLIGHT
+
+        q = request.rel_url.query
+        out: dict = {"bundles": GLOBAL_FLIGHT.list(), "stats": GLOBAL_FLIGHT.stats()}
+        if q.get("cluster", "") in ("1", "true"):
+            peers = {}
+            for p in _peer_clients():
+                try:
+                    r = p.flight_list(timeout=5.0)
+                    peers[p.url] = {"ok": True, "bundles": r.get("bundles", [])}
+                except oerr.StorageError as e:
+                    peers[p.url] = {"ok": False, "error": str(e)}
+            out["peers"] = peers
+        return out
+
+    def h_flight_get(request, body):
+        """Fetch one bundle by id; ?cluster=1 merges every node's bundle for
+        the same incident so one GET shows the correlated cluster view."""
+        from ..control.flight import GLOBAL_FLIGHT
+
+        bundle_id = request.match_info["id"]
+        bundle = GLOBAL_FLIGHT.get(bundle_id)
+        q = request.rel_url.query
+        if q.get("cluster", "") not in ("1", "true"):
+            if bundle is None:
+                raise S3Error("NoSuchKey", f"no flight bundle {bundle_id!r}")
+            return bundle
+        out: dict = {"id": bundle_id, "local": bundle, "peers": {}}
+        for p in _peer_clients():
+            try:
+                r = p.flight_get(bundle_id, timeout=10.0)
+                out["peers"][p.url] = {"ok": True, "bundle": r.get("bundle")}
+            except oerr.StorageError as e:
+                out["peers"][p.url] = {"ok": False, "error": str(e)}
+        if bundle is None and not any(
+            v.get("bundle") for v in out["peers"].values() if v.get("ok")
+        ):
+            raise S3Error("NoSuchKey", f"no flight bundle {bundle_id!r} on any node")
+        return out
+
     # -- profiling (admin-handlers.go:511-716 role): start broadcasts to
     # every peer; stop collects one dump per node -- plain text single-node,
     # a zip with per-node entries in a cluster. The profiler samples
@@ -1182,6 +1236,9 @@ def make_admin_app(ctx: AdminContext) -> web.Application:
     app.router.add_post("/speedtest/net", handler(h_speedtest_net))
     app.router.add_get("/speedtest/net", handler(_h_speedtest_last("net")))
     app.router.add_get("/timeseries", handler(h_timeseries))
+    app.router.add_post("/flight/dump", handler(h_flight_dump))
+    app.router.add_get("/flight", handler(h_flight_list))
+    app.router.add_get("/flight/{id}", handler(h_flight_get))
     app.router.add_post("/profile/start", handler(h_profile_start))
     app.router.add_post("/profile/stop", handler(h_profile_stop))
     app.router.add_get("/profile", handler(h_profile))

@@ -163,6 +163,12 @@ async def stream_hub_response(
         resp = web.StreamResponse()
         resp.content_type = content_type
         resp.headers["Connection"] = "close"
+        if hub is not None:
+            # Loss disclosure: how many records THIS hub has dropped on slow
+            # subscribers so far (control/pubsub.py counter). A watcher that
+            # reconnects and sees the number grow knows its previous feed
+            # had holes instead of trusting an unbroken-looking stream.
+            resp.headers["X-Mtpu-Hub-Dropped"] = str(getattr(hub, "dropped", 0))
         await resp.prepare(request)
         bridge.start()
         # Disconnects surface only through failed writes: emit at least one

@@ -251,7 +251,7 @@ class EventNotifier:
     def __init__(self):
         self.targets: dict[str, WebhookEventTarget] = {}
         self.bucket_rules: dict[str, list[Rule]] = {}
-        self.listen_hub = PubSub()
+        self.listen_hub = PubSub("listen")
         self._lock = san_rlock("EventNotifier._lock")
 
     def register_target(self, target) -> None:

@@ -78,6 +78,7 @@ class MetricsSys:
         self.disk_heal = None  # DiskHealMonitor completed trackers
         self.memcache = None  # MemObjectCache: hot-read tier counters
         self.poolmgr = None  # PoolManager: pool lifecycle gauges
+        self.notifier = None  # EventNotifier: listen-hub drop disclosure
 
     # -- recording -----------------------------------------------------------
 
@@ -210,6 +211,7 @@ class MetricsSys:
         self._render_memcache(metric)
         self._render_pools(metric)
         self._render_timeseries(metric)
+        self._render_flight(metric)
 
         if self.layer is not None:
             total = free = 0
@@ -764,6 +766,65 @@ class MetricsSys:
                help_="Probe runs that reported a failed node/drive/link.")
         metric("minio_tpu_selftest_scratch_cleanups_total", st["scratch_cleanups"],
                help_="Scratch-bucket cleanup passes after speedtest rounds.")
+
+    def _render_flight(self, metric) -> None:
+        """Flight-recorder plane (control/flight.py FlightRecorder) plus the
+        lossy-channel accounting the black box depends on: pub/sub hub drops
+        (control/pubsub.py) and the webhook audit sink's queue counters
+        (control/logging.py WebhookTarget)."""
+        from .flight import GLOBAL_FLIGHT
+        from .logging import GLOBAL_LOGGER
+        from .pubsub import GLOBAL_TRACE
+
+        st = GLOBAL_FLIGHT.stats()
+        metric("minio_tpu_flight_armed", int(bool(st["armed"])),
+               help_="1 when the flight-recorder trigger thread is running.",
+               type_="gauge")
+        metric("minio_tpu_flight_ring_spans", st["ring_spans"],
+               help_="Root spans currently held in the flight ring.",
+               type_="gauge")
+        metric("minio_tpu_flight_ring_capacity", st["ring_max"],
+               help_="Configured flight ring capacity.", type_="gauge")
+        for reason, n in sorted(st["triggers"].items()):
+            metric("minio_tpu_flight_triggers_total", n, {"reason": reason},
+                   help_="Flight-recorder triggers fired, by reason.")
+        metric("minio_tpu_flight_bundles_written_total", st["bundles_written"],
+               help_="Diagnostic bundles written to disk.")
+        metric("minio_tpu_flight_bundles_pruned_total", st["bundles_pruned"],
+               help_="Bundles removed by the retention cap.")
+        metric("minio_tpu_flight_suppressed_total", st["suppressed"],
+               help_="Trigger firings muted by the cooldown window.")
+        metric("minio_tpu_flight_capture_errors_total", st["capture_errors"],
+               help_="Bundle captures that raised (black box stayed up).")
+        metric("minio_tpu_flight_fanout_errors_total", st["fanout_errors"],
+               help_="Cluster fan-outs that raised (local bundle still wrote).")
+        metric("minio_tpu_flight_last_trigger_time", st["last_trigger_time"],
+               help_="Wall-clock time of the last trigger (0 = never).",
+               type_="gauge")
+        # Loss disclosure for every hub a watcher might tail: a grown counter
+        # means the stream had holes the watcher could not see.
+        hubs = [("trace", GLOBAL_TRACE.hub), ("audit", GLOBAL_LOGGER.audit_hub)]
+        if self.notifier is not None:
+            hubs.append(("listen", self.notifier.listen_hub))
+        for name, hub in hubs:
+            metric("minio_tpu_pubsub_dropped_total", getattr(hub, "dropped", 0),
+                   {"hub": name},
+                   help_="Records dropped on slow subscribers, by hub.")
+        dropped = failed = sent = 0
+        for t in GLOBAL_LOGGER.audit_targets:
+            stats = getattr(t, "stats", None)
+            if stats is None:
+                continue
+            row = stats()
+            dropped += row.get("dropped", 0)
+            failed += row.get("failed", 0)
+            sent += row.get("sent", 0)
+        metric("minio_tpu_audit_dropped_total", dropped,
+               help_="Audit entries lost to a full webhook queue.")
+        metric("minio_tpu_audit_failed_total", failed,
+               help_="Audit entries that exhausted webhook retries.")
+        metric("minio_tpu_audit_sent_total", sent,
+               help_="Audit entries delivered to webhook targets.")
 
     def _render_san(self, metric) -> None:
         """Concurrency-sanitizer plane (control/sanitizer.py). Emitted only

@@ -643,11 +643,20 @@ class PerfSys:
         # continuous axis (dashboards difference it), not a measurement
         # window.
         self.timeseries = OpsTimeSeries()
+        # Late-bound flight-recorder hook (control/flight.py installs its
+        # singleton here at import): flight reads this module, so the feed
+        # direction must not become an import cycle. Root spans land in the
+        # flight ring PRE-SAMPLING -- the black box sees every request even
+        # when MTPU_TRACE_SAMPLE thins hub publication.
+        self.flight = None
 
     def on_span_finish(
         self, span, duration_s: float, error: str | None, cpu_s: float = 0.0
     ) -> None:
         self.ledger.record(span.layer, span.name, duration_s, cpu_s)
+        fl = self.flight
+        if fl is not None and span.parent_id == "":
+            fl.record_span(span, duration_s, error)
         if span.trace_id and self.slow.wants(span.trace_id):
             rec = {
                 "name": span.name,

@@ -65,6 +65,8 @@ ROLE_PREFIXES: tuple[tuple[str, str], ...] = (
     ("breaker-probe", "scanner"),
     ("prof-", "profiler"),
     ("gil-probe", "profiler"),
+    ("flight-trigger", "profiler"),      # flight-recorder SLO watcher
+    ("log-webhook", "rpc"),              # webhook log/audit sender
     ("MainThread", "main"),
 )
 

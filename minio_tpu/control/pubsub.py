@@ -15,9 +15,16 @@ from .sanitizer import san_lock, san_rlock
 
 
 class PubSub:
-    def __init__(self):
+    def __init__(self, name: str = ""):
+        self.name = name  # metrics label; "" = anonymous test hub
         self._subs: list[queue.Queue] = []
         self._lock = san_lock("PubSub._lock")
+        # Messages dropped on full subscriber queues. A slow subscriber
+        # never blocks publishers, but the loss must be observable: metrics
+        # renders minio_tpu_pubsub_dropped_total{hub=...} and the stream
+        # endpoints stamp the count into a response header
+        # (api/streams.py), so a watcher with holes in its feed can tell.
+        self.dropped = 0
 
     def num_subscribers(self) -> int:
         return len(self._subs)
@@ -25,11 +32,15 @@ class PubSub:
     def publish(self, item: Any) -> None:
         with self._lock:
             subs = list(self._subs)
+        lost = 0
         for q in subs:
             try:
                 q.put_nowait(item)
             except queue.Full:
-                pass  # slow subscriber drops messages, never blocks publishers
+                lost += 1  # slow subscriber drops messages, never blocks publishers
+        if lost:
+            with self._lock:
+                self.dropped += lost
 
     def subscribe(self, maxsize: int = 10_000) -> queue.Queue:
         q: queue.Queue = queue.Queue(maxsize=maxsize)
@@ -50,7 +61,7 @@ class TraceSys:
     (admin `trace` feature, cmd/admin-handlers.go:1103)."""
 
     def __init__(self):
-        self.hub = PubSub()
+        self.hub = PubSub("trace")
 
     def enabled(self) -> bool:
         return self.hub.num_subscribers() > 0

@@ -49,12 +49,15 @@ _current: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
 # -- span sampling (MTPU_TRACE_SAMPLE) ----------------------------------------
 #
 # High-concurrency load (tools/loadgen.py) can root tens of thousands of
-# requests per second; publishing every span tree to the hub and buffering
-# every trace in the slow-request capture turns the observer into the
-# bottleneck. MTPU_TRACE_SAMPLE in [0, 1] keeps 1-in-round(1/rate) request
-# roots "sampled": sampled-out requests STILL feed the perf ledger (stage
-# attribution stays exact -- it is bucket increments, not span records) but
-# skip hub publication and slow-capture buffering. Default 1.0 = trace all.
+# requests per second; buffering every trace in the slow-request capture
+# turns the observer into the bottleneck. MTPU_TRACE_SAMPLE in [0, 1] keeps
+# 1-in-round(1/rate) request roots "sampled": sampled-out requests STILL
+# feed the perf ledger (stage attribution stays exact -- it is bucket
+# increments, not span records) and STILL publish to the hub / flight ring
+# -- sampling only thins the slow-capture buffering it was built to bound.
+# A live /trace watcher opted into the publication cost by subscribing, and
+# the flight recorder's black box must never be blinded by the knob.
+# Default 1.0 = trace all.
 
 _sample_counter = itertools.count()  # deterministic 1-in-N, not coin flips
 _sample_cached: tuple[str, float] = ("", 1.0)  # (raw env value, parsed rate)
@@ -168,11 +171,14 @@ class Span:
             if threading.get_ident() == self.tid
             else 0.0
         )
-        # The stage ledger records UNCONDITIONALLY -- attribution must not
-        # depend on someone watching the hub OR on the sampling knob
-        # (control/perf.py); only span PUBLICATION is sampled.
+        # The stage ledger and flight ring record UNCONDITIONALLY --
+        # attribution and the black box must not depend on someone watching
+        # the hub OR on the sampling knob (control/perf.py, control/
+        # flight.py); sampling only thins slow-capture buffering.
         GLOBAL_PERF.on_span_finish(self, duration, error, cpu)
-        if not self.sampled or not self.sys.enabled():
+        # Hub publication is subscriber-gated but PRE-SAMPLING: a live
+        # /trace watcher sees every span, sampled or not.
+        if not self.sys.enabled():
             return
         fields = dict(self.tags)
         if error:
@@ -242,8 +248,9 @@ def span(name: str, layer: str, sys: TraceSys | None = None, **tags):
     if parent is None and not tsys.enabled():
         return NOOP
     if parent is not None:
-        # Children inherit the root's sampling verdict (a _RemoteParent has
-        # no flag: the calling node already decided to trace this request).
+        # Children inherit the root's sampling verdict -- it records which
+        # traces the slow capture buffers (a _RemoteParent has no flag: the
+        # calling node already decided whether to buffer this request).
         return Span(
             name, layer, parent.trace_id, parent.span_id, tsys,
             sampled=getattr(parent, "sampled", True), **tags,
@@ -259,7 +266,8 @@ def root_span(name: str, layer: str, trace_id: str, sys: TraceSys | None = None,
     whole request tree (perf ledger + slow-request capture); publishing to
     the hub still costs nothing without subscribers. Under
     MTPU_TRACE_SAMPLE < 1, sampled-out roots skip slow-capture buffering
-    and hub publication but still feed the ledger."""
+    ONLY -- they still feed the ledger, the flight ring, and any live hub
+    subscriber."""
     tsys = sys or GLOBAL_TRACE
     sampled = _sample_next()
     if sampled:

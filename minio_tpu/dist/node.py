@@ -469,6 +469,7 @@ class Node:
         self.metrics.disk_heal = self.disk_heal
         self.metrics.memcache = self.memcache
         self.metrics.poolmgr = self.poolmgr
+        self.metrics.notifier = self.notifier
         # Rehydrate notification rules from persisted bucket metadata: the
         # notifier starts empty, and without this pass a restart silently
         # stops event delivery for every configured bucket until an
@@ -522,6 +523,22 @@ class Node:
         from ..control.profiler import GLOBAL_PROFILER
 
         GLOBAL_PROFILER.ensure_started()
+        # Arm the flight recorder's trigger engine (control/flight.py;
+        # MTPU_FLIGHT=0 vetoes -- tests default it off in conftest.py) and
+        # wire this node's identity + incident fanout into the process
+        # singleton. Last node registered wins: one node per process in
+        # production; in-process cluster peers still capture under their
+        # own tags via the flightcapture peer verb.
+        from ..control.flight import GLOBAL_FLIGHT
+
+        GLOBAL_FLIGHT.register_node(
+            self.url,
+            fanout=self.notification.flight_capture_all,
+            pool_status_fn=(
+                self.poolmgr.status if self.poolmgr is not None else None
+            ),
+        )
+        GLOBAL_FLIGHT.ensure_started()
         # Resume any drain the previous process left running (the leader
         # drives drains, like format orchestration; MTPU_POOL_RESUME=0
         # vetoes for surgical restarts).
@@ -708,11 +725,18 @@ class Node:
         lifetime."""
         for node in list(cls._live):
             node.close()
-        # The profiling plane is process-wide (not per-node), so it stops
-        # here -- after the last node -- rather than in close().
+        # The profiling and flight planes are process-wide (not per-node),
+        # so they stop here -- after the last node -- rather than in
+        # close(); buffering log targets flush for the same reason.
         from ..control.profiler import GLOBAL_PROFILER
 
         GLOBAL_PROFILER.stop()
+        from ..control.flight import GLOBAL_FLIGHT
+
+        GLOBAL_FLIGHT.stop()
+        from ..control.logging import GLOBAL_LOGGER
+
+        GLOBAL_LOGGER.close()
 
     def make_app(self) -> web.Application:
         """One aiohttp app: internode routers first, S3 catch-all last

@@ -194,6 +194,33 @@ def make_peer_app(node, token: str) -> web.Application:
 
         return {"timeseries": GLOBAL_PERF.timeseries.snapshot()}
 
+    # Flight-recorder plane (control/flight.py): an incident detected on
+    # any node broadcasts here so EVERY node freezes the same wall-clock
+    # window -- one correlated black-box dump per incident, not N skewed
+    # snapshots.
+
+    def h_flight_capture(a):
+        """Capture THIS node's bundle for the originator's incident (same
+        t0/t1 cluster-wide). Idempotent per (incident, node); also arms the
+        local cooldown so this node's own trigger won't re-open it."""
+        from ..control.flight import GLOBAL_FLIGHT
+
+        incident = a.get("incident", {}) or {}
+        return {"id": GLOBAL_FLIGHT.capture(incident, node=node.url)}
+
+    def h_flight_list(a):
+        """This node's bundle metas + recorder counters; the admin
+        /flight?cluster=1 endpoint merges peer lists."""
+        from ..control.flight import GLOBAL_FLIGHT
+
+        return {"bundles": GLOBAL_FLIGHT.list(), "stats": GLOBAL_FLIGHT.stats()}
+
+    def h_flight_get(a):
+        """One full bundle by id (or newest bundle of an incident id)."""
+        from ..control.flight import GLOBAL_FLIGHT
+
+        return {"bundle": GLOBAL_FLIGHT.get(str(a.get("id", "")))}
+
     # Per-node profiling (peer side of the admin start/download broadcast,
     # cmd/admin-handlers.go:511-716: every node profiles itself with a
     # whole-process sampler; the admin node collects one dump per node).
@@ -329,6 +356,9 @@ def make_peer_app(node, token: str) -> web.Application:
         "selftestobject": h_selftest_object,
         "netperfrun": h_netperf_run,
         "timeseries": h_timeseries,
+        "flightcapture": h_flight_capture,
+        "flightlist": h_flight_list,
+        "flightget": h_flight_get,
     }.items():
         app.router.add_post(f"/{name}", handler(fn))
     app.router.add_post("/listen", h_listen_stream)
@@ -422,6 +452,18 @@ class PeerClient:
     def timeseries_snapshot(self, timeout: float | None = None) -> dict:
         return self.client.call("/timeseries", {}, timeout=timeout) or {}
 
+    def flight_capture(self, incident: dict, timeout: float | None = None) -> dict:
+        """Ask the peer to capture ITS bundle for this incident's window."""
+        return self.client.call(
+            "/flightcapture", {"incident": incident}, timeout=timeout
+        ) or {}
+
+    def flight_list(self, timeout: float | None = None) -> dict:
+        return self.client.call("/flightlist", {}, timeout=timeout) or {}
+
+    def flight_get(self, bundle_id: str, timeout: float | None = None) -> dict:
+        return self.client.call("/flightget", {"id": bundle_id}, timeout=timeout) or {}
+
     def bandwidth(self, bucket: str = "") -> dict:
         return self.client.call("/bandwidth", {"bucket": bucket})
 
@@ -496,6 +538,12 @@ class NotificationSys:
         """Cluster-wide fault arm/disarm (the admin /chaos handlers call
         this after applying locally)."""
         self._fanout(lambda p, t: p.chaos(op, spec=spec, fault_id=fault_id, timeout=t))
+
+    def flight_capture_all(self, incident: dict) -> None:
+        """Incident broadcast (control/flight.py trigger/dump): every peer
+        captures its bundle for the SAME wall-clock window, so the cluster
+        yields one correlated dump per incident."""
+        self._fanout(lambda p, t: p.flight_capture(incident, timeout=t))
 
     def reload_bucket_meta_all(self, bucket: str = "") -> None:
         self._fanout(lambda p, t: p.reload_bucket_meta(bucket, timeout=t))

@@ -302,13 +302,26 @@ class ScenarioRunner:
             self.admin.reset_perf()
         except Exception:  # noqa: BLE001 - a live target may deny admin
             pass
+        if sc.flight is not None:
+            # Clear the recorder's cooldown/baselines so a trigger from a
+            # previous run in this process can't mute the fault window.
+            try:
+                self.admin.flight_reset()
+            except Exception:  # noqa: BLE001 - a live target may deny admin
+                pass
+        run_t0 = time.time()
+        # Wall-clock phase windows: the flight gate correlates bundle
+        # windows (wall clock, cluster-wide) against the faulted phase.
+        phase_windows: dict[str, tuple[float, float]] = {}
         results: list[PhaseResult] = []
         for phase in sc.phases:
             self._log(
                 f"phase {phase.name!r}: concurrency={phase.concurrency} "
                 + (f"ops={phase.ops}" if phase.ops else f"duration={phase.duration_s}s")
             )
+            w0 = time.time()
             results.append(self._run_phase(phase))
+            phase_windows[phase.name] = (w0, time.time())
         try:
             stage_breakdown = self.admin.stage_breakdown()
         except Exception:  # noqa: BLE001
@@ -330,6 +343,9 @@ class ScenarioRunner:
         pools_report = None
         if sc.pools_gate is not None:
             pools_report = self._await_drained(sc.pools_gate)
+        flight_report = None
+        if sc.flight is not None:
+            flight_report = self._await_flight(sc.flight, phase_windows, run_t0)
         from ..control.sanitizer import profile_if_armed
 
         report = build_report(
@@ -344,7 +360,66 @@ class ScenarioRunner:
         )
         if pools_report is not None:
             report["pools"] = pools_report
+        if flight_report is not None:
+            report["flight"] = flight_report
         return report
+
+    def _await_flight(self, gate: dict, windows: dict, run_t0: float) -> dict:
+        """Post-run flight gate: the faulted phase must have auto-captured a
+        diagnostic bundle on EVERY node whose window covers the fault, and no
+        bundle may have triggered outside it (a false alarm in a healthy
+        phase is as much a bug as a missed incident). Waits off the
+        measurement clock -- the trigger engine judges a second only after it
+        closes, so the fault phase's bundle can land just after it ends."""
+        phase = str(gate.get("phase", ""))
+        max_s = float(gate.get("max_wait_s", 15.0))
+        w0, w1 = windows.get(phase, (run_t0, run_t0))
+        grace = 3.0  # closed-second judging + poll cadence + fanout
+        expected = len(getattr(self.target, "urls", None) or []) or self.scenario.nodes
+        t_start = time.monotonic()
+        captured: list = []
+        false_triggers: list = []
+        nodes: set = set()
+        while True:
+            try:
+                metas = self.admin.flight_bundles()
+            except Exception:  # noqa: BLE001 - a live target may deny admin
+                metas = []
+            captured, false_triggers, nodes = [], [], set()
+            for m in metas:
+                win = m.get("window") or {}
+                t1 = float(win.get("t1", 0.0))
+                if t1 < run_t0:
+                    continue  # stale bundle from an earlier run
+                if w0 - 1.0 <= t1 <= w1 + grace:
+                    captured.append(m)
+                    nodes.add(m.get("node", ""))
+                else:
+                    false_triggers.append(m)
+            if len(nodes) >= expected or time.monotonic() - t_start >= max_s:
+                break
+            time.sleep(0.25)
+        ok = len(nodes) >= expected and not false_triggers
+        out = {
+            "phase": phase,
+            "window": [w0, w1],
+            "expected_nodes": expected,
+            "nodes_captured": sorted(nodes),
+            "bundles": captured,
+            "false_triggers": false_triggers,
+            "ok": ok,
+        }
+        if ok:
+            self._log(
+                f"flight gate: {len(captured)} bundle(s) across "
+                f"{len(nodes)}/{expected} nodes for phase {phase!r}"
+            )
+        else:
+            self._log(
+                f"flight gate FAILED: {len(nodes)}/{expected} nodes captured, "
+                f"{len(false_triggers)} false trigger(s)"
+            )
+        return out
 
     def _await_drained(self, gate: dict) -> dict:
         """Post-run pool gate: poll the pool-lifecycle status until every

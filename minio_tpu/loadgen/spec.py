@@ -111,6 +111,12 @@ class Scenario:
     cache: dict | None = None      # {"min_hit_ratio": r, "phase": name?}:
     #                                 judge the memcache hit ratio (of one
     #                                 phase's delta, or the whole run)
+    flight: dict | None = None     # {"phase": name, "max_wait_s": s}: gate
+    #                                 the run on the flight recorder -- the
+    #                                 named (faulted) phase must auto-capture
+    #                                 a bundle on EVERY node whose window
+    #                                 overlaps that phase, and the healthy
+    #                                 phases must produce none
     env: dict = field(default_factory=dict)  # env knobs the in-process
     #                                 cluster is built under (e.g.
     #                                 MTPU_MEMCACHE_MB); ignored for live
@@ -332,6 +338,17 @@ def parse_scenario(doc: dict) -> Scenario:
         if phase_name and phase_name not in names:
             raise SpecError("$.cache.phase", f"unknown phase {phase_name!r}")
         sc.cache = {"min_hit_ratio": float(ratio), "phase": phase_name}
+    fl = _require(doc, "$", "flight", dict, default=None)
+    if fl is not None:
+        phase_name = _require(fl, "$.flight", "phase", str, required=True)
+        if phase_name not in names:
+            raise SpecError("$.flight.phase", f"unknown phase {phase_name!r}")
+        sc.flight = {
+            "phase": phase_name,
+            "max_wait_s": float(
+                _number(fl, "$.flight", "max_wait_s", default=15.0, minimum=0)
+            ),
+        }
     if sc.compare is not None:
         # One block (dict, the historical shape) or a list of blocks (e.g.
         # a concurrency sweep asserting one ratio per rung).

@@ -285,6 +285,20 @@ class InProcessAdmin:
 
         return GLOBAL_PROFILER.summary()
 
+    # -- flight recorder ----------------------------------------------------
+
+    def flight_reset(self) -> None:
+        from ..control.flight import GLOBAL_FLIGHT
+
+        GLOBAL_FLIGHT.reset()
+
+    def flight_bundles(self) -> list:
+        """Bundle metas for EVERY node: the process-wide recorder stores one
+        bundle per node tag, so its list already is the cluster view."""
+        from ..control.flight import GLOBAL_FLIGHT
+
+        return GLOBAL_FLIGHT.list()
+
     # -- pool lifecycle ----------------------------------------------------
 
     def _poolmgr(self):
@@ -373,6 +387,17 @@ class EndpointAdmin:
 
     def profile_summary(self) -> dict:
         return self._get_json(ADMIN + "/profile", query=[("summary", "1")])
+
+    # -- flight recorder ----------------------------------------------------
+
+    def flight_bundles(self) -> list:
+        """Cluster-merged bundle metas (GET /flight?cluster=1 flattened)."""
+        doc = self._get_json(ADMIN + "/flight", query=[("cluster", "1")])
+        out = list(doc.get("bundles") or [])
+        for row in (doc.get("peers") or {}).values():
+            if isinstance(row, dict) and row.get("ok"):
+                out.extend(row.get("bundles") or [])
+        return out
 
     # -- pool lifecycle ----------------------------------------------------
 

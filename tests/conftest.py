@@ -31,6 +31,13 @@ os.environ.setdefault("MTPU_FSYNC", "never")
 # device codec) behind later tests' backs. Recovery tests set this per-test.
 os.environ.setdefault("MTPU_PROBE_RECOVERY_S", "0")
 
+# Flight-recorder trigger thread off by default: hundreds of tests build
+# throwaway nodes, and an armed SLO watcher would dump diagnostic bundles to
+# /tmp whenever a test intentionally provokes errors. The span ring and the
+# manual/fanout capture paths stay live; flight tests arm the thread
+# explicitly (tests/test_flight.py).
+os.environ.setdefault("MTPU_FLIGHT", "0")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -305,7 +305,12 @@ class TestTraceSampling:
 
         tracing._sample_counter = itertools.count()
 
-    def test_sampled_out_root_feeds_ledger_but_not_hub_or_slow_ring(self, monkeypatch):
+    def test_sampled_out_root_feeds_ledger_and_hub_but_not_slow_ring(self, monkeypatch):
+        """Sampling thins ONLY slow-capture buffering. The ledger (always-on
+        attribution), the live hub (/trace watchers), and the flight ring
+        (control/flight.py black box) all see sampled-out roots -- a thinned
+        trace stream must never blind the diagnostics that matter most
+        during an incident."""
         from minio_tpu.control.pubsub import TraceSys
 
         monkeypatch.setenv("MTPU_TRACE_SAMPLE", "0")
@@ -323,7 +328,8 @@ class TestTraceSampling:
         snap = perf.GLOBAL_PERF.ledger.snapshot()
         assert sum(snap["stages"]["samplelayer"]["op"]["counts"]) == 1
         assert sum(snap["stages"]["samplelayer"]["stage-b"]["counts"]) == 1
-        assert q.empty()  # nothing published to the hub
+        assert not q.empty()  # hub publication is PRE-sampling
+        # Slow-capture buffering is the only thing the verdict gates.
         assert perf.GLOBAL_PERF.slow.stats()["pending_traces"] == pending_before
 
     def test_rate_one_keeps_every_root(self, monkeypatch):

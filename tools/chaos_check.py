@@ -55,6 +55,17 @@ def main() -> int:
         )
         if proc.returncode != 0:
             return proc.returncode
+        # Flight-bundle gate (tools/flight_check.py --selftest): exercise
+        # the recorder's write -> schema-validate -> retention-prune cycle
+        # in a temp dir. Needs no pre-existing incident, so it runs (and
+        # means something) on every invocation.
+        proc = subprocess.run(
+            [sys.executable, os.path.join("tools", "flight_check.py"),
+             "--selftest"],
+            cwd=root,
+        )
+        if proc.returncode != 0:
+            return proc.returncode
         # Crash-consistency gate: enumerate every registered crash point,
         # kill at each, restart, verify the durability invariants
         # (tools/crashcheck.py). The full enumeration lives here; tier-1

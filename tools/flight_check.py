@@ -1,0 +1,187 @@
+"""Flight-bundle gate: validate diagnostic bundles against the schema and
+the retention invariants (the static half of the flight recorder's promise;
+the dynamic half -- triggers actually firing -- is tests/test_flight.py and
+the scenarios/flight_recorder.yaml loadgen gate).
+
+    python tools/flight_check.py <dir> [...]   # validate existing bundles
+    python tools/flight_check.py --selftest    # build a recorder in a temp
+                                               # dir, fire it past the
+                                               # retention cap, validate
+
+chaos_check --invariants runs the selftest leg: it needs no pre-existing
+incident, so CI exercises the write -> validate -> prune cycle
+deterministically on every run. Directory mode is the operator tool: point
+it at MTPU_FLIGHT_DIR after an incident and it vouches for (or indicts)
+every bundle on disk before anyone reads numbers out of them.
+
+Exit status: 0 all bundles valid (or nothing to check), 1 violations found.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_REQUIRED_KEYS = (
+    "flight_bundle", "id", "incident", "node", "reason", "window",
+    "captured_at", "spans", "timeseries", "ledger", "degrade",
+)
+
+
+def check_bundle(doc, where: str) -> list[str]:
+    """Schema violations in one decoded bundle document."""
+    from minio_tpu.control.flight import BUNDLE_SCHEMA, TRIGGER_KINDS, _safe_tag
+
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return [f"{where}: bundle is not an object"]
+    for k in _REQUIRED_KEYS:
+        if k not in doc:
+            problems.append(f"{where}: missing key {k!r}")
+    if problems:
+        return problems
+    if doc["flight_bundle"] != BUNDLE_SCHEMA:
+        problems.append(
+            f"{where}: schema {doc['flight_bundle']!r} != {BUNDLE_SCHEMA}"
+        )
+    if doc["reason"] not in TRIGGER_KINDS:
+        problems.append(f"{where}: unknown trigger reason {doc['reason']!r}")
+    expect_id = f"{doc['incident']}__{_safe_tag(str(doc['node']))}"
+    if doc["id"] != expect_id:
+        problems.append(f"{where}: id {doc['id']!r} != {expect_id!r}")
+    win = doc["window"]
+    if not isinstance(win, dict) or "t0" not in win or "t1" not in win:
+        problems.append(f"{where}: window needs t0/t1")
+        return problems
+    t0, t1 = float(win["t0"]), float(win["t1"])
+    if not t0 < t1:
+        problems.append(f"{where}: window t0 {t0} !< t1 {t1}")
+    if float(doc["captured_at"]) + 1.0 < t1:
+        problems.append(f"{where}: captured_at predates the window end")
+    if not isinstance(doc["spans"], list):
+        problems.append(f"{where}: spans must be a list")
+    else:
+        for i, s in enumerate(doc["spans"]):
+            if not isinstance(s, dict) or not {"t", "name", "duration_ms"} <= set(s):
+                problems.append(f"{where}: spans[{i}] malformed")
+                break
+            if not t0 <= s["t"] <= t1:
+                problems.append(
+                    f"{where}: spans[{i}].t {s['t']} outside window [{t0}, {t1}]"
+                )
+                break
+    ts = doc["timeseries"]
+    for sec in ts.get("series", []) if isinstance(ts, dict) else []:
+        st = sec.get("t")
+        # The bundle keeps the window's seconds plus one leading second
+        # (the ring bucket a window edge lands inside).
+        if st is not None and not t0 - 1 <= st <= t1:
+            problems.append(
+                f"{where}: timeseries second {st} outside window [{t0}, {t1}]"
+            )
+            break
+    return problems
+
+
+def check_dir(path: str, retain: int | None = None) -> list[str]:
+    """Schema problems for every bundle in a directory, plus the retention
+    invariant: at most `retain` bundles PER NODE TAG may exist."""
+    if retain is None:
+        try:
+            retain = int(os.environ.get("MTPU_FLIGHT_RETAIN", "16"))
+        except ValueError:
+            retain = 16
+    try:
+        names = sorted(
+            n for n in os.listdir(path)
+            if n.startswith("flight-") and n.endswith(".json")
+        )
+    except OSError as e:
+        return [f"{path}: unreadable ({e})"]
+    problems: list[str] = []
+    per_node: dict[str, int] = {}
+    for n in names:
+        where = os.path.join(path, n)
+        try:
+            with open(where) as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            problems.append(f"{where}: unreadable bundle ({e})")
+            continue
+        problems.extend(check_bundle(doc, where))
+        if isinstance(doc, dict) and "node" in doc:
+            tag = str(doc["node"])
+            per_node[tag] = per_node.get(tag, 0) + 1
+    for tag, count in sorted(per_node.items()):
+        if count > retain:
+            problems.append(
+                f"{path}: node {tag!r} holds {count} bundles > retain cap {retain}"
+            )
+    return problems
+
+
+def selftest() -> int:
+    """Deterministic write -> validate -> prune cycle in a temp dir: no
+    pre-existing incident needed, so the CI leg always exercises the code."""
+    import tempfile
+
+    from minio_tpu.control.degrade import DegradeStats
+    from minio_tpu.control.flight import FlightRecorder
+    from minio_tpu.control.perf import PerfSys
+
+    retain = 3
+    with tempfile.TemporaryDirectory(prefix="mtpu-flight-check-") as td:
+        fr = FlightRecorder(
+            dir=td, retain=retain, window_s=5.0, cooldown_s=0.0,
+            perf=PerfSys(), degrade=DegradeStats(),
+        )
+        # Feed the ring so bundles carry spans, then fire past the cap.
+        class _Span:
+            name = "s3.GetObject"
+            layer = "api"
+            trace_id = "t-selftest"
+
+        for _ in range(4):
+            fr.record_span(_Span(), 0.012)
+        for i in range(retain + 2):
+            fr.trigger("manual", detail={"via": "flight_check", "i": i},
+                       fan_out=False)
+        problems = check_dir(td, retain=retain)
+        written = len([n for n in os.listdir(td) if n.endswith(".json")])
+        if written != retain:
+            problems.append(
+                f"selftest: {written} bundles on disk != retain cap {retain}"
+            )
+        if fr.stats()["bundles_pruned"] != 2:
+            problems.append(
+                f"selftest: pruned {fr.stats()['bundles_pruned']} != 2"
+            )
+        for p in problems:
+            print(f"flight_check: {p}", file=sys.stderr)
+        if not problems:
+            print(f"flight_check: selftest ok ({written} bundles, cap {retain})")
+        return 1 if problems else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    if "--selftest" in args:
+        return selftest()
+    if not args:
+        print(__doc__.strip(), file=sys.stderr)
+        return 2
+    problems: list[str] = []
+    for d in args:
+        problems.extend(check_dir(d))
+    for p in problems:
+        print(f"flight_check: {p}", file=sys.stderr)
+    if not problems:
+        print(f"flight_check: ok ({len(args)} dir(s))")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

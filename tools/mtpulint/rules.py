@@ -34,6 +34,9 @@ PROFILER = "minio_tpu/control/profiler.py"
 SELFTEST = "minio_tpu/control/selftest.py"
 POOLMGR = "minio_tpu/object/poolmgr.py"
 REBALANCE = "minio_tpu/control/rebalance.py"
+FLIGHT = "minio_tpu/control/flight.py"
+LOGGING = "minio_tpu/control/logging.py"
+PUBSUB = "minio_tpu/control/pubsub.py"
 
 
 def _call_name(node: ast.Call) -> str:
@@ -545,17 +548,21 @@ class MetricsRenderedRule(Rule):
     A counter nobody exports is a measurement nobody sees: the increment
     costs a lock on the hot path and buys zero observability. Every public
     `self.<name> += ...` / keyed-dict bump in DegradeStats,
-    SlowRequestCapture, the profiling plane's CopyLedger, and the
-    self-measurement plane's SelfTestStats must appear (as a string key or
-    attribute) in the exposition renderer."""
+    SlowRequestCapture, the profiling plane's CopyLedger, the
+    self-measurement plane's SelfTestStats, the flight recorder, the
+    pub/sub hubs' drop accounting, and the webhook log sink's queue
+    counters must appear (as a string key or attribute) in the exposition
+    renderer."""
 
     id = "metrics-rendered"
     title = "counter incremented but never rendered in control/metrics.py"
-    scope = (DEGRADE, PERF, PROFILER, SELFTEST, POOLMGR, REBALANCE)
+    scope = (DEGRADE, PERF, PROFILER, SELFTEST, POOLMGR, REBALANCE, FLIGHT,
+             LOGGING, PUBSUB)
 
     _COUNTER_CLASSES = {
         "DegradeStats", "SlowRequestCapture", "CopyLedger", "SelfTestStats",
-        "PoolLifecycleStats", "ThrottleBudget",
+        "PoolLifecycleStats", "ThrottleBudget", "FlightRecorder", "PubSub",
+        "WebhookTarget",
     }
 
     def _counters(self, ctx) -> list[tuple[str, int]]:
