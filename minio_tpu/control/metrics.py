@@ -208,6 +208,7 @@ class MetricsSys:
         self._render_crash(metric)
         self._render_degrade(metric)
         self._render_san(metric)
+        self._render_bufsan(metric)
         self._render_memcache(metric)
         self._render_pools(metric)
         self._render_timeseries(metric)
@@ -859,6 +860,37 @@ class MetricsSys:
             metric("minio_tpu_san_lock_wait_seconds_total",
                    st["wait_s"], {"lock": name},
                    help_="Cumulative time spent waiting to acquire, by lock class.")
+
+    def _render_bufsan(self, metric) -> None:
+        """Buffer-lifetime sanitizer plane (control/bufsan.py). Emitted only
+        when the process runs armed (MTPU_BUFSAN=1) -- a production node
+        never pays for, or exposes, these series."""
+        from ..control import bufsan
+
+        if not bufsan.armed():
+            return
+        rep = bufsan.GLOBAL_BUFSAN.report()
+        by_rule: dict[str, int] = {}
+        for f in rep["findings"]:
+            by_rule[f["rule"]] = by_rule.get(f["rule"], 0) + 1
+        for rule, n in sorted(by_rule.items()):
+            metric("minio_tpu_bufsan_findings_total", n, {"rule": rule},
+                   help_="Buffer-lifetime findings recorded this process, by rule.")
+        c = rep["counters"]
+        metric("minio_tpu_bufsan_acquires_total", c["acquires"],
+               help_="Sanitized pool acquisitions tracked.")
+        metric("minio_tpu_bufsan_views_total", c["views"],
+               help_="Sanitized view() exports tracked.")
+        metric("minio_tpu_bufsan_sentinel_fills_total", c["sentinel_fills"],
+               help_="Free-list storages sentinel-poisoned on recycle.")
+        metric("minio_tpu_bufsan_sentinel_checks_total", c["sentinel_checks"],
+               help_="Sentinel verifications run on re-acquire.")
+        metric("minio_tpu_bufsan_poisoned_free_buffers", c["poisoned_free"],
+               help_="Free-list storages currently carrying a sentinel.",
+               type_="gauge")
+        metric("minio_tpu_bufsan_live_handles", c["live_handles"],
+               help_="PooledBuffer handles currently tracked live.",
+               type_="gauge")
 
     def _render_memcache(self, metric) -> None:
         """Hot-read memory cache tier (object/memcache.py). Absent when the

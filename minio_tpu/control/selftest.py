@@ -492,8 +492,8 @@ def netperf_row(peers: list, size: int | None = None, rounds: int = 4) -> dict:
     size = size if size else _env_int("MTPU_SELFTEST_SIZE", 1 << 20)
     row: dict[str, dict] = {}
     pb = _acquire_net_buf(size)
+    payload = pb.view(0, size)
     try:
-        payload = pb.view(0, size)
         for p in peers:
             with tracing.span("net-probe", "selftest", peer=p.url):
                 try:
@@ -520,6 +520,10 @@ def netperf_row(peers: list, size: int | None = None, rounds: int = 4) -> dict:
                     row[p.url] = {"ok": False,
                                   "error": f"{type(e).__name__}: {e}"}
     finally:
+        # Invalidate the probe view before the storage recycles -- this
+        # frame (pinned by any in-flight traceback) must not keep a live
+        # export over another probe's buffer.
+        payload.release()
         pb.release()
     return row
 

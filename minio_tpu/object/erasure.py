@@ -23,6 +23,7 @@ import hashlib
 import io
 import os
 import queue as _queue
+import sys
 import threading
 import time
 import uuid
@@ -118,25 +119,51 @@ def _rank_read_slots(by_shard: list, k: int) -> list[int]:
 WINDOW_BYTES = GROUP_BLOCKS * BLOCK_SIZE
 
 
+def _quiet_release(*views) -> None:
+    """Best-effort memoryview invalidation before pooled storage recycles.
+
+    A stale view over a recycled buffer silently reads another request's
+    bytes (bufsan: view-outlives-buffer), so owners invalidate their
+    exports at release. A view something re-exported (a live
+    np.frombuffer, a nested memoryview) refuses release() -- that one is
+    left alive for the runtime sanitizer to flag rather than crashing
+    the data path."""
+    for v in views:
+        if isinstance(v, memoryview):
+            try:
+                v.release()
+            except ValueError:
+                pass
+
+
 class _Window:
     """One pipeline window: a memoryview over the caller's buffer or over a
     pooled bytearray; release() recycles the latter."""
 
-    __slots__ = ("view", "_pb")
+    __slots__ = ("view", "_pb", "_blocks")
 
     def __init__(self, view: memoryview, pb=None):
         self.view = view
         self._pb = pb
+        self._blocks: list[memoryview] | None = None
 
     def __len__(self) -> int:
         return len(self.view)
 
     def blocks(self) -> list[memoryview]:
         v = self.view
-        return [v[off : off + BLOCK_SIZE] for off in range(0, len(v), BLOCK_SIZE)]
+        out = [v[off : off + BLOCK_SIZE] for off in range(0, len(v), BLOCK_SIZE)]
+        if self._pb is not None:
+            self._blocks = out
+        return out
 
     def release(self) -> None:
         if self._pb is not None:
+            # Invalidate this window's exports BEFORE the storage returns
+            # to the pool -- the encoder copied what it needed, so a view
+            # that survives past here is a lifetime bug, not a reader.
+            _quiet_release(*(self._blocks or ()), self.view)
+            self._blocks = None
             self._pb.release()
             self._pb = None
 
@@ -194,7 +221,10 @@ def _stream_windows(reader, pool, pb, filled: int) -> Iterator[_Window]:
 
     Ownership: each yielded _Window owns its pooled buffer (consumer
     releases); a buffer the generator still holds when it exits -- EOF or
-    close() -- is released here, so abandoned PUTs leak nothing."""
+    close() -- is released here, so abandoned PUTs leak nothing. The fill
+    view is named so a reader failure can invalidate it before the
+    finally recycles the storage (the traceback pins this frame)."""
+    mv = None
     try:
         while True:
             win = _Window(pb.view(0, filled), pb)
@@ -203,12 +233,21 @@ def _stream_windows(reader, pool, pb, filled: int) -> Iterator[_Window]:
             if filled < WINDOW_BYTES:
                 return  # EOF landed inside the last fill
             pb = pool.acquire()
-            filled = _fill_window(reader, pb.view())
+            mv = pb.view()
+            filled = _fill_window(reader, mv)
+            _quiet_release(mv)
+            mv = None
             if filled == 0:
                 return  # payload was an exact window multiple
     finally:
         if pb is not None:
-            pb.release()
+            _quiet_release(mv)
+            if sys.exc_info()[0] is not None:
+                # Reader raised mid-fill: its traceback may pin slices of
+                # the fill view in frames this code cannot reach.
+                pb.discard()
+            else:
+                pb.release()
 
 
 class _ReadaheadWindows:
@@ -283,8 +322,9 @@ class _WindowBufs:
     thread (hedged stragglers finish after the gather loop exits); the
     registry owns every buffer a window's reads produce and releases them
     all once the window's chunks have been consumed. add() after close()
-    releases immediately -- a straggler that completes late recycles its
-    buffer instead of leaking it (its result is discarded anyway)."""
+    returns False -- a straggler that completes late still owns its
+    buffer and must recycle it after dropping its own views (its result
+    is discarded anyway)."""
 
     __slots__ = ("_lock", "_bufs", "_closed")
 
@@ -293,12 +333,12 @@ class _WindowBufs:
         self._bufs: list = []
         self._closed = False
 
-    def add(self, pb) -> None:
+    def add(self, pb) -> bool:
         with self._lock:
             if not self._closed:
                 self._bufs.append(pb)
-                return
-        pb.release()
+                return True
+        return False
 
     def close(self) -> None:
         with self._lock:
@@ -307,7 +347,11 @@ class _WindowBufs:
             self._closed = True
             bufs, self._bufs = self._bufs, []
         for pb in bufs:
-            pb.release()
+            # The stream contract lets the consumer keep yielded chunk
+            # views past the stream itself: a buffer still exported here
+            # is demoted to a discard (allocator-owned, never repooled)
+            # instead of recycling under the holder's feet.
+            pb.release_or_discard()
 
 
 def _block_pieces(rows, chunk: int, s: int, e: int):
@@ -401,11 +445,17 @@ def data_windows(data) -> "Iterator[_Window]":
     if hasattr(data, "read") or hasattr(data, "readinto"):
         pool = bufpool.window_pool()
         pb = pool.acquire()
+        mv = pb.view()
         try:
-            filled = _fill_window(data, pb.view())
+            filled = _fill_window(data, mv)
         except BaseException:
-            pb.release()
+            # The propagating traceback pins the reader's frames, which may
+            # hold slices of `mv` this code cannot reach -- discard the
+            # storage instead of recycling it (bufsan: view-outlives-buffer).
+            _quiet_release(mv)
+            pb.discard()
             raise
+        _quiet_release(mv)
         return _wrap_readahead(_stream_windows(data, pool, pb, filled))
     raise TypeError(f"put data must be bytes or a reader, got {type(data)!r}")
 
@@ -1041,11 +1091,17 @@ class ErasureObjects:
             elif hasattr(data, "read") or hasattr(data, "readinto"):
                 pool = bufpool.window_pool()
                 pb = pool.acquire()
+                mv = pb.view()
                 try:
-                    filled = _fill_window(data, pb.view())
+                    filled = _fill_window(data, mv)
                 except BaseException:
-                    pb.release()
+                    # The propagating traceback pins the reader's frames,
+                    # which may hold slices of `mv` this code cannot reach
+                    # -- discard the storage instead of recycling it.
+                    _quiet_release(mv)
+                    pb.discard()
                     raise
+                _quiet_release(mv)
                 if filled < SMALL_FILE_THRESHOLD and not wants_whole:
                     head = bytes(pb.view(0, filled))  # mtpulint: disable=hot-path-copy -- sub-threshold inline blob outlives the pooled window
                     pb.release()
@@ -1601,8 +1657,17 @@ class ErasureObjects:
                         time.perf_counter() - t_fp, time.thread_time() - c_fp,
                     )
                     if pb is not None:
-                        bufs.add(pb)
-                        pb = None
+                        if bufs.add(pb):
+                            pb = None
+                            return parsed, oks
+                        # Hedged straggler: the window was consumed and
+                        # its registry closed while this read was in
+                        # flight. The result is discarded, so drop this
+                        # frame's exports first; the finally recycles pb.
+                        for d, c in parsed:
+                            _quiet_release(d, c)
+                        _quiet_release(blob)
+                        return None
                     return parsed, oks
                 except (errors.DiskError, errors.FileCorrupt):
                     return None
@@ -1713,7 +1778,20 @@ class ErasureObjects:
                     except BaseException:
                         bufs.close()
                         raise
-                    yield chunks, bufs.close
+
+                    def unit_close(chunks=chunks, futures=futures, bufs=bufs):
+                        # Drop the refs this pipeline owns before the
+                        # buffers recycle: straggler futures pin their
+                        # (parsed, oks) rows and the registry list pins
+                        # unconsumed chunks (bufsan: view-outlives-buffer).
+                        # Views already yielded to the consumer are NOT
+                        # invalidated -- bufs.close() demotes any buffer
+                        # they still export to a discard.
+                        futures.clear()
+                        del chunks[:]
+                        bufs.close()
+
+                    yield chunks, unit_close
             finally:
                 if pending is not None:
                     # Consumer abandoned the stream with a prefetched window
@@ -1727,11 +1805,14 @@ class ErasureObjects:
         try:
             for chunks, close in it:
                 try:
-                    for c in chunks:
-                        yield c
+                    # pop() so this frame never pins a yielded view: by the
+                    # time close() runs, only the consumer's own references
+                    # (if any) keep a chunk's storage exported.
+                    while chunks:
+                        yield chunks.pop(0)
                 finally:
                     # Runs when the consumer asks past the window's last
-                    # chunk (it is done with the views) or tears down.
+                    # chunk or tears down mid-window.
                     close()
         finally:
             closer = getattr(it, "close", None)

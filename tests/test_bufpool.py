@@ -87,6 +87,136 @@ class TestBufferPool:
         assert bufpool.window_pool() is bufpool.window_pool()
         assert bufpool.window_pool().buf_size == bufpool.WINDOW_BYTES
 
+    def test_discard_never_repools_storage(self):
+        pool = BufferPool(buf_size=8, capacity=2)
+        pb = pool.acquire()
+        storage = pb.data
+        pb.discard()
+        assert pool.outstanding() == 0
+        assert pool.stats()["free"] == 0  # storage went to the allocator
+        assert pool.stats()["discards"] == 1
+        pb2 = pool.acquire()
+        assert pb2.data is not storage
+        pb2.release()
+
+    def test_discard_after_release_still_raises(self):
+        pool = BufferPool(buf_size=8, capacity=1)
+        pb = pool.acquire()
+        pb.release()
+        with pytest.raises(RuntimeError):
+            pb.discard()
+
+    def test_release_or_discard_repools_when_unexported(self):
+        pool = BufferPool(buf_size=8, capacity=2)
+        pb = pool.acquire()
+        mv = pb.view(0, 4)
+        mv.release()
+        pb.release_or_discard()
+        assert pool.stats()["free"] == 1
+        assert pool.stats()["discards"] == 0
+
+    def test_release_or_discard_demotes_when_exported(self):
+        # The GET stream contract: a consumer that kept a yielded chunk
+        # must keep reading ITS bytes -- the storage leaves the pool
+        # instead of recycling under the view.
+        pool = BufferPool(buf_size=8, capacity=2)
+        pb = pool.acquire()
+        held = pb.view(0, 4)
+        held[:4] = b"mine"
+        pb.release_or_discard()
+        assert pool.stats()["free"] == 0
+        assert pool.stats()["discards"] == 1
+        assert bytes(held) == b"mine"  # still valid, never reused
+        pb2 = pool.acquire()
+        pb2.view()[:4] = b"XXXX"  # a new request cannot corrupt the holder
+        assert bytes(held) == b"mine"
+        pb2.release()
+        held.release()
+
+
+class TestViewBounds:
+    """PooledBuffer.view() bounds: after the last release poisons the
+    storage to 0 bytes, an out-of-range slice must raise -- a silently
+    empty view would mask exactly the use-after-release that the
+    poisoning exists to surface."""
+
+    def test_view_beyond_storage_raises_on_live_buffer(self):
+        pool = BufferPool(buf_size=8, capacity=1)
+        pb = pool.acquire()
+        try:
+            with pytest.raises(ValueError):
+                pb.view(0, 9)
+            with pytest.raises(ValueError):
+                pb.view(9, 12)
+        finally:
+            pb.release()
+
+    def test_view_with_negative_or_inverted_bounds_raises(self):
+        pool = BufferPool(buf_size=8, capacity=1)
+        pb = pool.acquire()
+        try:
+            with pytest.raises(ValueError):
+                pb.view(-1, 4)
+            with pytest.raises(ValueError):
+                pb.view(5, 2)
+        finally:
+            pb.release()
+
+    def test_sized_view_after_release_raises_not_empty(self):
+        pool = BufferPool(buf_size=8, capacity=1)
+        pb = pool.acquire()
+        pb.release()
+        # The poisoned handle has 0-byte storage: asking for the bytes the
+        # buffer USED to hold must fail loudly, not hand back b"".
+        with pytest.raises(ValueError):
+            pb.view(0, 8)
+        # The no-argument probe form stays: len()==0 is the poison signal.
+        assert len(pb.view()) == 0
+
+
+@pytest.mark.race
+class TestOverflowAccountingRace:
+    """The ISSUE flags overflow counters bumped outside the pool lock; the
+    accounting lives INSIDE acquire()'s critical section (see bufpool),
+    and this pins it: a barrier-synchronized burst where every thread
+    acquires before any release must count exactly max(0, T - capacity)
+    overflow allocations -- lost increments under-count, double bumps
+    over-count, and either fails the exact equality."""
+
+    def test_barrier_burst_counts_overflow_exactly(self):
+        capacity, threads = 4, 16
+        pool = BufferPool(buf_size=32, capacity=capacity)
+        start = threading.Barrier(threads)
+        acquired = threading.Barrier(threads)
+        errors: list[BaseException] = []
+
+        def worker():
+            try:
+                start.wait(5)
+                pb = pool.acquire()
+                acquired.wait(5)  # hold until EVERY thread has acquired
+                pb.release()
+            except BaseException as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        ts = [threading.Thread(target=worker) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert not errors
+        stats = pool.stats()
+        assert stats["gets"] == threads
+        assert stats["overflow_allocs"] == threads - capacity
+        assert stats["outstanding"] == 0
+        # Repeat rounds reuse the (now-warm) free list and must not drift
+        # the overflow count: the free list never exceeds capacity.
+        for _ in range(3):
+            pbs = [pool.acquire() for _ in range(capacity)]
+            for pb in pbs:
+                pb.release()
+        assert pool.stats()["overflow_allocs"] == threads - capacity
+
 
 class TestLanePool:
     def test_per_lane_fifo_order(self):

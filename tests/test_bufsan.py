@@ -1,0 +1,246 @@
+"""bufsan acceptance: the same seeded buffer-lifetime bugs are caught by
+BOTH halves of the sanitizer -- the static mtpulint dataflow rules
+(view-escape & friends over the AST) and the runtime MTPU_BUFSAN
+detectors (sentinel poisoning, export probes, weakref leak tracking).
+
+The static half lints tiny synthetic trees (the test_lint.py idiom); the
+runtime half arms a private BufSanitizer instance against real BufferPool
+traffic, so the bufpool hooks -- note_acquire / note_view / note_recycle /
+note_double_release -- are exercised exactly as MTPU_BUFSAN=1 wires them.
+"""
+
+from __future__ import annotations
+
+import gc
+import importlib.util
+import json
+import textwrap
+from pathlib import Path
+
+from tools.mtpulint import lint_tree
+from tools.mtpulint.rules import ReleaseOnAllPathsRule, ViewEscapeRule
+
+from minio_tpu.control import bufsan
+from minio_tpu.utils.bufpool import BufferPool
+
+_REPO = Path(__file__).resolve().parent.parent
+_LINT_PATH = _REPO / "tools" / "metrics_lint.py"
+_spec = importlib.util.spec_from_file_location("metrics_lint", _LINT_PATH)
+metrics_lint = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(metrics_lint)
+
+
+def _lint(tmp_path, src: str, rule) -> list:
+    p = tmp_path / "minio_tpu" / "api" / "seeded.py"
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src).lstrip("\n"))
+    return lint_tree(str(tmp_path), ["minio_tpu"], [rule])
+
+
+class _Armed:
+    """Arm a fresh sanitizer for one test; always disarm."""
+
+    def __enter__(self) -> bufsan.BufSanitizer:
+        self.san = bufsan.BufSanitizer()
+        bufsan.arm(self.san)
+        return self.san
+
+    def __exit__(self, *exc) -> None:
+        bufsan.disarm()
+
+
+def _rules(san: bufsan.BufSanitizer) -> list[str]:
+    return [f["rule"] for f in san.findings]
+
+
+# -- seeded bug #1: view escapes the buffer's lifetime ------------------------
+
+
+SEEDED_VIEW_ESCAPE = """
+    def stash(self, pool):
+        pb = pool.acquire()
+        try:
+            self.cache = pb.view(0, 128)
+        finally:
+            pb.release()
+"""
+
+
+def test_seeded_view_escape_caught_by_static_rule(tmp_path):
+    findings = _lint(tmp_path, SEEDED_VIEW_ESCAPE, ViewEscapeRule())
+    assert [f.rule for f in findings] == ["view-escape"]
+    assert "retain()" in findings[0].message
+
+
+def test_seeded_view_escape_caught_by_runtime_probe():
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pb = pool.acquire()
+        stashed = pb.view(0, 16)  # escapes: still alive at the release
+        pb.release()
+        assert "view-outlives-buffer" in _rules(san)
+        (finding,) = [f for f in san.findings
+                      if f["rule"] == "view-outlives-buffer"]
+        # The finding names the acquisition site (this test file), so a
+        # triager can jump straight to the leak.
+        assert "test_bufsan.py" in finding["site"]
+    stashed.release()
+
+
+def test_runtime_probe_quiet_when_views_die_first():
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pb = pool.acquire()
+        mv = pb.view(0, 16)
+        mv[:4] = b"abcd"
+        mv.release()
+        pb.release()
+        assert _rules(san) == []
+
+
+def test_runtime_probe_quiet_for_discarded_storage():
+    # discard() exists exactly so exception paths can hand traceback-pinned
+    # views to the allocator instead of the free list: no recycle, no
+    # corruption window, no finding.
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pb = pool.acquire()
+        pinned = pb.view(0, 16)
+        pb.discard()
+        assert _rules(san) == []
+    assert len(pinned) == 16  # the allocator keeps the bytes alive
+
+
+# -- seeded bug #2: write-after-release ---------------------------------------
+
+
+SEEDED_STRAIGHT_LINE_RELEASE = """
+    def fill(pool, reader):
+        pb = pool.acquire()
+        n = reader.readinto(pb.view())
+        pb.release()
+        return n
+"""
+
+
+def test_seeded_straight_line_release_caught_by_static_rule(tmp_path):
+    # The static half of the write-after-release story: a release with no
+    # exception-edge coverage is how a buffer ends up recycled while the
+    # raising frame still writes into it.
+    findings = _lint(
+        tmp_path, SEEDED_STRAIGHT_LINE_RELEASE, ReleaseOnAllPathsRule()
+    )
+    assert [f.rule for f in findings] == ["release-on-all-paths"]
+    assert "straight-line" in findings[0].message
+
+
+def test_seeded_write_after_release_caught_by_sentinel():
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pb = pool.acquire()
+        storage = pb.data  # the bug: a raw handle kept past the release
+        pb.release()  # storage recycles; bufsan sentinel-poisons it
+        storage[5] = 0x7F  # stale write lands in pooled memory
+        pool.acquire()  # re-acquire verifies the sentinel
+        assert "write-after-release" in _rules(san)
+        (finding,) = [f for f in san.findings
+                      if f["rule"] == "write-after-release"]
+        assert "byte 5" in finding["message"]
+
+
+def test_sentinel_quiet_on_clean_reuse():
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pool.acquire().release()
+        pb = pool.acquire()
+        assert _rules(san) == []
+        assert san.counters["sentinel_checks"] == 1
+        pb.release()
+
+
+# -- the remaining runtime detectors ------------------------------------------
+
+
+def test_double_release_recorded_before_raise():
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pb = pool.acquire()
+        pb.release()
+        try:
+            pb.release()
+        except RuntimeError:
+            pass
+        assert "double-release" in _rules(san)
+
+
+def test_buffer_leak_reported_for_collected_unreleased_handle():
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pool.acquire()  # dropped without release()
+        gc.collect()
+        assert "buffer-leak" in _rules(san)
+
+
+def test_teardown_check_flags_still_live_unreleased_handles():
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pb = pool.acquire()
+        san.teardown_check()
+        assert "buffer-leak" in _rules(san)
+        pb.release()
+
+
+def test_report_artifact_round_trips(tmp_path):
+    out = tmp_path / "bufsan.json"
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pb = pool.acquire()
+        leaked = pb.view(0, 8)
+        pb.release()
+        san.write_report(str(out))
+    rep = json.loads(out.read_text())
+    assert rep["bufsan"] == 1
+    assert rep["counters"]["acquires"] == 1
+    assert [f["rule"] for f in rep["findings"]] == ["view-outlives-buffer"]
+    assert rep["unsuppressed"] == 1
+    leaked.release()
+
+
+# -- metrics exposition (armed only) ------------------------------------------
+
+
+def test_bufsan_metrics_rendered_when_armed_and_lint_clean():
+    from minio_tpu.control.metrics import MetricsSys
+
+    pool = BufferPool(buf_size=64, capacity=2)
+    with _Armed() as san:
+        pool.acquire().release()
+        san.add_finding("view-outlives-buffer", "x.py:1", "m")
+        text = MetricsSys().render_node()
+        assert "minio_tpu_bufsan_acquires_total 1" in text
+        assert "minio_tpu_bufsan_sentinel_fills_total 1" in text
+        assert ('minio_tpu_bufsan_findings_total'
+                '{rule="view-outlives-buffer"} 1') in text
+        assert metrics_lint.validate_exposition(text) == []
+        assert metrics_lint.lint_exposition(text) == []
+
+
+def test_bufsan_metrics_absent_when_disarmed():
+    from minio_tpu.control.metrics import MetricsSys
+
+    bufsan.disarm()
+    text = MetricsSys().render_node()
+    assert "minio_tpu_bufsan_" not in text
+    assert metrics_lint.validate_exposition(text) == []
+
+
+def test_disarmed_pool_records_nothing():
+    san = bufsan.arm(bufsan.BufSanitizer())
+    bufsan.disarm()
+    assert bufsan.ACTIVE is None
+    pool = BufferPool(buf_size=64, capacity=2)
+    pb = pool.acquire()
+    pb.view(0, 8)
+    pb.release()
+    assert san.counters["acquires"] == 0
+    assert san.findings == []

@@ -19,11 +19,14 @@ from tools.mtpulint import (
 from tools.mtpulint.rules import (
     CondWaitLoopRule,
     DeadlineRebindRule,
+    DoubleReleaseRule,
     HotPathCopyRule,
+    InterfaceConformanceRule,
     LockBlockingIORule,
     LockOrderRule,
     MetricsRenderedRule,
     RawTransportRule,
+    ReleaseOnAllPathsRule,
     ResourceLeakRule,
     SharedPublishRule,
     StageKeyRule,
@@ -32,6 +35,7 @@ from tools.mtpulint.rules import (
     UnjoinedThreadRule,
     UnlockedGlobalRule,
     UnsyncedCommitRule,
+    ViewEscapeRule,
 )
 
 
@@ -1003,4 +1007,308 @@ def test_unsynced_commit_scoped_and_suppressible(tmp_path):
                 os.replace(p + ".tmp", p)
         """,
     }, UnsyncedCommitRule())
+    assert findings == []
+
+
+# -- release-on-all-paths -----------------------------------------------------
+
+
+def test_release_on_all_paths_fires_when_never_released(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, data):
+                pb = pool.acquire()
+                fill(data, pb.view())
+        """,
+    }, ReleaseOnAllPathsRule())
+    assert [f.rule for f in findings] == ["release-on-all-paths"]
+    assert "never released" in findings[0].message
+    assert findings[0].line == 2
+
+
+def test_release_on_all_paths_fires_on_straight_line_release(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, data):
+                pb = pool.acquire()
+                fill(data, pb.view())  # a raise here leaks the window
+                pb.release()
+        """,
+    }, ReleaseOnAllPathsRule())
+    assert len(findings) == 1
+    assert "straight-line" in findings[0].message
+
+
+def test_release_on_all_paths_quiet_with_finally_or_handler(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, data):
+                pb = pool.acquire()
+                try:
+                    fill(data, pb.view())
+                finally:
+                    pb.release()
+
+            def g(pool, data):
+                pb = pool.acquire()
+                try:
+                    filled = fill(data, pb.view())
+                except BaseException:
+                    pb.release()
+                    raise
+                pb.release()
+                return filled
+        """,
+    }, ReleaseOnAllPathsRule())
+    assert findings == []
+
+
+def test_release_on_all_paths_quiet_on_ownership_transfer(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, data):
+                pb = pool.acquire()
+                return stream_windows(data, pool, pb)
+
+            def g(pool, bufs):
+                pb = pool.acquire()
+                bufs.add(pb)
+        """,
+    }, ReleaseOnAllPathsRule())
+    assert findings == []
+
+
+def test_release_on_all_paths_ignores_locks_and_semaphores(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(lk, sem):
+                got = lk.acquire(writer=True, timeout=30)
+                ok = sem.acquire(blocking=False)
+        """,
+    }, ReleaseOnAllPathsRule())
+    assert findings == []
+
+
+def test_release_on_all_paths_suppressed_with_justification(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, data):
+                # mtpulint: disable=release-on-all-paths -- test harness leak on purpose
+                pb = pool.acquire()
+                fill(data, pb.view())
+        """,
+    }, ReleaseOnAllPathsRule())
+    assert findings == []
+
+
+# -- double-release -----------------------------------------------------------
+
+
+def test_double_release_fires_on_sequential_releases(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool):
+                pb = pool.acquire()
+                pb.release()
+                pb.release()
+        """,
+    }, DoubleReleaseRule())
+    assert [f.rule for f in findings] == ["double-release"]
+    assert findings[0].line == 4
+
+
+def test_double_release_fires_on_unguarded_finally(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, data):
+                pb = pool.acquire()
+                try:
+                    fill(data, pb.view())
+                    pb.release()
+                finally:
+                    pb.release()
+        """,
+    }, DoubleReleaseRule())
+    assert len(findings) == 1
+    assert "finally" in findings[0].message
+
+
+def test_double_release_quiet_with_none_rebind_guard(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, bufs, data):
+                pb = pool.acquire()
+                try:
+                    fill(data, pb.view())
+                    bufs.add(pb)
+                    pb = None
+                finally:
+                    if pb is not None:
+                        pb.release()
+        """,
+    }, DoubleReleaseRule())
+    assert findings == []
+
+
+def test_double_release_quiet_with_retain_between(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool):
+                pb = pool.acquire()
+                pb.release()
+                pb.retain()
+                pb.release()
+        """,
+    }, DoubleReleaseRule())
+    assert findings == []
+
+
+# -- view-escape --------------------------------------------------------------
+
+
+def test_view_escape_fires_on_self_assign_and_return(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            class C:
+                def f(self, pool):
+                    pb = pool.acquire()
+                    v = pb.view(0, 64)
+                    self.window = v
+                    pb.release()
+
+            def g(pool):
+                pb = pool.acquire()
+                v = pb.view()
+                pb.release()
+                return v
+        """,
+    }, ViewEscapeRule())
+    assert [f.rule for f in findings] == ["view-escape", "view-escape"]
+    assert findings[0].line == 5
+    assert "stored outside" in findings[0].message
+    assert "returned" in findings[1].message
+
+
+def test_view_escape_fires_on_container_append_and_submit(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, batch, ex):
+                pb = pool.acquire()
+                batch.append(pb.view(0, 32))
+                ex.submit(consume, pb.view(32, 64))
+                pb.release()
+        """,
+    }, ViewEscapeRule())
+    assert len(findings) == 2
+    assert "container" in findings[0].message
+    assert "submit" in findings[1].message
+
+
+def test_view_escape_fires_on_closure_capture(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, ex):
+                pb = pool.acquire()
+                v = pb.view()
+
+                def worker():
+                    return consume(v)
+
+                ex.submit(worker)
+                pb.release()
+        """,
+    }, ViewEscapeRule())
+    assert len(findings) == 1
+    assert "closure" in findings[0].message
+
+
+def test_view_escape_quiet_with_retain_or_plain_calls(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool, data, batch):
+                pb = pool.acquire()
+                filled = fill(data, pb.view())   # synchronous use: fine
+                pb.retain()
+                batch.append(pb.view(0, filled)) # rides the retained buffer
+                pb.release()
+                return filled
+        """,
+    }, ViewEscapeRule())
+    assert findings == []
+
+
+def test_view_escape_suppressed_with_justification(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/object/x.py": """
+            def f(pool):
+                pb = pool.acquire()
+                v = pb.view()
+                # mtpulint: disable=view-escape -- caller releases via the window object
+                return v
+        """,
+    }, ViewEscapeRule())
+    assert findings == []
+
+
+# -- interface-conformance ----------------------------------------------------
+
+_IFACE_SRC = """
+    import abc
+
+    class StorageAPI(abc.ABC):
+        @abc.abstractmethod
+        def read_all(self, volume, path): ...
+
+        @abc.abstractmethod
+        def write_all(self, volume, path, data): ...
+
+        def read_file_into(self, volume, path, offset, buf):
+            return 0
+"""
+
+
+def test_interface_conformance_fires_on_missing_methods(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/interface.py": _IFACE_SRC,
+        "minio_tpu/storage/wrap.py": """
+            class PartialWrapper:
+                def __init__(self, inner):
+                    self.__dict__["inner"] = inner
+
+                def read_all(self, volume, path):
+                    return self.inner.read_all(volume, path)
+        """,
+    }, InterfaceConformanceRule())
+    missing = sorted(f.message.split("StorageAPI.")[1].split(" ")[0] for f in findings)
+    assert [f.rule for f in findings] == ["interface-conformance"] * 2
+    assert missing == ["read_file_into", "write_all"]
+
+
+def test_interface_conformance_quiet_with_getattr_delegation(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/interface.py": _IFACE_SRC,
+        "minio_tpu/chaos/wrap.py": """
+            class Delegating:
+                def __init__(self, inner):
+                    self.inner = inner
+
+                def __getattr__(self, name):
+                    return getattr(self.inner, name)
+
+                def read_all(self, volume, path):
+                    return self.inner.read_all(volume, path)
+        """,
+    }, InterfaceConformanceRule())
+    assert findings == []
+
+
+def test_interface_conformance_ignores_non_wrappers(tmp_path):
+    findings = run_rule(tmp_path, {
+        "minio_tpu/storage/interface.py": _IFACE_SRC,
+        "minio_tpu/storage/other.py": """
+            class NotAWrapper:
+                def __init__(self, path):
+                    self.path = path
+        """,
+    }, InterfaceConformanceRule())
     assert findings == []

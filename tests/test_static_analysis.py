@@ -41,6 +41,8 @@ def test_mtpulint_lists_all_rules():
         "lock-blocking-io", "resource-leak", "stage-key",
         "metrics-rendered", "typed-errors", "unlocked-global",
         "lock-order", "unjoined-thread", "cond-wait-loop", "shared-publish",
+        "release-on-all-paths", "double-release", "view-escape",
+        "interface-conformance",
     ):
         assert rule_id in proc.stdout, f"rule {rule_id} missing from --list-rules"
 

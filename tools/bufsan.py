@@ -1,0 +1,234 @@
+"""bufsan driver: static + runtime buffer-lifetime scan, gated on findings.
+
+The third leg of the correctness-tooling tripod (mtpulint: static project
+invariants; mtpusan: runtime concurrency sanitizer; bufsan: buffer lifetime
+on the zero-copy plane). This driver runs BOTH halves:
+
+  1. the static half -- the mtpulint buffer rules (`release-on-all-paths`,
+     `double-release`, `view-escape`, `interface-conformance`) over the
+     tree, so an escape on a path the replay never exercises still gates;
+  2. the runtime half -- loadgen scenario replays with ``MTPU_BUFSAN=1``
+     (minio_tpu/control/bufsan.py): every acquisition site-tagged, free-list
+     storage sentinel-poisoned and verified on reuse, live view exports
+     probed at the last release, handles weakref-tracked for leaks. The
+     full run replays ``put_scaling`` AND ``hot_get_storm`` (the PUT window
+     pipeline and the GET shard-row fan-out are disjoint buffer planes);
+     ``--smoke`` replays ``smoke`` only, fast enough for
+     ``chaos_check --invariants``;
+  3. merges every subprocess's ``MTPU_BUFSAN_OUT`` artifact, drops rows the
+     in-code SUPPRESSIONS table already justified, applies the shrink-only
+     baseline (``tools/bufsan_baseline.txt``, site::rule::count -- kept
+     EMPTY: every true positive gets fixed, not grandfathered), and fails
+     on anything left.
+
+    python tools/bufsan.py                  # static + both replays, gate
+    python tools/bufsan.py --smoke          # static + smoke replay only
+    python tools/bufsan.py --static-only
+    python tools/bufsan.py --scenarios-only
+    python tools/bufsan.py --out /tmp/bufsan.json     # merged report JSON
+    python tools/bufsan.py --write-baseline           # grandfather (shrink-only)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _HERE)
+sys.path.insert(0, ROOT)
+
+from mtpulint.engine import (  # noqa: E402
+    Finding,
+    apply_baseline,
+    build_project,
+    format_baseline,
+    load_baseline,
+    run_rules,
+)
+from mtpulint.rules import (  # noqa: E402
+    DoubleReleaseRule,
+    InterfaceConformanceRule,
+    ReleaseOnAllPathsRule,
+    ViewEscapeRule,
+)
+
+BASELINE_PATH = os.path.join(_HERE, "bufsan_baseline.txt")
+FULL_SCENARIOS = ("put_scaling", "hot_get_storm")
+SMOKE_SCENARIOS = ("smoke",)
+TIMEOUT_S = int(os.environ.get("BUFSAN_TIMEOUT_S", "1200"))
+
+BUFFER_RULES = [
+    ReleaseOnAllPathsRule(),
+    DoubleReleaseRule(),
+    ViewEscapeRule(),
+    InterfaceConformanceRule(),
+]
+
+
+def _read_report(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def run_static(reports: list[dict]) -> int:
+    """The four buffer rules over minio_tpu, reported in the same shape as
+    a runtime artifact so one merge/gate handles both halves. Inline
+    mtpulint suppressions already filtered these; anything left is real
+    (or belongs in the shrink-only baseline, which stays empty)."""
+    project = build_project(ROOT, ["minio_tpu"])
+    findings = [
+        {"rule": f.rule, "site": f"{f.relpath}:{f.line}", "message": f.message}
+        for f in run_rules(project, BUFFER_RULES)
+    ]
+    reports.append({"source": "static", "findings": findings})
+    print(f"[bufsan] static scan: {len(project.files)} file(s), "
+          f"{len(findings)} finding(s)")
+    return 0
+
+
+def run_scenario(name: str, reports: list[dict]) -> int:
+    """One loadgen replay with the runtime sanitizer armed."""
+    scen = os.path.join(ROOT, "scenarios", f"{name}.yaml")
+    if not os.path.exists(scen):
+        print(f"[bufsan] scenario not found: {scen}", file=sys.stderr)
+        return 2
+    print(f"[bufsan] sanitized scenario replay: {name}")
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
+        out = tf.name
+    report_path = os.path.join(tempfile.gettempdir(), f"bufsan_{name}.json")
+    env = dict(os.environ, MTPU_BUFSAN="1", MTPU_BUFSAN_OUT=out)
+    try:
+        t0 = time.time()
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_HERE, "loadgen.py"), scen,
+             "--out", report_path],
+            cwd=ROOT, env=env, timeout=TIMEOUT_S,
+        )
+        rep = _read_report(out)
+        if rep is not None:
+            rep["source"] = f"scenario:{name}"
+            reports.append(rep)
+        counters = (rep or {}).get("counters") or {}
+        print(f"[bufsan] scenario {name}: rc={proc.returncode} "
+              f"({time.time() - t0:.0f}s, "
+              f"{counters.get('acquires', '?')} acquire(s), "
+              f"{counters.get('sentinel_checks', '?')} sentinel check(s), "
+              f"{len((rep or {}).get('findings', []))} raw finding(s))")
+        if rep is None:
+            print(f"[bufsan] scenario {name}: no sanitizer artifact -- "
+                  "the armed run died before atexit", file=sys.stderr)
+            return max(proc.returncode, 1)
+        # The scenario's SLO verdict is tools/perf_gate.py's business; only
+        # lifetime findings gate here, so a perf regression cannot mask (or
+        # be masked by) a buffer bug.
+        return 0 if proc.returncode in (0, 1) else proc.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[bufsan] scenario {name}: timed out after {TIMEOUT_S}s",
+              file=sys.stderr)
+        return 1
+    finally:
+        try:
+            os.unlink(out)
+        except OSError:
+            pass
+
+
+def merge_findings(reports: list[dict]) -> tuple[list[dict], list[dict]]:
+    """(unsuppressed, suppressed) across runs, deduped by (rule, site)."""
+    seen: set[tuple[str, str]] = set()
+    unsup: list[dict] = []
+    sup: list[dict] = []
+    for rep in reports:
+        for f in rep.get("findings", []):
+            key = (f.get("rule", "?"), f.get("site", "?"))
+            if key in seen:
+                continue
+            seen.add(key)
+            f = dict(f, source=rep.get("source", "?"))
+            (sup if "suppressed" in f else unsup).append(f)
+    return unsup, sup
+
+
+def gate(unsup: list[dict], baseline_path: str, write: bool) -> int:
+    """Apply the shrink-only baseline; 0 iff nothing new."""
+    as_findings = [
+        Finding(f["rule"], f["site"], 0, f.get("message", "")) for f in unsup
+    ]
+    if write:
+        header = (
+            "# bufsan baseline -- grandfathered buffer-lifetime findings\n"
+            "# (site::rule::count). Shrink-only, and kept EMPTY on purpose:\n"
+            "# a buffer-lifetime finding is a data-corruption class, fix it\n"
+            "# in the same PR. Regenerate: python tools/bufsan.py --write-baseline"
+        )
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(format_baseline(as_findings, header))
+        print(f"[bufsan] baseline written: {len(as_findings)} finding(s) "
+              f"-> {baseline_path}")
+        return 0
+    new, stale = apply_baseline(as_findings, load_baseline(baseline_path))
+    for f in new:
+        print(f"[bufsan] FINDING {f.rule} @ {f.relpath}: {f.message}",
+              file=sys.stderr)
+    for s in stale:
+        print(f"[bufsan] stale baseline entry: {s}", file=sys.stderr)
+    if new:
+        print(f"[bufsan] {len(new)} unsuppressed finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="bufsan", description="buffer-lifetime sanitizer driver"
+    )
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast gate: static rules + the smoke scenario only")
+    ap.add_argument("--static-only", action="store_true")
+    ap.add_argument("--scenarios-only", action="store_true")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather current findings (shrink-only) and exit 0")
+    ap.add_argument("--out", default=None,
+                    help="write the merged bufsan report JSON here")
+    args = ap.parse_args(argv)
+
+    reports: list[dict] = []
+    rc = 0
+    if not args.scenarios_only:
+        rc = max(rc, run_static(reports))
+    if not args.static_only:
+        names = SMOKE_SCENARIOS if args.smoke else FULL_SCENARIOS
+        for name in names:
+            rc = max(rc, run_scenario(name, reports))
+
+    unsup, sup = merge_findings(reports)
+    for f in sup:
+        print(f"[bufsan] suppressed: {f['rule']} @ {f['site']} "
+              f"({f['suppressed']})")
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(
+                {"bufsan": 1, "findings": unsup, "suppressed": sup,
+                 "runs": len(reports)},
+                f, indent=2, sort_keys=True,
+            )
+        print(f"[bufsan] merged report: {args.out}")
+    gate_rc = gate(unsup, args.baseline, args.write_baseline)
+    rc = max(rc, gate_rc)
+    print(f"[bufsan] {'PASS' if rc == 0 else 'FAIL'}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,7 +20,11 @@ hop that lost the budget plumbing fails here, not in a live cluster. With
 --invariants the FULL mtpulint rule set runs first, which since the mtpusan
 work includes the concurrency rules (lock-order, unjoined-thread,
 cond-wait-loop, shared-publish) -- the static half of what the runtime
-sanitizer (tools/mtpusan.py, MTPU_TSAN=1) checks dynamically.
+sanitizer (tools/mtpusan.py, MTPU_TSAN=1) checks dynamically -- and since
+the bufsan work the buffer-lifetime rules (release-on-all-paths,
+double-release, view-escape, interface-conformance), whose runtime half
+(tools/bufsan.py --smoke, MTPU_BUFSAN=1) replays a sanitized smoke
+scenario right after.
 """
 
 from __future__ import annotations
@@ -52,6 +56,17 @@ def main() -> int:
         # the deadline ones: run the full mtpulint rule set over the tree.
         proc = subprocess.run(
             [sys.executable, "-m", "tools.mtpulint", "minio_tpu"], cwd=root
+        )
+        if proc.returncode != 0:
+            return proc.returncode
+        # Buffer-lifetime gate (tools/bufsan.py --smoke): the static buffer
+        # rules again (redundant with mtpulint above, cheap) PLUS one
+        # sanitized smoke replay with MTPU_BUFSAN=1 -- sentinel poisoning,
+        # view-export probes, and leak tracking against live pool traffic,
+        # gated on the (empty) tools/bufsan_baseline.txt.
+        proc = subprocess.run(
+            [sys.executable, os.path.join("tools", "bufsan.py"), "--smoke"],
+            cwd=root,
         )
         if proc.returncode != 0:
             return proc.returncode

@@ -4,8 +4,11 @@ Every rule encodes a structural property PRs 1-4 established and a refactor
 could silently drop: error transport (swallowed-except, typed-errors),
 deadline plumbing (raw-transport, deadline-rebind), lock hygiene
 (lock-blocking-io, unlocked-global), resource lifetime (resource-leak),
-durability barriers (unsynced-commit), and the observability seams
-(stage-key, metrics-rendered). Rules are AST-based
+durability barriers (unsynced-commit), the observability seams
+(stage-key, metrics-rendered), and buffer lifetime on the zero-copy plane
+(release-on-all-paths, double-release, view-escape, interface-conformance
+-- the static half of bufsan, see minio_tpu/control/bufsan.py). Rules are
+AST-based
 -- they see structure, not text -- so renames and reformatting can't dodge
 them, and suppressions (`# mtpulint: disable=<rule>`) are visible decisions
 in the diff rather than regex blind spots.
@@ -1534,6 +1537,534 @@ class HotPathCopyRule(Rule):
             yield from self._check_augments(ctx)
 
 
+# ---------------------------------------------------------------------------
+# bufsan static half: buffer-lifetime dataflow over the zero-copy plane.
+# The runtime complement lives in minio_tpu/control/bufsan.py (MTPU_BUFSAN=1);
+# these rules prove the discipline about paths the sanitized replay never ran.
+# ---------------------------------------------------------------------------
+
+STORAGE_IFACE = "minio_tpu/storage/interface.py"
+
+# Everywhere pooled buffers flow today, plus the control-plane probe that
+# borrows the pool (selftest netperf) and utils/ itself.
+BUFFER_PATHS = HOT_PATHS + (
+    "minio_tpu/control/selftest.py",
+    "minio_tpu/utils/",
+)
+
+
+def _is_poolish(expr: ast.AST) -> bool:
+    """Does this expression look like a BufferPool? Matched by the naming
+    convention the tree actually uses -- `pool`, `self._pool`,
+    `window_pool()`, `shard_pool()`, `BufferPool(...)` -- so `lk.acquire()`
+    (locks) and `sem.acquire()` (semaphores) never enter the dataflow."""
+    if isinstance(expr, ast.Name):
+        return "pool" in expr.id.lower()
+    if isinstance(expr, ast.Attribute):
+        return "pool" in expr.attr.lower()
+    if isinstance(expr, ast.Call):
+        last = _call_name(expr).rsplit(".", 1)[-1]
+        return "pool" in last.lower() or last == "BufferPool"
+    return False
+
+
+# Both end the buffer's life: release() recycles the storage, discard()
+# drops it (exception paths where a traceback may pin foreign views).
+RELEASE_METHODS = ("release", "discard")
+
+
+def _is_buffer_acquire(value: ast.AST | None) -> bool:
+    """`<pool>.acquire(...)` or a `*acquire*buf*` helper call."""
+    if not isinstance(value, ast.Call):
+        return False
+    func = value.func
+    if isinstance(func, ast.Attribute) and func.attr == "acquire":
+        return _is_poolish(func.value)
+    if isinstance(func, ast.Name):
+        low = func.id.lower()
+        return "acquire" in low and "buf" in low
+    return False
+
+
+def _shallow_nodes(root: ast.AST):
+    """Pre-order walk of a function body that does not descend into nested
+    function scopes (each scope owns its own buffer lifecycle)."""
+    for stmt in root.body:
+        stack = [stmt]
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.extend(reversed(list(ast.iter_child_nodes(node))))
+
+
+def _method_call(node: ast.AST, name: str, method: str) -> bool:
+    """Is `node` the call `name.method(...)`?"""
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == method
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == name
+    )
+
+
+def _escaping_names(expr: ast.AST | None) -> set[str]:
+    """Names whose VALUE escapes through `expr` (returned/stored as-is):
+    direct names and names inside tuple/list/dict/set/conditional
+    containers. Does NOT descend into calls -- `bytes(v)` / `len(v)`
+    compute FROM the view, they do not leak it."""
+    out: set[str] = set()
+    if expr is None:
+        return out
+    if isinstance(expr, ast.Name):
+        out.add(expr.id)
+    elif isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        for e in expr.elts:
+            out |= _escaping_names(e)
+    elif isinstance(expr, ast.Dict):
+        for e in expr.values:
+            out |= _escaping_names(e)
+    elif isinstance(expr, ast.Starred):
+        out |= _escaping_names(expr.value)
+    elif isinstance(expr, ast.IfExp):
+        out |= _escaping_names(expr.body) | _escaping_names(expr.orelse)
+    elif isinstance(expr, ast.NamedExpr):
+        out |= _escaping_names(expr.value)
+    return out
+
+
+class _BufferFlow:
+    """Per-function buffer-lifetime facts shared by the three bufsan rules:
+    which names were acquired from a pool, where they are released (and
+    whether any release sits on an exception edge), which were retained,
+    and which were handed off (bare argument to a call, returned, yielded,
+    or stored into an attribute/container)."""
+
+    CONTAINER_METHODS = {"append", "add", "put", "put_nowait", "appendleft"}
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.acquired: dict[str, int] = {}          # name -> first acquire line
+        self.releases: dict[str, list[ast.Call]] = {}
+        self.protected: set[str] = set()            # release on an except/finally edge
+        self.retained: set[str] = set()
+        self.transferred: set[str] = set()
+        self._collect()
+
+    def _collect(self) -> None:
+        nodes = list(_shallow_nodes(self.func))
+        for node in nodes:
+            if isinstance(node, ast.Assign) and _is_buffer_acquire(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.acquired.setdefault(t.id, node.lineno)
+        if not self.acquired:
+            return
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                self._note_call(node)
+            elif isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                for name in _escaping_names(node.value):
+                    if name in self.acquired:
+                        self.transferred.add(name)
+            elif isinstance(node, ast.Assign):
+                if any(
+                    isinstance(t, (ast.Attribute, ast.Subscript))
+                    for t in node.targets
+                ):
+                    for name in _escaping_names(node.value):
+                        if name in self.acquired:
+                            self.transferred.add(name)
+        # Exception-edge coverage: a release reachable from an except
+        # handler or finally body covers the raise paths of its try.
+        for node in nodes:
+            if not isinstance(node, ast.Try):
+                continue
+            edges = list(node.finalbody)
+            for h in node.handlers:
+                edges.extend(h.body)
+            for stmt in edges:
+                for sub in ast.walk(stmt):
+                    for name in self.acquired:
+                        if any(
+                            _method_call(sub, name, m) for m in RELEASE_METHODS
+                        ):
+                            self.protected.add(name)
+
+    def _note_call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+            owner = func.value.id
+            if owner in self.acquired:
+                if func.attr in RELEASE_METHODS:
+                    self.releases.setdefault(owner, []).append(node)
+                    return
+                if func.attr == "retain":
+                    self.retained.add(owner)
+                    return
+                if func.attr == "view":
+                    return  # view creation is not a handoff of the buffer
+        # A tracked buffer passed as a bare argument is an ownership
+        # transfer: `_stream_windows(data, pool, pb, filled)`,
+        # `_Window(view, pb)`, `bufs.add(pb)` all hand the release
+        # obligation to the callee.
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in self.acquired:
+                self.transferred.add(arg.id)
+
+
+def _iter_functions(ctx: FileContext):
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+class ReleaseOnAllPathsRule(Rule):
+    """Every pooled-buffer acquire() must reach release() on every path.
+
+    The pool's pigeonhole (outstanding == 0 after every request) only holds
+    when each `pb = pool.acquire()` either releases on the exception edges
+    too -- a release inside an `except`/`finally` -- or hands the buffer
+    off (bare argument to a call, returned, yielded, stored) to an owner
+    that takes over the obligation. A straight-line release with neither is
+    one raise away from leaking the window forever."""
+
+    id = "release-on-all-paths"
+    title = "pooled buffer acquire() without release on every path"
+    scope = BUFFER_PATHS
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            for func in _iter_functions(ctx):
+                flow = _BufferFlow(func)
+                for name, lineno in flow.acquired.items():
+                    if name in flow.retained or name in flow.transferred:
+                        continue
+                    if not flow.releases.get(name):
+                        yield Finding(
+                            self.id, ctx.relpath, lineno,
+                            f"{name!r} is acquired from a pool but never "
+                            "released or handed off in this function -- "
+                            "the window leaks and outstanding never drains",
+                        )
+                    elif name not in flow.protected:
+                        yield Finding(
+                            self.id, ctx.relpath, lineno,
+                            f"{name!r} is only released on the straight-line "
+                            "path -- a raise between acquire() and release() "
+                            "leaks the window; release in a finally/except "
+                            "or hand the buffer off",
+                        )
+
+
+class DoubleReleaseRule(Rule):
+    """release() twice on the same pooled buffer.
+
+    The second release corrupts whoever re-acquired the storage (or raises
+    under the pool's refcount guard, torching an unrelated request). Two
+    shapes: back-to-back unconditional releases in one statement list, and
+    a try-body release repeated unguarded in the finally (the correct
+    pattern rebinds `pb = None` after the handoff and guards the finally
+    with `if pb is not None`)."""
+
+    id = "double-release"
+    title = "pooled buffer released twice on one path"
+    scope = BUFFER_PATHS
+
+    def _sequential(self, flow: _BufferFlow):
+        """Two top-level `name.release()` statements in one body list with
+        no rebind/retain between them."""
+        for node in [flow.func, *_shallow_nodes(flow.func)]:
+            for field in ("body", "orelse", "finalbody"):
+                body = getattr(node, field, None)
+                if not isinstance(body, list):
+                    continue
+                seen: set[str] = set()
+                for stmt in body:
+                    if isinstance(stmt, ast.Assign):
+                        for t in stmt.targets:
+                            if isinstance(t, ast.Name):
+                                seen.discard(t.id)
+                        continue
+                    if not isinstance(stmt, ast.Expr):
+                        continue
+                    call = stmt.value
+                    for name in flow.acquired:
+                        if _method_call(call, name, "retain"):
+                            seen.discard(name)
+                        elif any(
+                            _method_call(call, name, m) for m in RELEASE_METHODS
+                        ):
+                            if name in seen:
+                                yield name, stmt.lineno
+                            seen.add(name)
+
+    def _try_finally(self, flow: _BufferFlow):
+        """Unconditional release in a try body + unguarded release at the
+        top of its finally: both run on the success path."""
+        for node in _shallow_nodes(flow.func):
+            if not isinstance(node, ast.Try) or not node.finalbody:
+                continue
+            for name in flow.acquired:
+                in_try = any(
+                    isinstance(stmt, ast.Expr)
+                    and any(
+                        _method_call(stmt.value, name, m)
+                        for m in RELEASE_METHODS
+                    )
+                    for stmt in node.body
+                )
+                rebound = any(
+                    isinstance(stmt, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name) and t.id == name
+                        for t in stmt.targets
+                    )
+                    for stmt in node.body
+                )
+                if not in_try or rebound:
+                    continue
+                for stmt in node.finalbody:
+                    if isinstance(stmt, ast.Expr) and any(
+                        _method_call(stmt.value, name, m)
+                        for m in RELEASE_METHODS
+                    ):
+                        yield name, stmt.lineno
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            for func in _iter_functions(ctx):
+                flow = _BufferFlow(func)
+                if not flow.acquired:
+                    continue
+                seen_lines: set[tuple[str, int]] = set()
+                for name, lineno in self._sequential(flow):
+                    seen_lines.add((name, lineno))
+                    yield Finding(
+                        self.id, ctx.relpath, lineno,
+                        f"{name!r} released twice on the same path -- the "
+                        "second release corrupts the refcount of whoever "
+                        "re-acquired the storage",
+                    )
+                for name, lineno in self._try_finally(flow):
+                    if (name, lineno) in seen_lines:
+                        continue
+                    yield Finding(
+                        self.id, ctx.relpath, lineno,
+                        f"{name!r} released in the try body AND unguarded in "
+                        "its finally -- rebind to None after the handoff and "
+                        "guard the finally with `if {0} is not None`".format(name),
+                    )
+
+
+class ViewEscapeRule(Rule):
+    """A memoryview over a pooled buffer escaping its owner's scope.
+
+    bufpool's contract: views must not outlive the buffer's last release.
+    A view that is returned/yielded, stored on `self` or in a container,
+    shipped to a thread/lane submit, or captured by a closure survives
+    past the release that recycles the storage underneath it -- the holder
+    then silently reads ANOTHER request's bytes. Legitimate long-lived
+    views ride a `retain()`ed buffer (the _Window pattern: view and buffer
+    handed off together)."""
+
+    id = "view-escape"
+    title = "pooled-buffer view escapes without a retain()"
+    scope = BUFFER_PATHS
+
+    SUBMITISH = ("submit", "Thread", "start_new_thread", "run_in_executor")
+
+    def _is_view_of(self, node: ast.AST, flow: _BufferFlow) -> str | None:
+        """Owner name when `node` is `<buf>.view(...)` or
+        `memoryview(<buf>.data)` over a tracked buffer."""
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "view"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in flow.acquired
+        ):
+            return func.value.id
+        if (
+            isinstance(func, ast.Name)
+            and func.id == "memoryview"
+            and node.args
+            and isinstance(node.args[0], ast.Attribute)
+            and node.args[0].attr == "data"
+            and isinstance(node.args[0].value, ast.Name)
+            and node.args[0].value.id in flow.acquired
+        ):
+            return node.args[0].value.id
+        return None
+
+    def check(self, project: ProjectContext):
+        for ctx in project.iter_files(*self.scope):
+            for func in _iter_functions(ctx):
+                flow = _BufferFlow(func)
+                if not flow.acquired:
+                    continue
+                # vname -> owning buffer name, for named view bindings.
+                views: dict[str, str] = {}
+                for node in _shallow_nodes(func):
+                    if isinstance(node, ast.Assign):
+                        owner = self._is_view_of(node.value, flow)
+                        if owner is not None:
+                            for t in node.targets:
+                                if isinstance(t, ast.Name):
+                                    views[t.id] = owner
+
+                def owner_of(expr: ast.AST) -> str | None:
+                    direct = self._is_view_of(expr, flow)
+                    if direct is not None:
+                        return direct
+                    if isinstance(expr, ast.Name):
+                        return views.get(expr.id)
+                    return None
+
+                def escapees(expr: ast.AST | None):
+                    direct = self._is_view_of(expr, flow) if expr is not None else None
+                    if direct is not None:
+                        yield direct, expr
+                    for name in _escaping_names(expr):
+                        if name in views:
+                            yield views[name], expr
+
+                findings: dict[tuple[int, str], str] = {}
+
+                def note(owner: str, node: ast.AST, how: str) -> None:
+                    if owner in flow.retained:
+                        return
+                    findings.setdefault((node.lineno, owner), how)
+
+                for node in _shallow_nodes(func):
+                    if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                        for owner, val in escapees(getattr(node, "value", None)):
+                            note(owner, node, "returned/yielded")
+                    elif isinstance(node, ast.Assign):
+                        if any(
+                            isinstance(t, (ast.Attribute, ast.Subscript))
+                            for t in node.targets
+                        ):
+                            for owner, val in escapees(node.value):
+                                note(owner, node, "stored outside the scope")
+                    elif isinstance(node, ast.Call):
+                        callee = _call_name(node)
+                        last = callee.rsplit(".", 1)[-1]
+                        args = list(node.args) + [kw.value for kw in node.keywords]
+                        if last in _BufferFlow.CONTAINER_METHODS:
+                            for a in args:
+                                o = owner_of(a)
+                                if o is not None:
+                                    note(o, node, "appended to a container")
+                        elif any(s in last for s in self.SUBMITISH):
+                            for a in args:
+                                for sub in ast.walk(a):
+                                    o = owner_of(sub)
+                                    if o is not None:
+                                        note(o, node, "passed to a thread/lane submit")
+                    elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                        # Closure capture: the nested scope outlives this one.
+                        inner = (
+                            node.body if isinstance(node.body, list) else [node.body]
+                        )
+                        for stmt in inner:
+                            for sub in ast.walk(stmt if isinstance(stmt, ast.AST) else node):
+                                if isinstance(sub, ast.Name) and sub.id in views:
+                                    note(views[sub.id], node, "captured by a closure")
+                for (lineno, owner), how in sorted(findings.items()):
+                    yield Finding(
+                        self.id, ctx.relpath, lineno,
+                        f"view over pooled buffer {owner!r} {how} without a "
+                        f"retain() -- when {owner!r} is released the storage "
+                        "recycles and the view reads another request's "
+                        "bytes; retain() the buffer for the view's lifetime "
+                        "(and release with it), or copy the bytes out",
+                    )
+
+
+class InterfaceConformanceRule(Rule):
+    """StorageAPI wrappers must forward the FULL storage interface.
+
+    MeteredDrive / FaultyDisk / HealthGatedDrive sit in every drive stack;
+    a wrapper that pins an `inner` drive but neither defines `__getattr__`
+    nor implements every StorageAPI method silently drops whatever the
+    interface grew since the wrapper was written (`read_file_into`,
+    `append_iov`) -- callers fall back to slow paths or AttributeError at
+    runtime. The interface roster is read from storage/interface.py, so the
+    rule tracks StorageAPI growth automatically."""
+
+    id = "interface-conformance"
+    title = "StorageAPI wrapper missing interface methods"
+    scope = ("minio_tpu/storage/", "minio_tpu/chaos/")
+
+    @staticmethod
+    def _iface_methods(project: ProjectContext) -> set[str]:
+        ctx = project.get(STORAGE_IFACE)
+        if ctx is None:
+            return set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "StorageAPI":
+                return {
+                    n.name
+                    for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and not n.name.startswith("_")
+                }
+        return set()
+
+    @staticmethod
+    def _wraps_inner(cls: ast.ClassDef) -> bool:
+        """Does __init__ pin an `inner` drive? Both idioms count:
+        `self.inner = inner` and `self.__dict__["inner"] = inner` (the
+        __setattr__-forwarding form the real wrappers use)."""
+        for node in cls.body:
+            if not isinstance(node, ast.FunctionDef) or node.name != "__init__":
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                for t in sub.targets:
+                    if isinstance(t, ast.Attribute) and t.attr == "inner":
+                        return True
+                    if (
+                        isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and t.value.attr == "__dict__"
+                        and _str_const(t.slice) == "inner"
+                    ):
+                        return True
+        return False
+
+    def check(self, project: ProjectContext):
+        methods = self._iface_methods(project)
+        if not methods:
+            return
+        for ctx in project.iter_files(*self.scope):
+            if ctx.relpath == STORAGE_IFACE:
+                continue
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.ClassDef) or not self._wraps_inner(node):
+                    continue
+                defined = {
+                    n.name
+                    for n in node.body
+                    if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                }
+                if "__getattr__" in defined:
+                    continue
+                for missing in sorted(methods - defined):
+                    yield Finding(
+                        self.id, ctx.relpath, node.lineno,
+                        f"wrapper {node.name!r} neither defines __getattr__ "
+                        f"nor forwards StorageAPI.{missing} -- the drive "
+                        "stack silently loses the method",
+                    )
+
+
 ALL_RULES: list[Rule] = [
     SwallowedExceptRule(),
     RawTransportRule(),
@@ -1550,6 +2081,10 @@ ALL_RULES: list[Rule] = [
     SharedPublishRule(),
     UnsyncedCommitRule(),
     HotPathCopyRule(),
+    ReleaseOnAllPathsRule(),
+    DoubleReleaseRule(),
+    ViewEscapeRule(),
+    InterfaceConformanceRule(),
 ]
 
 # deadline_lint.py's historical surface: the two rules that together are the

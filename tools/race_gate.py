@@ -25,7 +25,11 @@ With --sanitize every round ALSO arms the mtpusan runtime sanitizer
 cycles, long holds, sleeps under locks, and teardown thread/fd leaks are
 collected per round and gated against tools/mtpusan_baseline.txt -- the
 lockdep side of the story, where this gate alone only catches races that
-actually fire.
+actually fire. The same rounds arm the bufsan buffer-lifetime sanitizer
+(MTPU_BUFSAN=1, minio_tpu/control/bufsan.py): view-outlives-buffer,
+write-after-release, double-release, and buffer-leak findings gate
+against tools/bufsan_baseline.txt (which is kept empty -- buffer
+lifetime bugs are a data-corruption class, not a backlog).
 
     python tools/race_gate.py [repeats] [--sanitize]
 """
@@ -76,16 +80,20 @@ def main() -> int:
           + (" [sanitized]" if sanitize else ""))
     env = dict(os.environ, MINIO_TPU_RACE="1")
     san_reports: list[dict] = []
+    bufsan_reports: list[dict] = []
     failures = 0
     for i in range(repeats):
         t0 = time.time()
-        san_out = ""
+        san_out = bufsan_out = ""
         if sanitize:
             import tempfile
 
             fd, san_out = tempfile.mkstemp(suffix=".json", prefix="mtpusan-")
             os.close(fd)
-            env = dict(env, MTPU_TSAN="1", MTPU_TSAN_OUT=san_out)
+            fd, bufsan_out = tempfile.mkstemp(suffix=".json", prefix="bufsan-")
+            os.close(fd)
+            env = dict(env, MTPU_TSAN="1", MTPU_TSAN_OUT=san_out,
+                       MTPU_BUFSAN="1", MTPU_BUFSAN_OUT=bufsan_out)
             env.setdefault("MTPU_TSAN_HOLD_MS", "400")
         try:
             proc = subprocess.run(
@@ -111,29 +119,37 @@ def main() -> int:
             status = f"DEADLOCK? timed out after {TIMEOUT_S}s"
             failures += 1
         if sanitize:
-            try:
-                with open(san_out, encoding="utf-8") as f:
-                    rep = __import__("json").load(f)
-                san_reports.append(rep)
-                status += f", {rep.get('unsuppressed', '?')} unsuppressed finding(s)"
-            except (OSError, ValueError):
-                status += ", NO sanitizer report (armed process died early?)"
-                failures += 1
-            finally:
+            for label, path, sink in (
+                ("mtpusan", san_out, san_reports),
+                ("bufsan", bufsan_out, bufsan_reports),
+            ):
                 try:
-                    os.unlink(san_out)
-                except OSError:
-                    pass
+                    with open(path, encoding="utf-8") as f:
+                        rep = __import__("json").load(f)
+                    sink.append(rep)
+                    status += (f", {rep.get('unsuppressed', '?')} "
+                               f"unsuppressed {label} finding(s)")
+                except (OSError, ValueError):
+                    status += f", NO {label} report (armed process died early?)"
+                    failures += 1
+                finally:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
         print(f"[race-gate] round {i + 1}/{repeats}: {status} ({time.time() - t0:.0f}s)")
     if sanitize and san_reports:
-        failures += _gate_sanitizer(root, san_reports)
+        failures += _gate_sanitizer(root, san_reports, "mtpusan_baseline.txt")
+    if sanitize and bufsan_reports:
+        failures += _gate_sanitizer(root, bufsan_reports, "bufsan_baseline.txt")
     print(f"[race-gate] {'PASS' if not failures else 'FAIL'} ({repeats} rounds)")
     return 1 if failures else 0
 
 
-def _gate_sanitizer(root: str, reports: list[dict]) -> int:
-    """Merge per-round sanitizer findings, gate vs tools/mtpusan_baseline.txt
-    (mtpusan.py owns the heavier scenario flow; this is the suite-only gate)."""
+def _gate_sanitizer(root: str, reports: list[dict], baseline: str) -> int:
+    """Merge per-round sanitizer findings, gate vs tools/<baseline>
+    (mtpusan.py / bufsan.py own the heavier scenario flows; this is the
+    suite-only gate)."""
     sys.path.insert(0, os.path.join(root, "tools"))
     from mtpulint.engine import Finding, apply_baseline, load_baseline
 
@@ -148,7 +164,7 @@ def _gate_sanitizer(root: str, reports: list[dict]) -> int:
                 seen.add(key)
                 merged.append(Finding(key[0], key[1], 0, f.get("message", "")))
     new, _stale = apply_baseline(
-        merged, load_baseline(os.path.join(root, "tools", "mtpusan_baseline.txt"))
+        merged, load_baseline(os.path.join(root, "tools", baseline))
     )
     for f in new:
         print(f"[race-gate] SANITIZER {f.rule} @ {f.relpath}: {f.message}",
